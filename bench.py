@@ -1,0 +1,85 @@
+"""North-star benchmark: sustained spans/sec through the fused spanmetrics
+registry update on one chip (BASELINE.json: target 10M spans/s on v5e-1).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is value / 10M (the north-star target, since the reference
+publishes no absolute numbers — BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from tempo_tpu.ops import sketches
+    from tempo_tpu.registry import metrics as rm
+
+    n_spans = 262144          # one padded batch bucket
+    n_series = 4096           # active series (typical RED cardinality)
+    edges = (0.002, 0.004, 0.008, 0.016, 0.032, 0.064, 0.128, 0.256,
+             0.512, 1.024, 2.048, 4.096, 8.192, 16.384)
+    gamma, nb_dd = sketches.dd_params(0.01, 1e-9, 1e6)
+
+    def fused_step(calls_v, h_buckets, h_sums, h_counts, size_v,
+                   dd_counts, dd_zeros, slots, dur_s, sizes, weights):
+        calls = rm.counter_update(rm.CounterState(calls_v), slots, weights)
+        hist = rm.histogram_update(
+            rm.HistogramState(h_buckets, h_sums, h_counts, edges),
+            slots, dur_s, weights)
+        size_c = rm.counter_update(rm.CounterState(size_v), slots, sizes * weights)
+        keep = slots >= 0
+        dd = sketches.dd_update(
+            sketches.DDSketch(dd_counts, dd_zeros, gamma, 1e-9),
+            jnp.where(keep, slots, 0), dur_s, mask=keep, weights=weights)
+        return (calls.values, hist.bucket_counts, hist.sums, hist.counts,
+                size_c.values, dd.counts, dd.zeros)
+
+    step = jax.jit(fused_step, donate_argnums=tuple(range(7)))
+
+    rng = np.random.default_rng(0)
+    state = (
+        jnp.zeros((n_series,), jnp.float32),
+        jnp.zeros((n_series, len(edges) + 1), jnp.float32),
+        jnp.zeros((n_series,), jnp.float32),
+        jnp.zeros((n_series,), jnp.float32),
+        jnp.zeros((n_series,), jnp.float32),
+        jnp.zeros((n_series, nb_dd), jnp.float32),
+        jnp.zeros((n_series,), jnp.float32),
+    )
+    batch = (
+        jnp.asarray(rng.integers(0, n_series, n_spans), jnp.int32),
+        jnp.asarray(rng.lognormal(-3, 1.5, n_spans), jnp.float32),
+        jnp.asarray(rng.integers(100, 5000, n_spans), jnp.float32),
+        jnp.ones((n_spans,), jnp.float32),
+    )
+
+    # warmup / compile
+    state = step(*state, *batch)
+    jax.block_until_ready(state)
+
+    iters = 30
+    t0 = time.time()
+    for _ in range(iters):
+        state = step(*state, *batch)
+    jax.block_until_ready(state)
+    dt = time.time() - t0
+
+    spans_per_sec = iters * n_spans / dt
+    print(json.dumps({
+        "metric": "spanmetrics_fused_update_throughput",
+        "value": round(spans_per_sec, 1),
+        "unit": "spans/s",
+        "vs_baseline": round(spans_per_sec / 1e7, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,16 @@
+"""Metrics-generator service: streaming span batches → Prometheus series.
+
+The TPU-native re-architecture of `modules/generator/`: per-tenant instances
+host pluggable processors (spanmetrics, servicegraphs, localblocks); a
+ManagedRegistry aggregates series on device; a collection tick converts device
+state to samples pushed out via Prometheus remote write.
+"""
+
+from tempo_tpu.generator.remote_write import (
+    encode_write_request,
+    snappy_compress,
+    RemoteWriteClient,
+)
+from tempo_tpu.generator.instance import GeneratorInstance, GeneratorConfig
+
+__all__ = [k for k in dir() if not k.startswith("_")]

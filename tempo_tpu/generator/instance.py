@@ -1,0 +1,122 @@
+"""Per-tenant generator instance: processors + registry + remote write.
+
+The analog of `modules/generator/instance.go`: `push_batch` fans a span batch
+to the enabled processors (`pushSpans` `instance.go:398-415`), processor
+enable/disable diffing follows per-tenant overrides
+(`instance.go:207-385`), and a collection tick drains the registry to the
+remote-write client (`registry.go:206` + `storage/instance.go`).
+Ingestion-slack filtering (`instance.go:442-473`) drops spans whose end time
+is too far outside [now - slack, now + slack].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+
+from tempo_tpu.generator.processors.servicegraphs import (
+    ServiceGraphsConfig,
+    ServiceGraphsProcessor,
+)
+from tempo_tpu.generator.processors.spanmetrics import (
+    SpanMetricsConfig,
+    SpanMetricsProcessor,
+)
+from tempo_tpu.generator.remote_write import RemoteWriteClient, RemoteWriteConfig
+from tempo_tpu.model.span_batch import SpanBatch
+from tempo_tpu.registry import ManagedRegistry, RegistryOverrides
+
+
+@dataclasses.dataclass
+class GeneratorConfig:
+    processors: tuple[str, ...] = ("span-metrics", "service-graphs")
+    registry: RegistryOverrides = dataclasses.field(default_factory=RegistryOverrides)
+    spanmetrics: SpanMetricsConfig = dataclasses.field(default_factory=SpanMetricsConfig)
+    servicegraphs: ServiceGraphsConfig = dataclasses.field(default_factory=ServiceGraphsConfig)
+    remote_write: RemoteWriteConfig = dataclasses.field(default_factory=RemoteWriteConfig)
+    ingestion_time_range_slack_s: float = 30.0
+
+
+class GeneratorInstance:
+    def __init__(self, tenant: str, cfg: GeneratorConfig | None = None,
+                 now=time.time):
+        self.tenant = tenant
+        self.cfg = cfg or GeneratorConfig()
+        self.now = now
+        self.registry = ManagedRegistry(tenant, self.cfg.registry, now=now)
+        self.remote_write = RemoteWriteClient(self.cfg.remote_write)
+        self.processors: dict[str, object] = {}
+        self._lock = threading.Lock()
+        self.update_processors(self.cfg.processors)
+        self.spans_received = 0
+        self.spans_filtered_slack = 0
+        self._last_purge = 0.0
+
+    # -- processor lifecycle (instance.go:207-385) -------------------------
+
+    def update_processors(self, desired: tuple[str, ...]) -> None:
+        with self._lock:
+            for name in list(self.processors):
+                if name not in desired:
+                    del self.processors[name]
+            for name in desired:
+                if name in self.processors:
+                    continue
+                if name == "span-metrics":
+                    self.processors[name] = SpanMetricsProcessor(
+                        self.registry, self.cfg.spanmetrics)
+                elif name == "service-graphs":
+                    self.processors[name] = ServiceGraphsProcessor(
+                        self.registry, self.cfg.servicegraphs)
+                elif name == "local-blocks":
+                    try:
+                        from tempo_tpu.generator.processors.localblocks import (
+                            LocalBlocksProcessor)
+                    except ImportError as e:
+                        raise NotImplementedError(
+                            "local-blocks processor requires the storage "
+                            "layer (tempo_tpu.storage); not yet built") from e
+                    self.processors[name] = LocalBlocksProcessor(self.registry)
+                else:
+                    raise ValueError(f"unknown processor {name}")
+
+    # -- ingest ------------------------------------------------------------
+
+    def push_batch(self, sb: SpanBatch, span_sizes: np.ndarray | None = None) -> None:
+        self.spans_received += sb.n
+        sb = self._apply_slack(sb)
+        for proc in self.processors.values():
+            if isinstance(proc, SpanMetricsProcessor):
+                proc.push_batch(sb, span_sizes)
+            else:
+                proc.push_batch(sb)
+
+    def _apply_slack(self, sb: SpanBatch) -> SpanBatch:
+        slack = self.cfg.ingestion_time_range_slack_s
+        if slack <= 0:
+            return sb
+        now_ns = int(self.now() * 1e9)
+        lo, hi = now_ns - int(slack * 1e9), now_ns + int(slack * 1e9)
+        keep = (sb.end_unix_nano >= lo) & (sb.end_unix_nano <= hi)
+        dropped = int((sb.valid & ~keep).sum())
+        if dropped:
+            self.spans_filtered_slack += dropped
+            sb = dataclasses.replace(sb, valid=sb.valid & keep)
+        return sb
+
+    # -- collection tick ---------------------------------------------------
+
+    def collect_and_push(self, ts_ms: int | None = None) -> int:
+        """One collection: purge stale series, gather device state, remote
+        write. Returns number of scalar samples pushed."""
+        if self.now() - self._last_purge > 60.0:
+            self.registry.purge_stale()
+            self._last_purge = self.now()
+        samples = self.registry.collect(ts_ms)
+        native = (self.registry.native_histograms(ts_ms)
+                  if self.cfg.remote_write.send_native_histograms else [])
+        self.remote_write.send(samples, native)
+        return len(samples)

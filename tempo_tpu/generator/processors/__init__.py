@@ -1,0 +1,12 @@
+"""Generator processors: spanmetrics, servicegraphs, localblocks.
+
+Processor contract (the analog of the reference's
+`modules/generator/processor.Processor` interface): `push_batch(SpanBatch)`
+ingests spans, `name()` identifies the processor for per-tenant enable/disable
+diffing (`modules/generator/instance.go:207-385`).
+"""
+
+from tempo_tpu.generator.processors.spanmetrics import SpanMetricsConfig, SpanMetricsProcessor
+from tempo_tpu.generator.processors.servicegraphs import ServiceGraphsConfig, ServiceGraphsProcessor
+
+__all__ = [k for k in dir() if not k.startswith("_")]

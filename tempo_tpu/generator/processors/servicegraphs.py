@@ -1,0 +1,217 @@
+"""servicegraphs processor: client/server span pairing → edge metrics.
+
+Reference semantics (`modules/generator/processor/servicegraphs/`):
+
+- `consume` (`servicegraphs.go:172-255`): CLIENT/PRODUCER spans register an
+  edge keyed by (trace id, span id); SERVER/CONSUMER spans match on
+  (trace id, parent span id). A completed edge emits:
+  `traces_service_graph_request_total`, `_failed_total` (either side errored),
+  `_client_seconds` / `_server_seconds` histograms (+ messaging-system delay
+  for PRODUCER/CONSUMER pairs), labeled (client, server) service names.
+- expiring edge store (`store/store.go:29,78,119`): TTL ring; expired
+  half-edges infer virtual nodes (`servicegraphs.go:390-421`): an unmatched
+  SERVER span with a remote parent gets client="user"; an unmatched CLIENT
+  span pointing at a known peer (db/messaging attrs, `servicegraphs.go:
+  287-343` heuristics) gets a server node named from peer attributes.
+
+TPU split: edge *matching* is pointer-chasing and stays on the host (a dict
+keyed by 24-byte trace+span ids, vectorized staging in/out); the metric
+updates for matched edges are batched device scatters via the shared
+registry. Latencies additionally feed a DDSketch per edge series.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+
+import numpy as np
+
+from tempo_tpu.model.interner import INVALID_ID
+from tempo_tpu.model.span_batch import (
+    KIND_CLIENT,
+    KIND_CONSUMER,
+    KIND_PRODUCER,
+    KIND_SERVER,
+    STATUS_ERROR,
+    SpanBatch,
+)
+from tempo_tpu.registry.registry import DEFAULT_HISTOGRAM_EDGES, ManagedRegistry
+
+_PEER_ATTRS = ("peer.service", "db.name", "db.system", "messaging.system",
+               "net.peer.name")  # `servicegraphs.go:287-343` heuristics
+
+
+@dataclasses.dataclass
+class ServiceGraphsConfig:
+    histogram_buckets: tuple[float, ...] = DEFAULT_HISTOGRAM_EDGES
+    wait_s: float = 10.0                 # edge TTL before expiry
+    max_items: int = 10000               # store capacity
+    enable_client_server_prefix: bool = False
+    enable_messaging_system_latency_histogram: bool = False
+    enable_virtual_node_label: bool = False
+
+
+@dataclasses.dataclass
+class _HalfEdge:
+    service_id: int
+    duration_s: float
+    failed: bool
+    is_client: bool
+    is_messaging: bool
+    peer_id: int          # interned peer-attr value (client side), or INVALID_ID
+    start_ns: int
+    expire_at: float
+
+
+class ServiceGraphsProcessor:
+    def __init__(self, registry: ManagedRegistry, config: ServiceGraphsConfig | None = None):
+        self.cfg = config or ServiceGraphsConfig()
+        self.registry = registry
+        labels = ("client", "server", "connection_type")
+        edges = self.cfg.histogram_buckets
+        self.total = registry.new_counter("traces_service_graph_request_total", labels)
+        self.failed = registry.new_counter("traces_service_graph_request_failed_total", labels)
+        self.client_hist = registry.new_histogram(
+            "traces_service_graph_request_client_seconds", labels, edges=edges)
+        self.server_hist = registry.new_histogram(
+            "traces_service_graph_request_server_seconds", labels, edges=edges)
+        for fam in (self.failed, self.client_hist, self.server_hist):
+            fam.table = self.total.table  # edge families stay slot-aligned
+        if self.cfg.enable_messaging_system_latency_histogram:
+            self.messaging_hist = registry.new_histogram(
+                "traces_service_graph_request_messaging_system_seconds", labels, edges=edges)
+            self.messaging_hist.table = self.total.table
+        else:
+            self.messaging_hist = None
+        self._store: dict[bytes, _HalfEdge] = {}
+        self._ttl: collections.deque[tuple[float, bytes]] = collections.deque()
+        self.dropped = 0  # store-full drops (`store.go` max_items)
+        self.expired = 0
+
+    def name(self) -> str:
+        return "service-graphs"
+
+    # -- ingestion ---------------------------------------------------------
+
+    def push_batch(self, sb: SpanBatch) -> None:
+        if sb.interner is not self.registry.interner:
+            raise ValueError(
+                "SpanBatch must be built with the tenant registry's interner")
+        now = self.registry.now()
+        kinds = sb.kind
+        client_like = (kinds == KIND_CLIENT) | (kinds == KIND_PRODUCER)
+        server_like = (kinds == KIND_SERVER) | (kinds == KIND_CONSUMER)
+        interesting = np.flatnonzero(sb.valid & (client_like | server_like))
+        if interesting.size == 0:
+            self._expire(now)
+            return
+        dur_s = sb.duration_ns / 1e9
+        failed = sb.status_code == STATUS_ERROR
+        peer_col = self._peer_col(sb)
+        completed: list[tuple[int, int, str, float, float, bool]] = []
+        for i in interesting.tolist():
+            is_client = bool(client_like[i])
+            is_messaging = kinds[i] in (KIND_PRODUCER, KIND_CONSUMER)
+            # client keys on own span id; server keys on parent span id
+            own = sb.span_id[i].tobytes()
+            parent = sb.parent_span_id[i].tobytes()
+            key = sb.trace_id[i].tobytes() + (own if is_client else parent)
+            other = self._store.pop(key, None)
+            if other is not None and other.is_client != is_client:
+                cli, srv = (other, None) if other.is_client else (None, other)
+                if is_client:
+                    cli = _HalfEdge(int(sb.service_id[i]), float(dur_s[i]),
+                                    bool(failed[i]), True, is_messaging,
+                                    int(peer_col[i]), int(sb.start_unix_nano[i]), 0)
+                else:
+                    srv = _HalfEdge(int(sb.service_id[i]), float(dur_s[i]),
+                                    bool(failed[i]), False, is_messaging,
+                                    INVALID_ID, int(sb.start_unix_nano[i]), 0)
+                if cli is None:
+                    cli = other
+                if srv is None:
+                    srv = other
+                conn = ("messaging_system" if (cli.is_messaging or srv.is_messaging)
+                        else "")
+                completed.append((cli.service_id, srv.service_id, conn,
+                                  cli.duration_s, srv.duration_s,
+                                  cli.failed or srv.failed,
+                                  max(0.0, (srv.start_ns - cli.start_ns) / 1e9)))
+            else:
+                if other is not None:
+                    self._store[key] = other  # same side dup; put back
+                if len(self._store) >= self.cfg.max_items:
+                    self.dropped += 1
+                    continue
+                he = _HalfEdge(int(sb.service_id[i]), float(dur_s[i]), bool(failed[i]),
+                               is_client, is_messaging, int(peer_col[i]),
+                               int(sb.start_unix_nano[i]), now + self.cfg.wait_s)
+                self._store[key] = he
+                self._ttl.append((he.expire_at, key))
+        if completed:
+            self._emit(completed)
+        self._expire(now)
+
+    def _peer_col(self, sb: SpanBatch) -> np.ndarray:
+        col = np.full(sb.capacity, INVALID_ID, np.int32)
+        for key in _PEER_ATTRS:
+            nxt = sb.attr_sval_column(key)
+            col = np.where(col != INVALID_ID, col, nxt)
+        return col
+
+    # -- emission ----------------------------------------------------------
+
+    def _emit(self, edges: list[tuple]) -> None:
+        it = self.registry.interner
+        conn_ids = {c: it.intern(c) for c in ("", "messaging_system", "virtual_node")}
+        n = len(edges)
+        rows = np.zeros((n, 3), np.int32)
+        cdur = np.zeros(n, np.float32)
+        sdur = np.zeros(n, np.float32)
+        fail = np.zeros(n, np.float32)
+        mdur = np.zeros(n, np.float32)
+        for j, (cid, sid, conn, cd, sd, failed, msg_delay) in enumerate(edges):
+            rows[j] = (cid, sid, conn_ids[conn])
+            cdur[j], sdur[j], fail[j] = cd, sd, 1.0 if failed else 0.0
+            mdur[j] = msg_delay
+        slots = self.total.resolve_slots(rows)
+        from tempo_tpu.registry import metrics as rmx
+        self.total.state = rmx.counter_update(self.total.state, slots)
+        self.failed.state = rmx.counter_update(self.failed.state, slots, fail)
+        self.client_hist.state = rmx.histogram_update(self.client_hist.state, slots, cdur)
+        self.server_hist.state = rmx.histogram_update(self.server_hist.state, slots, sdur)
+        if self.messaging_hist is not None:
+            msg = np.array([e[2] == "messaging_system" for e in edges])
+            self.messaging_hist.state = rmx.histogram_update(
+                self.messaging_hist.state, np.where(msg, slots, -1), mdur)
+
+    def _expire(self, now: float) -> None:
+        """Expired half-edges become virtual-node edges (`servicegraphs.go:390-421`)."""
+        it = self.registry.interner
+        expired_edges = []
+        while self._ttl and self._ttl[0][0] <= now:
+            _, key = self._ttl.popleft()
+            he = self._store.get(key)
+            if he is None:   # already matched
+                continue
+            if he.expire_at > now:
+                # key was reused by a newer half-edge; re-queue, don't evict
+                self._ttl.append((he.expire_at, key))
+                continue
+            del self._store[key]
+            self.expired += 1
+            if he.is_client:
+                # client → peer-derived virtual server node (db, queue, ...)
+                peer = it.lookup(he.peer_id) if he.peer_id != INVALID_ID else None
+                if peer:
+                    expired_edges.append((he.service_id, it.intern(peer),
+                                          "virtual_node", he.duration_s, 0.0,
+                                          he.failed, 0.0))
+            else:
+                # unmatched server with remote parent → synthetic "user" client
+                expired_edges.append((it.intern("user"), he.service_id,
+                                      "virtual_node", 0.0, he.duration_s,
+                                      he.failed, 0.0))
+        if expired_edges:
+            self._emit(expired_edges)

@@ -1,0 +1,29 @@
+"""Wire model and span tensors.
+
+The analog of the reference's `pkg/tempopb` (wire protos) + `pkg/model`
+(internal codecs), re-shaped for a dense-tensor machine: spans are staged into
+padded structure-of-arrays `SpanBatch`es with dictionary-coded strings so the
+per-span loops of the reference become batched device kernels.
+"""
+
+from tempo_tpu.model.interner import StringInterner
+from tempo_tpu.model.span_batch import (
+    KIND_CLIENT,
+    KIND_CONSUMER,
+    KIND_INTERNAL,
+    KIND_PRODUCER,
+    KIND_SERVER,
+    KIND_UNSPECIFIED,
+    STATUS_ERROR,
+    STATUS_OK,
+    STATUS_UNSET,
+    SpanBatch,
+    SpanBatchBuilder,
+)
+from tempo_tpu.model.otlp import (
+    otlp_json_to_batch,
+    otlp_proto_to_batch,
+    spans_from_otlp_json,
+)
+
+__all__ = [k for k in dir() if not k.startswith("_")]

@@ -1,0 +1,180 @@
+"""OTLP trace ingest: JSON and protobuf → SpanBatch.
+
+The receiver-side conversion the reference performs in its OTel receiver shim
+plus `ptrace→tempopb` marshal round-trip
+(`modules/distributor/receiver/shim.go:165`, `distributor.go:421-432`),
+collapsed into a single decode straight into span tensors. Handles the public
+OTLP wire schemas (opentelemetry-proto trace.proto v1 field numbers, and the
+OTLP/JSON camelCase mapping).
+"""
+
+from __future__ import annotations
+
+import binascii
+from typing import Any, Iterable
+
+from tempo_tpu.model import proto_wire as pw
+from tempo_tpu.model.span_batch import SpanBatch, SpanBatchBuilder
+
+_KIND_NAMES = {
+    "SPAN_KIND_UNSPECIFIED": 0, "SPAN_KIND_INTERNAL": 1, "SPAN_KIND_SERVER": 2,
+    "SPAN_KIND_CLIENT": 3, "SPAN_KIND_PRODUCER": 4, "SPAN_KIND_CONSUMER": 5,
+}
+_STATUS_NAMES = {"STATUS_CODE_UNSET": 0, "STATUS_CODE_OK": 1, "STATUS_CODE_ERROR": 2}
+
+
+# ---------------------------------------------------------------------------
+# OTLP/JSON
+# ---------------------------------------------------------------------------
+
+def _json_anyvalue(v: dict[str, Any]) -> Any:
+    if "stringValue" in v:
+        return v["stringValue"]
+    if "intValue" in v:
+        return int(v["intValue"])
+    if "doubleValue" in v:
+        return float(v["doubleValue"])
+    if "boolValue" in v:
+        return bool(v["boolValue"])
+    if "arrayValue" in v:
+        return [_json_anyvalue(x) for x in v["arrayValue"].get("values", [])]
+    if "kvlistValue" in v:
+        return {kv["key"]: _json_anyvalue(kv.get("value", {}))
+                for kv in v["kvlistValue"].get("values", [])}
+    if "bytesValue" in v:
+        return v["bytesValue"]
+    return None
+
+
+def _json_attrs(lst: Iterable[dict] | None) -> dict[str, Any]:
+    return {kv["key"]: _json_anyvalue(kv.get("value", {})) for kv in (lst or [])}
+
+
+def spans_from_otlp_json(payload: dict) -> Iterable[dict]:
+    """Yield flat span dicts from an OTLP/JSON ExportTraceServiceRequest."""
+    for rs in payload.get("resourceSpans", []):
+        res_attrs = _json_attrs(rs.get("resource", {}).get("attributes"))
+        service = str(res_attrs.get("service.name", ""))
+        for ss in rs.get("scopeSpans", rs.get("instrumentationLibrarySpans", [])):
+            for sp in ss.get("spans", []):
+                kind = sp.get("kind", 0)
+                if isinstance(kind, str):
+                    kind = _KIND_NAMES.get(kind, 0)
+                status = sp.get("status", {})
+                scode = status.get("code", 0)
+                if isinstance(scode, str):
+                    scode = _STATUS_NAMES.get(scode, 0)
+                yield {
+                    "trace_id": binascii.unhexlify(sp.get("traceId", "")),
+                    "span_id": binascii.unhexlify(sp.get("spanId", "")),
+                    "parent_span_id": binascii.unhexlify(sp.get("parentSpanId", "") or ""),
+                    "name": sp.get("name", ""),
+                    "service": service,
+                    "kind": int(kind),
+                    "status_code": int(scode),
+                    "status_message": status.get("message", ""),
+                    "start_unix_nano": int(sp.get("startTimeUnixNano", 0)),
+                    "end_unix_nano": int(sp.get("endTimeUnixNano", 0)),
+                    "attrs": _json_attrs(sp.get("attributes")),
+                    "res_attrs": res_attrs,
+                }
+
+
+def otlp_json_to_batch(payload: dict, builder: SpanBatchBuilder | None = None) -> SpanBatch:
+    b = builder or SpanBatchBuilder()
+    for span in spans_from_otlp_json(payload):
+        b.append(**span)
+    return b.build()
+
+
+# ---------------------------------------------------------------------------
+# OTLP/protobuf (field numbers from public opentelemetry-proto trace.proto)
+# ---------------------------------------------------------------------------
+
+def _pb_anyvalue(buf) -> Any:
+    for fnum, _, val in pw.iter_fields(bytes(buf)):
+        if fnum == 1:
+            return bytes(val).decode("utf-8", "replace")
+        if fnum == 2:
+            return bool(val)
+        if fnum == 3:
+            # int64 varint, two's complement
+            return val - (1 << 64) if val >= (1 << 63) else val
+        if fnum == 4:
+            return pw.f64(val)
+        if fnum == 5:  # ArrayValue{ repeated AnyValue values = 1 }
+            return [_pb_anyvalue(v) for f, _, v in pw.iter_fields(bytes(val)) if f == 1]
+        if fnum == 6:  # KeyValueList{ repeated KeyValue values = 1 }
+            return _pb_attrs([v for f, _, v in pw.iter_fields(bytes(val)) if f == 1])
+        if fnum == 7:
+            return bytes(val)
+    return None
+
+
+def _pb_attrs(kvs: Iterable) -> dict[str, Any]:
+    out = {}
+    for kv in kvs:
+        key, val = "", None
+        for fnum, _, v in pw.iter_fields(bytes(kv)):
+            if fnum == 1:
+                key = bytes(v).decode("utf-8", "replace")
+            elif fnum == 2:
+                val = _pb_anyvalue(v)
+        out[key] = val
+    return out
+
+
+def otlp_proto_to_batch(data: bytes, builder: SpanBatchBuilder | None = None) -> SpanBatch:
+    """Decode an OTLP protobuf ExportTraceServiceRequest into a SpanBatch."""
+    b = builder or SpanBatchBuilder()
+    for fnum, _, rs in pw.iter_fields(data):
+        if fnum != 1:  # ResourceSpans
+            continue
+        res_attrs: dict[str, Any] = {}
+        scope_bufs = []
+        for f2, _, v2 in pw.iter_fields(bytes(rs)):
+            if f2 == 1:  # Resource{ repeated KeyValue attributes = 1 }
+                res_attrs = _pb_attrs(
+                    [v for f, _, v in pw.iter_fields(bytes(v2)) if f == 1])
+            elif f2 == 2:  # ScopeSpans
+                scope_bufs.append(v2)
+        service = str(res_attrs.get("service.name", ""))
+        for sbuf in scope_bufs:
+            for f3, _, v3 in pw.iter_fields(bytes(sbuf)):
+                if f3 != 2:  # Span
+                    continue
+                span = {
+                    "trace_id": b"", "span_id": b"", "parent_span_id": b"",
+                    "name": "", "service": service, "kind": 0,
+                    "status_code": 0, "status_message": "",
+                    "start_unix_nano": 0, "end_unix_nano": 0,
+                    "attrs": {}, "res_attrs": res_attrs,
+                }
+                kvs = []
+                for f4, _, v4 in pw.iter_fields(bytes(v3)):
+                    if f4 == 1:
+                        span["trace_id"] = bytes(v4)
+                    elif f4 == 2:
+                        span["span_id"] = bytes(v4)
+                    elif f4 == 4:
+                        span["parent_span_id"] = bytes(v4)
+                    elif f4 == 5:
+                        span["name"] = bytes(v4).decode("utf-8", "replace")
+                    elif f4 == 6:
+                        span["kind"] = v4
+                    elif f4 == 7:
+                        span["start_unix_nano"] = v4
+                    elif f4 == 8:
+                        span["end_unix_nano"] = v4
+                    elif f4 == 9:
+                        kvs.append(v4)
+                    elif f4 == 15:  # Status{ message=2, code=3 }
+                        for f5, _, v5 in pw.iter_fields(bytes(v4)):
+                            if f5 == 2:
+                                span["status_message"] = bytes(v5).decode("utf-8", "replace")
+                            elif f5 == 3:
+                                span["status_code"] = v5
+                if kvs:
+                    span["attrs"] = _pb_attrs(kvs)
+                b.append(**span)
+    return b.build()

@@ -1,0 +1,44 @@
+"""TPU numeric plane: vectorized hashing and mergeable sketch kernels.
+
+These are the kernels that replace the reference's per-span scalar hot loops
+(span→series aggregation, latency histograms, quantile estimation) with batched
+XLA programs. Everything here is a pure function over arrays, jit-safe, with
+static shapes, and every sketch state is *mergeable* (add / max) so shards can
+be combined with `jax.lax.psum` / `pmax` across a device mesh.
+"""
+
+from tempo_tpu.ops.hashing import (
+    fnv1_32,
+    fnv1a_32,
+    fnv1a_64,
+    hash_columns32,
+    hash_columns_pair,
+    murmur_fmix32,
+    splitmix32,
+    token_for,
+)
+from tempo_tpu.ops.sketches import (
+    CountMinSketch,
+    HyperLogLog,
+    Log2Histogram,
+    DDSketch,
+    cms_estimate,
+    cms_init,
+    cms_merge,
+    cms_update,
+    dd_init,
+    dd_merge,
+    dd_quantile,
+    dd_update,
+    hll_estimate,
+    hll_init,
+    hll_merge,
+    hll_update,
+    log2_bucket,
+    log2_hist_init,
+    log2_hist_merge,
+    log2_hist_update,
+    log2_quantile,
+)
+
+__all__ = [k for k in dir() if not k.startswith("_")]

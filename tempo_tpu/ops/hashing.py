@@ -1,0 +1,132 @@
+"""Vectorized hashing: FNV (wire-compatible token routing) + mixers (device).
+
+The reference routes traces onto its consistent-hash ring with a 32-bit FNV-1
+hash over (tenant, traceID) bytes (`pkg/util/hash.go:8-16` `TokenFor`) and
+keys metric series with an FNV-1a hash over label strings
+(`modules/generator/registry/hash.go`). We keep those exact functions on the
+host side (numpy, vectorized over byte matrices) so sharding decisions are
+reproducible, and use cheap integer mixers (murmur3 fmix / splitmix) on device
+where only uniformity matters: series-key hashing, HyperLogLog, count-min rows.
+
+JAX note: all device hashing is 32-bit (uint32 pairs where 64 bits of hash are
+needed) so nothing here requires jax x64 mode.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+_FNV1_32_OFFSET = np.uint32(2166136261)
+_FNV1_32_PRIME = np.uint32(16777619)
+_FNV1_64_OFFSET = np.uint64(14695981039346656037)
+_FNV1_64_PRIME = np.uint64(1099511628211)
+
+
+def _as_byte_matrix(data) -> np.ndarray:
+    """Coerce input to a [n_rows, n_bytes] uint8 matrix."""
+    arr = np.asarray(data, dtype=np.uint8)
+    if arr.ndim == 1:
+        arr = arr[None, :]
+    return arr
+
+
+def fnv1_32(data) -> np.ndarray:
+    """FNV-1 32-bit (multiply, then xor — Go fnv.New32) over byte rows.
+
+    Vectorized across rows; sequential across the (small, fixed) byte width.
+    Matches the reference's ring token hash `pkg/util/hash.go:8`.
+    """
+    arr = _as_byte_matrix(data)
+    with np.errstate(over="ignore"):
+        h = np.full(arr.shape[0], _FNV1_32_OFFSET, dtype=np.uint32)
+        for i in range(arr.shape[1]):
+            h = (h * _FNV1_32_PRIME) ^ arr[:, i].astype(np.uint32)
+    return h
+
+
+def fnv1a_32(data) -> np.ndarray:
+    """FNV-1a 32-bit (xor, then multiply) over byte rows."""
+    arr = _as_byte_matrix(data)
+    with np.errstate(over="ignore"):
+        h = np.full(arr.shape[0], _FNV1_32_OFFSET, dtype=np.uint32)
+        for i in range(arr.shape[1]):
+            h = (h ^ arr[:, i].astype(np.uint32)) * _FNV1_32_PRIME
+    return h
+
+
+def fnv1a_64(data) -> np.ndarray:
+    """FNV-1a 64-bit over byte rows (series hashing analog, registry/hash.go)."""
+    arr = _as_byte_matrix(data)
+    with np.errstate(over="ignore"):
+        h = np.full(arr.shape[0], _FNV1_64_OFFSET, dtype=np.uint64)
+        for i in range(arr.shape[1]):
+            h = (h ^ arr[:, i].astype(np.uint64)) * _FNV1_64_PRIME
+    return h
+
+
+def token_for(tenant: str, trace_ids: np.ndarray) -> np.ndarray:
+    """Ring tokens for a batch of trace IDs: fnv1_32(tenant_bytes || trace_id).
+
+    `trace_ids` is [n, 16] uint8 (128-bit OTLP trace ids). Reference:
+    `pkg/util/hash.go:8-16` (`TokenFor`, `TokenForTraceID`).
+    """
+    tids = _as_byte_matrix(trace_ids)
+    tenant_b = np.frombuffer(tenant.encode("utf-8"), dtype=np.uint8)
+    with np.errstate(over="ignore"):
+        h = np.full(tids.shape[0], _FNV1_32_OFFSET, dtype=np.uint32)
+        for b in tenant_b:
+            h = (h * _FNV1_32_PRIME) ^ np.uint32(b)
+        for i in range(tids.shape[1]):
+            h = (h * _FNV1_32_PRIME) ^ tids[:, i].astype(np.uint32)
+    return h
+
+
+# ---------------------------------------------------------------------------
+# Device-side integer mixers (jnp, uint32)
+# ---------------------------------------------------------------------------
+
+def murmur_fmix32(h):
+    """Murmur3 32-bit finalizer. Full-avalanche mix of a uint32 lane."""
+    h = jnp.asarray(h, dtype=jnp.uint32)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def splitmix32(h):
+    """splitmix-style 32-bit mixer (distinct constants from fmix32)."""
+    h = jnp.asarray(h, dtype=jnp.uint32)
+    h = (h + jnp.uint32(0x9E3779B9))
+    h = (h ^ (h >> 16)) * jnp.uint32(0x21F0AAAD)
+    h = (h ^ (h >> 15)) * jnp.uint32(0x735A2D97)
+    h = h ^ (h >> 15)
+    return h
+
+
+def hash_columns32(cols, seed: int = 0):
+    """Hash a [n, k] int32/uint32 matrix row-wise to uint32.
+
+    This is the device-side analog of the reference's series-label hashing
+    (`modules/generator/registry/hash.go`): label *values* are already
+    dictionary-coded to int ids in a SpanBatch, so a row hash over the id
+    columns keys a series. Murmur-style combine per column, fmix finalizer.
+    """
+    cols = jnp.asarray(cols)
+    if cols.ndim == 1:
+        cols = cols[:, None]
+    h = jnp.full(cols.shape[:1], jnp.uint32(seed) ^ jnp.uint32(0x811C9DC5), dtype=jnp.uint32)
+    for i in range(cols.shape[1]):
+        k = murmur_fmix32(cols[:, i].astype(jnp.uint32) + jnp.uint32((i * 0x9E3779B9) & 0xFFFFFFFF))
+        h = (h ^ k) * jnp.uint32(0x01000193)
+    return murmur_fmix32(h)
+
+
+def hash_columns_pair(cols, seed: int = 0):
+    """Two independent uint32 row hashes (64 hash bits without x64 mode)."""
+    h1 = hash_columns32(cols, seed=seed)
+    h2 = hash_columns32(cols, seed=seed ^ 0x5BD1E995)
+    return h1, h2

@@ -1,0 +1,337 @@
+"""Mergeable sketches as batched XLA scatter kernels.
+
+This module replaces the reference's scalar per-span sketch loops with
+vectorized device programs:
+
+- `Log2Histogram`  — power-of-two latency histogram; semantics of the
+  reference's fixed 64-bucket `LatencyHistogram`
+  (`pkg/traceqlmetrics/metrics.go:17-98`: Record / Combine / Percentile with
+  exponential interpolation) and of the TraceQL metrics engine's log2
+  bucketing + interpolated quantile (`pkg/traceql/engine_metrics.go:1392-1468`
+  `Log2Bucketize` / `Log2Quantile`).
+- `DDSketch`       — relative-error quantile sketch (log-gamma buckets); the
+  "t-digest-style" bounded-error quantile plane. Error ≤ (γ-1)/(γ+1).
+- `HyperLogLog`    — distinct-count (e.g. span-name cardinality) with
+  scatter-max updates; merge = elementwise max (pmax across shards).
+- `CountMinSketch` — heavy-hitter frequency estimation; merge = add (psum).
+
+Every sketch is a registered-dataclass pytree (arrays are data, hyperparams
+like γ / precision / depth are static metadata); `*_update` functions are pure,
+jit-safe, static-shape, and take per-row `series_ids` so one kernel serves
+both a single sketch (S=1) and a whole registry of per-series sketches
+(state leading dim S). Padding rows are handled with a validity `mask`:
+masked rows scatter zero weight at index 0.
+
+Merging across devices: counts merge with `lax.psum`, HLL registers with
+`lax.pmax` — see tempo_tpu.parallel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tempo_tpu.ops.hashing import murmur_fmix32, splitmix32
+
+NUM_LOG2_BUCKETS = 64
+
+
+# ---------------------------------------------------------------------------
+# Log2 histogram (power-of-two buckets)
+# ---------------------------------------------------------------------------
+
+@partial(jax.tree_util.register_dataclass, data_fields=["counts"], meta_fields=[])
+@dataclasses.dataclass(frozen=True)
+class Log2Histogram:
+    """Per-series power-of-two histograms: counts[S, 64].
+
+    Bucket 0 holds exact zeros; bucket b>0 holds values in (2^(b-2), 2^(b-1)]
+    i.e. b = floor(log2(v)) + 1 clamped to 63 — the bit-length bucketing the
+    reference uses (`pkg/traceqlmetrics/metrics.go:36-44`).
+    """
+
+    counts: jax.Array  # [S, 64] float32 (float so psum/weighted counts work)
+
+
+def log2_bucket(values: jax.Array) -> jax.Array:
+    """Bit-length bucket of non-negative values: 0→0, v>0 → floor(log2 v)+1, ≤63."""
+    v = jnp.maximum(jnp.asarray(values), 0.0)
+    # floor(log2(v)) via frexp-free math; v in [2^(b-1), 2^b) → bucket b.
+    # The 1e-4 nudge absorbs float32 log2 rounding at exact power-of-two
+    # boundaries (2^62 must land in bucket 63, not 62).
+    b = jnp.floor(jnp.log2(jnp.maximum(v, 1e-30)) + 1e-4) + 1.0
+    b = jnp.where(v > 0, b, 0.0)
+    return jnp.clip(b, 0, NUM_LOG2_BUCKETS - 1).astype(jnp.int32)
+
+
+def log2_hist_init(num_series: int) -> Log2Histogram:
+    return Log2Histogram(counts=jnp.zeros((num_series, NUM_LOG2_BUCKETS), jnp.float32))
+
+
+def log2_hist_update(
+    state: Log2Histogram,
+    series_ids: jax.Array,
+    values: jax.Array,
+    mask: jax.Array | None = None,
+    weights: jax.Array | None = None,
+) -> Log2Histogram:
+    """Scatter a batch of observations into per-series histograms.
+
+    The whole reference hot loop `LatencyHistogram.Record` becomes one
+    scatter-add over flat indices sid*64+bucket.
+    """
+    sids = jnp.asarray(series_ids, jnp.int32)
+    w = jnp.ones_like(sids, dtype=jnp.float32) if weights is None else jnp.asarray(weights, jnp.float32)
+    if mask is not None:
+        w = jnp.where(mask, w, 0.0)
+        sids = jnp.where(mask, sids, 0)
+    buckets = log2_bucket(values)
+    flat = sids * NUM_LOG2_BUCKETS + buckets
+    counts = state.counts.reshape(-1).at[flat].add(w, mode="drop").reshape(state.counts.shape)
+    return Log2Histogram(counts=counts)
+
+
+def log2_hist_merge(a: Log2Histogram, b: Log2Histogram) -> Log2Histogram:
+    """Combine = elementwise add (`metrics.go:52-58` Combine)."""
+    return Log2Histogram(counts=a.counts + b.counts)
+
+
+def log2_quantile(state: Log2Histogram, q: float | jax.Array) -> jax.Array:
+    """Interpolated quantile per series, [S]. Matches the reference's
+    exponential interpolation (`metrics.go:60-98` Percentile,
+    `engine_metrics.go:1402-1468` Log2Quantile): position within the selected
+    bucket interpolates the exponent, i.e. value = 2^(b-1+frac).
+    """
+    counts = state.counts  # [S, B]
+    total = counts.sum(axis=-1)  # [S]
+    target = jnp.asarray(q, jnp.float32) * total  # [S]
+    cum = jnp.cumsum(counts, axis=-1)  # [S, B]
+    # First bucket where cumulative >= target.
+    b = jnp.argmax(cum >= target[..., None], axis=-1)  # [S]
+    take = jnp.take_along_axis
+    cum_before = jnp.where(b > 0, take(cum, jnp.maximum(b - 1, 0)[..., None], axis=-1)[..., 0], 0.0)
+    in_bucket = take(counts, b[..., None], axis=-1)[..., 0]
+    frac = jnp.where(in_bucket > 0, (target - cum_before) / jnp.maximum(in_bucket, 1e-30), 1.0)
+    # Bucket b>0 spans (2^(b-2), 2^(b-1)]: interpolate the exponent.
+    val = jnp.exp2(jnp.asarray(b, jnp.float32) - 2.0 + frac)
+    val = jnp.where(b == 0, 0.0, val)
+    return jnp.where(total > 0, val, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# DDSketch-style relative-error quantile sketch
+# ---------------------------------------------------------------------------
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=["counts", "zeros"], meta_fields=["gamma", "min_value"])
+@dataclasses.dataclass(frozen=True)
+class DDSketch:
+    """Per-series log-γ bucket histograms: counts[S, B], plus zero counts.
+
+    Bucket i (i ≥ 0) covers (γ^(i-1+off), γ^(i+off)]; quantile estimates use
+    the γ-midpoint 2γ^i/(γ+1), giving relative error ≤ (γ-1)/(γ+1). With the
+    default γ ≈ 1.0202 the guarantee is 1% — the BASELINE.json p99-error
+    budget. Mergeable by addition.
+    """
+
+    counts: jax.Array  # [S, B] float32
+    zeros: jax.Array   # [S]    float32
+    gamma: float       # static
+    min_value: float   # static: values below → bucket 0
+
+
+def dd_params(rel_err: float = 0.01, min_value: float = 1e-9, max_value: float = 1e12):
+    gamma = (1.0 + rel_err) / (1.0 - rel_err)
+    nbuckets = int(math.ceil(math.log(max_value / min_value) / math.log(gamma))) + 2
+    return gamma, nbuckets
+
+
+def dd_init(num_series: int, rel_err: float = 0.01, min_value: float = 1e-9,
+            max_value: float = 1e12) -> DDSketch:
+    gamma, nb = dd_params(rel_err, min_value, max_value)
+    return DDSketch(
+        counts=jnp.zeros((num_series, nb), jnp.float32),
+        zeros=jnp.zeros((num_series,), jnp.float32),
+        gamma=gamma,
+        min_value=min_value,
+    )
+
+
+def dd_update(state: DDSketch, series_ids: jax.Array, values: jax.Array,
+              mask: jax.Array | None = None,
+              weights: jax.Array | None = None) -> DDSketch:
+    sids = jnp.asarray(series_ids, jnp.int32)
+    v = jnp.asarray(values, jnp.float32)
+    w = jnp.ones_like(v) if weights is None else jnp.asarray(weights, jnp.float32)
+    if mask is not None:
+        w = jnp.where(mask, w, 0.0)
+        sids = jnp.where(mask, sids, 0)
+    nb = state.counts.shape[-1]
+    log_gamma = math.log(state.gamma)
+    is_zero = v <= state.min_value
+    idx = jnp.ceil(jnp.log(jnp.maximum(v, state.min_value) / state.min_value) / log_gamma)
+    idx = jnp.clip(idx, 0, nb - 1).astype(jnp.int32)
+    flat = sids * nb + idx
+    counts = state.counts.reshape(-1).at[flat].add(
+        jnp.where(is_zero, 0.0, w), mode="drop").reshape(state.counts.shape)
+    zeros = state.zeros.at[sids].add(jnp.where(is_zero, w, 0.0), mode="drop")
+    return dataclasses.replace(state, counts=counts, zeros=zeros)
+
+
+def dd_merge(a: DDSketch, b: DDSketch) -> DDSketch:
+    return dataclasses.replace(a, counts=a.counts + b.counts, zeros=a.zeros + b.zeros)
+
+
+def dd_quantile(state: DDSketch, q: float | jax.Array) -> jax.Array:
+    """γ-midpoint interpolated quantile per series, [S]."""
+    counts = state.counts
+    total = state.zeros + counts.sum(axis=-1)
+    target = jnp.asarray(q, jnp.float32) * total
+    # Zeros sort first.
+    hit_zero = state.zeros >= target
+    cum = state.zeros[..., None] + jnp.cumsum(counts, axis=-1)
+    b = jnp.argmax(cum >= target[..., None], axis=-1).astype(jnp.float32)
+    # Bucket i covers (min*γ^(i-1), min*γ^i]; midpoint estimate 2γ^i/(γ+1)·min·γ^(b-1)… use
+    # the standard DDSketch estimate: min_value * 2 γ^b / (γ + 1).
+    val = state.min_value * 2.0 * jnp.power(state.gamma, b) / (state.gamma + 1.0)
+    val = jnp.where(hit_zero, 0.0, val)
+    return jnp.where(total > 0, val, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# HyperLogLog
+# ---------------------------------------------------------------------------
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=["registers"], meta_fields=["precision"])
+@dataclasses.dataclass(frozen=True)
+class HyperLogLog:
+    """Per-series HLL registers[S, m], m = 2^p. int32 registers (VPU-friendly).
+
+    Distinct-count plane for cardinality estimation (e.g. distinct span names
+    per service — the BASELINE.json HLL config). Update = scatter-max; merge =
+    elementwise max, so cross-device merge is `lax.pmax`.
+    """
+
+    registers: jax.Array  # [S, m] int32
+    precision: int        # static p, m = 2^p
+
+
+def hll_init(num_series: int, precision: int = 14) -> HyperLogLog:
+    m = 1 << precision
+    return HyperLogLog(registers=jnp.zeros((num_series, m), jnp.int32), precision=precision)
+
+
+def hll_update(state: HyperLogLog, series_ids: jax.Array, h1: jax.Array,
+               h2: jax.Array, mask: jax.Array | None = None) -> HyperLogLog:
+    """Insert pre-hashed items (two independent uint32 hashes per item).
+
+    h1 picks the register (top p bits); rho = clz(h2)+1 (≤ 33) supplies the
+    leading-zero pattern, as in standard 64-bit-split HLL implementations.
+    """
+    p = state.precision
+    m = 1 << p
+    sids = jnp.asarray(series_ids, jnp.int32)
+    idx = (jnp.asarray(h1, jnp.uint32) >> jnp.uint32(32 - p)).astype(jnp.int32)
+    rho = (lax.clz(jnp.asarray(h2, jnp.uint32).astype(jnp.int32)) + 1).astype(jnp.int32)
+    if mask is not None:
+        rho = jnp.where(mask, rho, 0)
+        sids = jnp.where(mask, sids, 0)
+        idx = jnp.where(mask, idx, 0)
+    flat = sids * m + idx
+    regs = state.registers.reshape(-1).at[flat].max(rho, mode="drop").reshape(state.registers.shape)
+    return dataclasses.replace(state, registers=regs)
+
+
+def hll_merge(a: HyperLogLog, b: HyperLogLog) -> HyperLogLog:
+    return dataclasses.replace(a, registers=jnp.maximum(a.registers, b.registers))
+
+
+def hll_estimate(state: HyperLogLog) -> jax.Array:
+    """Bias-corrected cardinality estimate per series, [S] float32.
+
+    Standard Flajolet alpha_m raw estimate with linear-counting correction in
+    the small range (E ≤ 2.5m with empty registers).
+    """
+    p = state.precision
+    m = float(1 << p)
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    regs = state.registers.astype(jnp.float32)  # [S, m]
+    raw = alpha * m * m / jnp.sum(jnp.exp2(-regs), axis=-1)
+    zeros = jnp.sum(regs == 0, axis=-1).astype(jnp.float32)
+    linear = m * jnp.log(m / jnp.maximum(zeros, 1e-30))
+    use_linear = (raw <= 2.5 * m) & (zeros > 0)
+    return jnp.where(use_linear, linear, raw)
+
+
+# ---------------------------------------------------------------------------
+# Count-min sketch
+# ---------------------------------------------------------------------------
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=["table"], meta_fields=["depth", "width"])
+@dataclasses.dataclass(frozen=True)
+class CountMinSketch:
+    """Per-series count-min tables[S, d, w]; heavy-hitter frequency plane.
+
+    Kirsch-Mitzenmacher double hashing: row i uses (h1 + i·h2) & (w-1).
+    Merge = add (psum across shards).
+    """
+
+    table: jax.Array  # [S, d, w] float32
+    depth: int        # static
+    width: int        # static, power of two
+
+
+def cms_init(num_series: int, depth: int = 4, width: int = 2048) -> CountMinSketch:
+    assert width & (width - 1) == 0, "width must be a power of two"
+    return CountMinSketch(table=jnp.zeros((num_series, depth, width), jnp.float32),
+                          depth=depth, width=width)
+
+
+def _cms_cols(state: CountMinSketch, h1: jax.Array, h2: jax.Array) -> jax.Array:
+    """[n, d] column indices from two uint32 hashes."""
+    h1 = jnp.asarray(h1, jnp.uint32)[:, None]
+    h2 = jnp.asarray(h2, jnp.uint32)[:, None]
+    i = jnp.arange(state.depth, dtype=jnp.uint32)[None, :]
+    return ((h1 + i * h2) & jnp.uint32(state.width - 1)).astype(jnp.int32)
+
+
+def cms_update(state: CountMinSketch, series_ids: jax.Array, h1: jax.Array,
+               h2: jax.Array, counts: jax.Array | None = None,
+               mask: jax.Array | None = None) -> CountMinSketch:
+    sids = jnp.asarray(series_ids, jnp.int32)
+    n = sids.shape[0]
+    w = jnp.ones((n,), jnp.float32) if counts is None else jnp.asarray(counts, jnp.float32)
+    if mask is not None:
+        w = jnp.where(mask, w, 0.0)
+        sids = jnp.where(mask, sids, 0)
+    cols = _cms_cols(state, h1, h2)  # [n, d]
+    d, width = state.depth, state.width
+    rows = jnp.arange(d, dtype=jnp.int32)[None, :]  # [1, d]
+    flat = (sids[:, None] * d + rows) * width + cols  # [n, d]
+    table = state.table.reshape(-1).at[flat.reshape(-1)].add(
+        jnp.broadcast_to(w[:, None], (n, d)).reshape(-1), mode="drop"
+    ).reshape(state.table.shape)
+    return dataclasses.replace(state, table=table)
+
+
+def cms_merge(a: CountMinSketch, b: CountMinSketch) -> CountMinSketch:
+    return dataclasses.replace(a, table=a.table + b.table)
+
+
+def cms_estimate(state: CountMinSketch, series_ids: jax.Array, h1: jax.Array,
+                 h2: jax.Array) -> jax.Array:
+    """Point frequency estimates, [n] float32 (min over depth rows)."""
+    sids = jnp.asarray(series_ids, jnp.int32)
+    cols = _cms_cols(state, h1, h2)  # [n, d]
+    d, width = state.depth, state.width
+    rows = jnp.arange(d, dtype=jnp.int32)[None, :]
+    flat = (sids[:, None] * d + rows) * width + cols
+    vals = state.table.reshape(-1)[flat]  # [n, d]
+    return vals.min(axis=-1)

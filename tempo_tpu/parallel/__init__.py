@@ -1,0 +1,17 @@
+"""Device-mesh parallelism: sharded sketch/registry pipelines.
+
+The TPU-native replacement for the reference's scale-out constructs
+(SURVEY.md §2.6): data parallelism over span batches replaces the
+distributor's ring fan-out; series-dimension sharding replaces per-instance
+registry partitioning; collective merges (psum for counts, pmax for HLL
+registers) replace the frontend's combiner tree over gRPC.
+"""
+
+from tempo_tpu.parallel.mesh import (
+    make_mesh,
+    merge_sketch_states,
+    sharded_spanmetrics_step,
+    shard_batch_arrays,
+)
+
+__all__ = [k for k in dir() if not k.startswith("_")]

@@ -1,0 +1,108 @@
+"""Mesh construction and the sharded aggregation step.
+
+Parallelism axes (the analog of the reference's strategies, SURVEY.md §2.6):
+
+- `data`: span batches are split across devices — the ring-of-ingesters /
+  shuffle-shard fan-out (`distributor.go:511-547`) becomes a sharded array
+  dimension. Registry updates happen on local shards; the quorum-merge
+  becomes a `psum` over this axis.
+- `series`: metric series slots are sharded — the per-instance registry
+  partitioning becomes a sharded state dimension. Each device owns
+  max_active_series / series_shards slots; a slot's owner is slot//shard_cap,
+  so updates need no all-to-all (mirroring how the reference routes series to
+  exactly one generator instance via the partition ring).
+
+The canonical step below (spanmetrics fused update under shard_map) is what
+`__graft_entry__.dryrun_multichip` compiles across an N-device mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from tempo_tpu.ops import sketches
+from tempo_tpu.registry import metrics as rm
+
+
+def make_mesh(n_devices: int | None = None, series_shards: int = 1) -> Mesh:
+    """2D mesh ('data', 'series'). series_shards must divide device count."""
+    devs = jax.devices()
+    n = len(devs) if n_devices is None else n_devices
+    devs = np.array(devs[:n])
+    assert n % series_shards == 0, (n, series_shards)
+    return Mesh(devs.reshape(n // series_shards, series_shards), ("data", "series"))
+
+
+def shard_batch_arrays(mesh: Mesh, arrays: dict) -> dict:
+    """Place host batch columns with leading dim sharded over 'data'."""
+    sh = NamedSharding(mesh, P("data"))
+    return {k: jax.device_put(v, sh) for k, v in arrays.items()}
+
+
+def merge_sketch_states(state, axis_name: str = "data"):
+    """Collective merge of sketch/registry pytrees inside shard_map/pjit:
+    HLL registers merge with pmax, everything else (counts/sums) with psum."""
+
+    def merge(path, leaf):
+        if any(getattr(p, "name", "") == "registers" for p in path):
+            return jax.lax.pmax(leaf, axis_name)
+        return jax.lax.psum(leaf, axis_name)
+
+    return jax.tree_util.tree_map_with_path(merge, state)
+
+
+def sharded_spanmetrics_step(mesh: Mesh, edges: tuple, gamma: float,
+                             min_value: float):
+    """Build the jitted multi-device spanmetrics step over `mesh`.
+
+    Layout: span columns sharded over 'data' (replicated over 'series');
+    registry state arrays sharded over 'series' on their slot dim and
+    replicated over 'data'. Each device updates only the slots it owns; a
+    psum over 'data' yields the global state — the collective that replaces
+    the reference's frontend combiner tree.
+
+    Takes/returns raw arrays (static hyperparams via closure) so the
+    shard_map in/out specs are flat.
+    """
+
+    def step(calls_v, h_buckets, h_sums, h_counts, size_v, dd_counts,
+             dd_zeros, slots, dur_s, sizes, weights):
+        shard_cap = calls_v.shape[0]  # local slot count
+        my_shard = jax.lax.axis_index("series")
+        owner = jnp.where(slots >= 0, slots // shard_cap, -1)
+        local = jnp.where(owner == my_shard, slots - my_shard * shard_cap, -1)
+
+        # Updates start from ZERO states so only the delta is psum'd over
+        # 'data' (the base state is replicated across data shards; summing it
+        # would multiply prior state by the data-shard count every step).
+        z = jnp.zeros_like
+        calls_d = rm.counter_update(rm.CounterState(z(calls_v)), local, weights)
+        hist_d = rm.histogram_update(
+            rm.HistogramState(z(h_buckets), z(h_sums), z(h_counts), edges),
+            local, dur_s, weights)
+        size_d = rm.counter_update(rm.CounterState(z(size_v)), local,
+                                   sizes * weights)
+        keep = local >= 0
+        dd_d = sketches.dd_update(
+            sketches.DDSketch(z(dd_counts), z(dd_zeros), gamma, min_value),
+            jnp.where(keep, local, 0), dur_s, mask=keep, weights=weights)
+        deltas = (calls_d.values, hist_d.bucket_counts, hist_d.sums,
+                  hist_d.counts, size_d.values, dd_d.counts, dd_d.zeros)
+        base = (calls_v, h_buckets, h_sums, h_counts, size_v, dd_counts, dd_zeros)
+        return tuple(b + jax.lax.psum(d, "data") for b, d in zip(base, deltas))
+
+    state_specs = (P("series"), P("series", None), P("series"), P("series"),
+                   P("series"), P("series", None), P("series"))
+    batch_specs = (P("data"),) * 4
+    fn = _shard_map(step, mesh=mesh,
+                    in_specs=state_specs + batch_specs,
+                    out_specs=state_specs)
+    return jax.jit(fn)

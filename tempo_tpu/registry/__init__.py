@@ -1,0 +1,43 @@
+"""Metric series registry with device-resident aggregation state.
+
+The analog of the reference's `ManagedRegistry`
+(`modules/generator/registry/registry.go:58-136`): per-tenant metric series
+(counters, gauges, classic histograms, native/exponential histograms) with
+active-series limits, staleness eviction, and a collection tick that turns
+device state into Prometheus samples.
+
+Split of responsibilities on a TPU machine:
+
+- host (`series.py`): label-string interning, label-combo → dense slot-id
+  tables (the `LabelValueCombo`/series-hash role of `registry/hash.go`),
+  last-seen bookkeeping, staleness purge.
+- device (`metrics.py`): one array row per series slot; batched updates are
+  scatter-add/set kernels; collection is a single device→host gather.
+"""
+
+from tempo_tpu.registry.series import Exemplar, Sample, SeriesBudget, SeriesTable
+from tempo_tpu.registry.metrics import (
+    CounterState,
+    GaugeState,
+    HistogramState,
+    NativeHistogramState,
+    counter_init,
+    counter_update,
+    gauge_init,
+    gauge_set,
+    histogram_init,
+    histogram_update,
+    native_histogram_init,
+    native_histogram_update,
+    zero_slots,
+)
+from tempo_tpu.registry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    ManagedRegistry,
+    NativeHistogram,
+    RegistryOverrides,
+)
+
+__all__ = [k for k in dir() if not k.startswith("_")]

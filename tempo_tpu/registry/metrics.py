@@ -1,0 +1,188 @@
+"""Device metric states + pure batched update kernels.
+
+One array row per series slot. These pure functions are the composable
+device half of each metric type in the reference registry
+(`modules/generator/registry/{counter,gauge,histogram,native_histogram}.go`);
+processors fuse several of them into a single jitted step per span batch
+(see tempo_tpu.generator.processors.spanmetrics).
+
+All updates accept slot ids with -1 = "discard" (series-limited or padding).
+JAX wraps negative indices, so discards are redirected to an index >= capacity,
+which IS out of bounds, and scattered with `mode="drop"` — no host-side
+filtering needed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from tempo_tpu.ops import sketches
+
+
+def _mask_slots(slots: jax.Array, mask: jax.Array | None, capacity: int) -> jax.Array:
+    """Slot ids with discards redirected OOB (>= capacity) so scatters drop them."""
+    s = jnp.asarray(slots, jnp.int32)
+    if mask is not None:
+        s = jnp.where(mask, s, -1)
+    return jnp.where(s < 0, capacity, s)
+
+
+# -- counter -----------------------------------------------------------------
+
+@partial(jax.tree_util.register_dataclass, data_fields=["values"], meta_fields=[])
+@dataclasses.dataclass(frozen=True)
+class CounterState:
+    values: jax.Array  # [S] f32
+
+
+def counter_init(capacity: int) -> CounterState:
+    return CounterState(values=jnp.zeros((capacity,), jnp.float32))
+
+
+def counter_update(state: CounterState, slots: jax.Array,
+                   weights: jax.Array | None = None,
+                   mask: jax.Array | None = None) -> CounterState:
+    s = _mask_slots(slots, mask, state.values.shape[0])
+    w = jnp.ones(s.shape, jnp.float32) if weights is None else jnp.asarray(weights, jnp.float32)
+    return CounterState(values=state.values.at[s].add(w, mode="drop"))
+
+
+# -- gauge -------------------------------------------------------------------
+
+@partial(jax.tree_util.register_dataclass, data_fields=["values"], meta_fields=[])
+@dataclasses.dataclass(frozen=True)
+class GaugeState:
+    values: jax.Array  # [S] f32
+
+
+def gauge_init(capacity: int) -> GaugeState:
+    return GaugeState(values=jnp.zeros((capacity,), jnp.float32))
+
+
+def gauge_set(state: GaugeState, slots: jax.Array, values: jax.Array,
+              mask: jax.Array | None = None) -> GaugeState:
+    """Set semantics; the host stages at most one row per slot per batch
+    (last-wins resolved during staging, since scatter order is unspecified)."""
+    s = _mask_slots(slots, mask, state.values.shape[0])
+    v = jnp.asarray(values, jnp.float32)
+    return GaugeState(values=state.values.at[s].set(v, mode="drop"))
+
+
+def gauge_add(state: GaugeState, slots: jax.Array, values: jax.Array,
+              mask: jax.Array | None = None) -> GaugeState:
+    s = _mask_slots(slots, mask, state.values.shape[0])
+    v = jnp.asarray(values, jnp.float32)
+    return GaugeState(values=state.values.at[s].add(v, mode="drop"))
+
+
+# -- classic histogram -------------------------------------------------------
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=["bucket_counts", "sums", "counts"], meta_fields=["edges"])
+@dataclasses.dataclass(frozen=True)
+class HistogramState:
+    """Prometheus classic histogram rows (`registry/histogram.go:107-189`):
+    cumulative `le` buckets are produced at collect; device keeps per-bucket
+    increments. edges are the static upper bounds (seconds), +Inf implicit.
+    """
+
+    bucket_counts: jax.Array  # [S, B+1] f32 (last = +Inf overflow)
+    sums: jax.Array           # [S] f32
+    counts: jax.Array         # [S] f32
+    edges: tuple              # static tuple[float, ...]
+
+
+def histogram_init(capacity: int, edges: tuple[float, ...]) -> HistogramState:
+    nb = len(edges) + 1
+    return HistogramState(
+        bucket_counts=jnp.zeros((capacity, nb), jnp.float32),
+        sums=jnp.zeros((capacity,), jnp.float32),
+        counts=jnp.zeros((capacity,), jnp.float32),
+        edges=tuple(edges),
+    )
+
+
+def histogram_update(state: HistogramState, slots: jax.Array, values: jax.Array,
+                     weights: jax.Array | None = None,
+                     mask: jax.Array | None = None) -> HistogramState:
+    cap = state.sums.shape[0]
+    s = _mask_slots(slots, mask, cap)
+    v = jnp.asarray(values, jnp.float32)
+    w = jnp.ones(s.shape, jnp.float32) if weights is None else jnp.asarray(weights, jnp.float32)
+    edges = jnp.asarray(state.edges, jnp.float32)  # [B]
+    b = jnp.sum(v[:, None] > edges[None, :], axis=1).astype(jnp.int32)  # le-inclusive
+    nb = len(state.edges) + 1
+    flat = jnp.where(s < cap, s * nb + b, cap * nb)  # OOB for discards
+    return dataclasses.replace(
+        state,
+        bucket_counts=state.bucket_counts.reshape(-1).at[flat].add(
+            w, mode="drop").reshape(state.bucket_counts.shape),
+        sums=state.sums.at[s].add(v * w, mode="drop"),
+        counts=state.counts.at[s].add(w, mode="drop"),
+    )
+
+
+# -- native (exponential) histogram -----------------------------------------
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=["hist", "sums", "counts", "zeros"], meta_fields=[])
+@dataclasses.dataclass(frozen=True)
+class NativeHistogramState:
+    """Exponential-bucket histogram (`registry/native_histogram.go:85,195`).
+
+    Device representation is the log2 sketch (= Prometheus native histogram
+    schema 0: one bucket per power of two), plus sum/count/zero-count — enough
+    to emit remote-write `Histogram` protos losslessly at that schema.
+    """
+
+    hist: sketches.Log2Histogram  # [S, 64]
+    sums: jax.Array               # [S]
+    counts: jax.Array             # [S]
+    zeros: jax.Array              # [S]
+
+
+def native_histogram_init(capacity: int) -> NativeHistogramState:
+    return NativeHistogramState(
+        hist=sketches.log2_hist_init(capacity),
+        sums=jnp.zeros((capacity,), jnp.float32),
+        counts=jnp.zeros((capacity,), jnp.float32),
+        zeros=jnp.zeros((capacity,), jnp.float32),
+    )
+
+
+def native_histogram_update(state: NativeHistogramState, slots: jax.Array,
+                            values: jax.Array,
+                            weights: jax.Array | None = None,
+                            mask: jax.Array | None = None) -> NativeHistogramState:
+    cap = state.sums.shape[0]
+    s = _mask_slots(slots, mask, cap)
+    keep = s < cap
+    v = jnp.asarray(values, jnp.float32)
+    w = jnp.ones(s.shape, jnp.float32) if weights is None else jnp.asarray(weights, jnp.float32)
+    return NativeHistogramState(
+        hist=sketches.log2_hist_update(
+            state.hist, jnp.where(keep, s, 0), v,
+            mask=keep, weights=w),
+        sums=state.sums.at[s].add(v * w, mode="drop"),
+        counts=state.counts.at[s].add(w, mode="drop"),
+        zeros=state.zeros.at[s].add(jnp.where(v == 0, w, 0.0), mode="drop"),
+    )
+
+
+# -- eviction ----------------------------------------------------------------
+
+def zero_slots(state, slots: jax.Array):
+    """Zero the device rows of evicted slots (any metric state pytree)."""
+    s = jnp.asarray(slots, jnp.int32)
+
+    def z(arr):
+        if arr.ndim == 1:
+            return arr.at[s].set(0.0, mode="drop")
+        flat = arr.reshape(arr.shape[0], -1)
+        return flat.at[s, :].set(0.0, mode="drop").reshape(arr.shape)
+
+    return jax.tree.map(z, state)

@@ -1,0 +1,342 @@
+"""ManagedRegistry: per-tenant metric families over device state.
+
+Reference behavior being reproduced (`modules/generator/registry/registry.go`):
+
+- `NewCounter/NewGauge/NewHistogram/NewNativeHistogram` → metric families
+  sharing one per-tenant active-series budget (`max_active_series`,
+  `registry.go:184-197`).
+- `CollectMetrics` tick (`registry.go:206-256`): walk active series, append
+  samples at a synchronized timestamp; histograms expand to cumulative
+  `_bucket`/`_sum`/`_count`; exemplars carry trace ids.
+- stale-series purge (`registry.go:258-277`): series idle > staleness window
+  are dropped, device rows zeroed, staleness markers (NaN) appended once.
+- extra const labels and per-tenant external labels merged into every series.
+
+Device work is batched: each metric family stages (slots, values) on host and
+runs one scatter kernel; `collect` gathers each family's arrays once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Callable, Iterable, Sequence
+
+import jax
+import numpy as np
+
+from tempo_tpu.model.interner import StringInterner
+from tempo_tpu.registry import metrics as m
+from tempo_tpu.registry.series import Exemplar, Sample, SeriesBudget, SeriesTable
+
+STALE_NAN = float("nan")
+
+DEFAULT_HISTOGRAM_EDGES = (0.002, 0.004, 0.008, 0.016, 0.032, 0.064, 0.128,
+                           0.256, 0.512, 1.024, 2.048, 4.096, 8.192, 16.384)
+
+
+@dataclasses.dataclass
+class RegistryOverrides:
+    """Per-tenant knobs (subset of `modules/overrides/config.go:71-200`)."""
+
+    max_active_series: int = 65536
+    collection_interval_s: float = 15.0
+    stale_duration_s: float = 900.0
+    external_labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    disable_collection: bool = False
+
+
+class _MetricBase:
+    def __init__(self, registry: "ManagedRegistry", name: str,
+                 label_names: Sequence[str], capacity: int):
+        self.registry = registry
+        self.name = name
+        self.label_names = tuple(label_names)
+        self.table = SeriesTable(capacity, len(self.label_names),
+                                 budget=registry.budget)
+        self.exemplars: dict[int, Exemplar] = {}  # slot -> last exemplar
+        self._stale_pending: list[tuple[tuple[tuple[str, str], ...], float]] = []
+
+    # -- staging helpers ---------------------------------------------------
+
+    def resolve_slots(self, label_rows: np.ndarray,
+                      valid: np.ndarray | None = None) -> np.ndarray:
+        """[n, L] interned label-value rows → [n] slots (-1 = discarded)."""
+        return self.table.lookup_or_create(label_rows, self.registry.now(), valid=valid)
+
+    def labels_of(self, slot: int) -> tuple[tuple[str, str], ...]:
+        it = self.registry.interner
+        vals = it.lookup_many(self.table.slot_keys[slot])
+        pairs = dict(zip(self.label_names, vals))
+        pairs.update(self.registry.overrides.external_labels)
+        pairs["__name__"] = self.name
+        return tuple(sorted(pairs.items()))
+
+    def note_exemplars(self, slots: np.ndarray, trace_ids: np.ndarray,
+                       values: np.ndarray, ts_ms: int, max_new: int = 100) -> None:
+        """Record up to max_new last-seen exemplars (budget per push, like
+        the engine's exemplar budgeting `engine_metrics.go:1070`)."""
+        ok = np.flatnonzero(slots >= 0)[:max_new]
+        for i in ok.tolist():
+            tid = trace_ids[i].tobytes().hex()
+            self.exemplars[int(slots[i])] = Exemplar(tid, float(values[i]), ts_ms)
+
+    def note_stale(self, slots: np.ndarray) -> None:
+        """Capture label sets before slot_keys are wiped (markers emitted on
+        the next collect) and forget exemplars for evicted slots."""
+        for slot in slots.tolist():
+            self._stale_pending.append((self.labels_of(slot), self.registry.now()))
+            self.exemplars.pop(slot, None)
+
+    def _drain_stale_markers(self, ts_ms: int) -> list[Sample]:
+        out = [Sample(self.name, labels, STALE_NAN, ts_ms, is_stale_marker=True)
+               for labels, _ in self._stale_pending]
+        self._stale_pending = []
+        return out
+
+
+class Counter(_MetricBase):
+    def __init__(self, registry, name, label_names, capacity):
+        super().__init__(registry, name, label_names, capacity)
+        self.state = m.counter_init(capacity)
+
+    def inc_batch(self, label_rows: np.ndarray, weights: np.ndarray | None = None,
+                  valid: np.ndarray | None = None) -> np.ndarray:
+        slots = self.resolve_slots(label_rows, valid)
+        self.state = m.counter_update(self.state, slots, weights, None)
+        return slots
+
+    def inc(self, label_values: Sequence[str], value: float = 1.0) -> None:
+        row = self.registry.interner.intern_many(label_values)[None, :]
+        self.inc_batch(row, np.array([value], np.float32))
+
+    def collect(self, ts_ms: int) -> list[Sample]:
+        vals = np.asarray(self.state.values)
+        out = [Sample(self.name, self.labels_of(s), float(vals[s]), ts_ms,
+                      exemplar=self.exemplars.get(s))
+               for s in self.table.active_slots().tolist()]
+        return out + self._drain_stale_markers(ts_ms)
+
+
+class Gauge(_MetricBase):
+    def __init__(self, registry, name, label_names, capacity):
+        super().__init__(registry, name, label_names, capacity)
+        self.state = m.gauge_init(capacity)
+
+    def set_batch(self, label_rows: np.ndarray, values: np.ndarray,
+                  valid: np.ndarray | None = None) -> None:
+        slots = self.resolve_slots(label_rows, valid)
+        # last-wins per slot, resolved on host (scatter order is unspecified)
+        order = np.arange(slots.shape[0])
+        keep = {}
+        for i in order.tolist():
+            if slots[i] >= 0:
+                keep[int(slots[i])] = i
+        if not keep:
+            return
+        idx = np.fromiter(keep.values(), int)
+        self.state = m.gauge_set(self.state, slots[idx], values[idx], None)
+
+    def set(self, label_values: Sequence[str], value: float) -> None:
+        row = self.registry.interner.intern_many(label_values)[None, :]
+        self.set_batch(row, np.array([value], np.float32))
+
+    def collect(self, ts_ms: int) -> list[Sample]:
+        vals = np.asarray(self.state.values)
+        out = [Sample(self.name, self.labels_of(s), float(vals[s]), ts_ms)
+               for s in self.table.active_slots().tolist()]
+        return out + self._drain_stale_markers(ts_ms)
+
+
+class Histogram(_MetricBase):
+    """Classic histogram family → `_count`/`_sum`/`_bucket{le=...}` series."""
+
+    def __init__(self, registry, name, label_names, capacity,
+                 edges: tuple[float, ...] = DEFAULT_HISTOGRAM_EDGES):
+        super().__init__(registry, name, label_names, capacity)
+        self.state = m.histogram_init(capacity, edges)
+
+    def observe_batch(self, label_rows: np.ndarray, values: np.ndarray,
+                      weights: np.ndarray | None = None,
+                      valid: np.ndarray | None = None) -> np.ndarray:
+        slots = self.resolve_slots(label_rows, valid)
+        self.state = m.histogram_update(self.state, slots, values, weights, None)
+        return slots
+
+    def observe(self, label_values: Sequence[str], value: float) -> None:
+        row = self.registry.interner.intern_many(label_values)[None, :]
+        self.observe_batch(row, np.array([value], np.float32))
+
+    def collect(self, ts_ms: int) -> list[Sample]:
+        bc = np.asarray(self.state.bucket_counts)
+        sums = np.asarray(self.state.sums)
+        counts = np.asarray(self.state.counts)
+        out: list[Sample] = []
+        edges = self.state.edges
+        for s in self.table.active_slots().tolist():
+            base = self.labels_of(s)
+            ex = self.exemplars.get(s)
+            cum = np.cumsum(bc[s])
+            out.append(Sample(self.name + "_count", base, float(counts[s]), ts_ms))
+            out.append(Sample(self.name + "_sum", base, float(sums[s]), ts_ms))
+            for i, e in enumerate(edges):
+                le = (("le", _fmt_le(e)),)
+                out.append(Sample(self.name + "_bucket", base + le, float(cum[i]),
+                                  ts_ms, exemplar=ex if ex and ex.value <= e else None))
+            out.append(Sample(self.name + "_bucket", base + (("le", "+Inf"),),
+                              float(cum[-1]), ts_ms, exemplar=ex))
+        return out + self._drain_stale_markers(ts_ms)
+
+
+class NativeHistogram(_MetricBase):
+    """Exponential histogram family (remote-write native histogram payloads)."""
+
+    def __init__(self, registry, name, label_names, capacity):
+        super().__init__(registry, name, label_names, capacity)
+        self.state = m.native_histogram_init(capacity)
+
+    def observe_batch(self, label_rows: np.ndarray, values: np.ndarray,
+                      weights: np.ndarray | None = None,
+                      valid: np.ndarray | None = None) -> np.ndarray:
+        slots = self.resolve_slots(label_rows, valid)
+        self.state = m.native_histogram_update(self.state, slots, values, weights, None)
+        return slots
+
+    def collect(self, ts_ms: int) -> list[Sample]:
+        # Scalar samples for visibility; the remote-write encoder additionally
+        # reads `native_payload()` for real native-histogram protos.
+        sums = np.asarray(self.state.sums)
+        counts = np.asarray(self.state.counts)
+        out = []
+        for s in self.table.active_slots().tolist():
+            base = self.labels_of(s)
+            out.append(Sample(self.name + "_count", base, float(counts[s]), ts_ms))
+            out.append(Sample(self.name + "_sum", base, float(sums[s]), ts_ms))
+        return out + self._drain_stale_markers(ts_ms)
+
+    def native_payload(self):
+        """(slots, labels, log2 counts, sums, counts, zeros) for remote write."""
+        slots = self.table.active_slots()
+        return (slots, [self.labels_of(s) for s in slots.tolist()],
+                np.asarray(self.state.hist.counts)[slots],
+                np.asarray(self.state.sums)[slots],
+                np.asarray(self.state.counts)[slots],
+                np.asarray(self.state.zeros)[slots])
+
+
+def _fmt_le(e: float) -> str:
+    return repr(round(e, 9)) if e != int(e) else str(int(e))
+
+
+class ManagedRegistry:
+    """Per-tenant registry: metric families + limits + collection."""
+
+    def __init__(self, tenant: str = "single-tenant",
+                 overrides: RegistryOverrides | None = None,
+                 interner: StringInterner | None = None,
+                 now: Callable[[], float] = time.time):
+        self.tenant = tenant
+        self.overrides = overrides or RegistryOverrides()
+        self.interner = interner if interner is not None else StringInterner()
+        self.now = now
+        self.budget = SeriesBudget(self.overrides.max_active_series)
+        self._metrics: dict[str, _MetricBase] = {}
+
+    # -- family constructors ----------------------------------------------
+
+    def _capacity_share(self) -> int:
+        # Every family's table has full capacity; the cross-family total of
+        # allocated label combos is enforced by the shared `budget` that all
+        # SeriesTables consult on allocation (registry.go:184-197 analog).
+        return self.overrides.max_active_series
+
+    def new_counter(self, name: str, label_names: Sequence[str]) -> Counter:
+        c = Counter(self, name, label_names, self._capacity_share())
+        self._metrics[name] = c
+        return c
+
+    def new_gauge(self, name: str, label_names: Sequence[str]) -> Gauge:
+        g = Gauge(self, name, label_names, self._capacity_share())
+        self._metrics[name] = g
+        return g
+
+    def new_histogram(self, name: str, label_names: Sequence[str],
+                      edges: tuple[float, ...] = DEFAULT_HISTOGRAM_EDGES) -> Histogram:
+        h = Histogram(self, name, label_names, self._capacity_share(), edges)
+        self._metrics[name] = h
+        return h
+
+    def new_native_histogram(self, name: str, label_names: Sequence[str]) -> NativeHistogram:
+        h = NativeHistogram(self, name, label_names, self._capacity_share())
+        self._metrics[name] = h
+        return h
+
+    # -- bookkeeping -------------------------------------------------------
+
+    @property
+    def active_series(self) -> int:
+        return sum(mt.table.active_count for mt in self._metrics.values())
+
+    @property
+    def discarded_series(self) -> int:
+        return sum(mt.table.discarded for mt in self._metrics.values())
+
+    def collect(self, ts_ms: int | None = None) -> list[Sample]:
+        """The collection tick (`registry.go:206-256`): one synchronized
+        timestamp across all families, device state gathered once each."""
+        if self.overrides.disable_collection:
+            return []
+        ts = int(self.now() * 1000) if ts_ms is None else ts_ms
+        out: list[Sample] = []
+        for mt in self._metrics.values():
+            out.extend(mt.collect(ts))
+        return out
+
+    def purge_stale(self) -> int:
+        """Evict idle series and zero their device rows; returns eviction
+        count (of label combos). Families may share a SeriesTable (e.g. the
+        spanmetrics calls/latency/size trio stays slot-aligned); eviction is
+        computed once per table but EVERY family on that table gets its
+        device rows zeroed and its staleness markers queued."""
+        cutoff = self.now() - self.overrides.stale_duration_s
+        by_table: dict[int, list[_MetricBase]] = {}
+        for mt in self._metrics.values():
+            by_table.setdefault(id(mt.table), []).append(mt)
+        total = 0
+        for fams in by_table.values():
+            table = fams[0].table
+            stale = np.flatnonzero(table.active & (table.last_seen < cutoff))
+            if not stale.size:
+                continue
+            # pad to a small set of static shapes to bound recompiles
+            padded = np.full(_pad_len(stale.size), table.capacity, np.int32)
+            padded[: stale.size] = stale
+            for mt in fams:
+                mt.note_stale(stale)
+                mt.state = m.zero_slots(mt.state, padded)
+            table.purge_stale(cutoff)
+            total += stale.size
+        return total
+
+    def native_histograms(self, ts_ms: int | None = None) -> list[tuple]:
+        """(labels, log2_counts, sum, count, zeros, ts) per active native-
+        histogram series, in the shape encode_write_request consumes."""
+        ts = int(self.now() * 1000) if ts_ms is None else ts_ms
+        out = []
+        for mt in self._metrics.values():
+            payload = getattr(mt, "native_payload", None)
+            if payload is None:
+                continue
+            slots, labels, hists, sums, counts, zeros = payload()
+            for i in range(len(labels)):
+                out.append((labels[i], hists[i], float(sums[i]),
+                            float(counts[i]), float(zeros[i]), ts))
+        return out
+
+    def metric(self, name: str) -> _MetricBase:
+        return self._metrics[name]
+
+
+def _pad_len(n: int) -> int:
+    return max(16, 1 << math.ceil(math.log2(n)))

@@ -1,0 +1,133 @@
+"""Host-side series tables: label combos → dense device slot ids.
+
+Replaces the reference's per-series hash map + `LabelValueCombo` hashing
+(`modules/generator/registry/registry.go:139-144`, `registry/hash.go`) with a
+vectorized staging step: a batch of label-id rows is uniqued once (numpy),
+unseen combos get slots from a free list, and every span row resolves to a
+dense int32 slot usable as a device scatter index.
+
+Slot lifecycle mirrors the reference's active-series accounting
+(`registry.go:184-197` onAddSeries / max_active_series) and staleness purge
+(`registry.go:258-277` removeStaleSeries): full table → new combos are
+rejected (slot -1, counted as discarded); idle series are evicted and their
+device rows zeroed (see `zero_slots`) with staleness markers emitted on the
+next collect.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Exemplar:
+    trace_id_hex: str
+    value: float
+    ts_ms: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Sample:
+    name: str
+    labels: tuple[tuple[str, str], ...]  # sorted (name, value) pairs
+    value: float
+    ts_ms: int
+    exemplar: Exemplar | None = None
+    is_stale_marker: bool = False
+
+
+class SeriesBudget:
+    """Cross-family active-series budget shared by all tables of a tenant
+    registry (`registry.go:184-197` onAddSeries/max_active_series)."""
+
+    def __init__(self, limit: int):
+        self.limit = limit
+        self.used = 0
+
+    def take(self) -> bool:
+        if self.used >= self.limit:
+            return False
+        self.used += 1
+        return True
+
+    def release(self, n: int = 1) -> None:
+        self.used = max(0, self.used - n)
+
+
+class SeriesTable:
+    """Fixed-capacity table of label-value-id rows → slot ids."""
+
+    def __init__(self, capacity: int, n_labels: int,
+                 budget: "SeriesBudget | None" = None):
+        self.capacity = capacity
+        self.n_labels = n_labels
+        self.budget = budget
+        self._slots: dict[bytes, int] = {}
+        self._free: list[int] = list(range(capacity - 1, -1, -1))
+        self.slot_keys = np.full((capacity, n_labels), -1, np.int32)
+        self.active = np.zeros(capacity, bool)
+        self.last_seen = np.zeros(capacity, np.float64)
+        self.discarded = 0  # combos rejected because the table was full
+
+    @property
+    def active_count(self) -> int:
+        return self.capacity - len(self._free)
+
+    def lookup_or_create(self, rows: np.ndarray, now: float,
+                         valid: np.ndarray | None = None) -> np.ndarray:
+        """Resolve [n, n_labels] int32 label rows to [n] int32 slots.
+
+        Rows that cannot be allocated (table full) resolve to -1; callers must
+        mask those out of the device update (the reference increments
+        `tempo_metrics_generator_registry_series_limited_total` — we count in
+        `self.discarded`).
+        """
+        n = rows.shape[0]
+        out = np.full(n, -1, np.int32)
+        if n == 0:
+            return out
+        if valid is None:
+            valid = np.ones(n, bool)
+        uniq, inverse = np.unique(rows, axis=0, return_inverse=True)
+        uslots = np.full(uniq.shape[0], -1, np.int32)
+        # Only unique rows that actually appear in valid positions allocate.
+        used = np.zeros(uniq.shape[0], bool)
+        np.logical_or.at(used, inverse, valid)
+        for i in range(uniq.shape[0]):
+            if not used[i]:
+                continue
+            key = uniq[i].tobytes()
+            slot = self._slots.get(key)
+            if slot is None:
+                if not self._free or (self.budget is not None
+                                      and not self.budget.take()):
+                    self.discarded += 1
+                    continue
+                slot = self._free.pop()
+                self._slots[key] = slot
+                self.slot_keys[slot] = uniq[i]
+                self.active[slot] = True
+            self.last_seen[slot] = now
+            uslots[i] = slot
+        out = uslots[inverse]
+        out[~valid] = -1
+        return out
+
+    def purge_stale(self, older_than: float) -> np.ndarray:
+        """Evict series idle since before `older_than`; returns evicted slots."""
+        stale = np.flatnonzero(self.active & (self.last_seen < older_than))
+        for slot in stale.tolist():
+            key = self.slot_keys[slot].tobytes()
+            self._slots.pop(key, None)
+            self.active[slot] = False
+            self.slot_keys[slot] = -1
+            self._free.append(slot)
+        if self.budget is not None and stale.size:
+            self.budget.release(stale.size)
+        return stale
+
+    def active_slots(self) -> np.ndarray:
+        return np.flatnonzero(self.active)

@@ -1,0 +1,1 @@
+"""Shared utilities (the analog of the reference's `pkg/` helpers)."""

@@ -1,0 +1,104 @@
+"""Vectorized span filter policies.
+
+The analog of `pkg/spanfilter` (`spanfilter.go:19,53`): include/exclude
+policies with strict or regex matching over intrinsics (kind, status, name)
+and span/resource attributes. A policy set compiles to a single callable
+producing a keep-mask over a SpanBatch — string comparisons become id
+comparisons (strict) or a per-id boolean lookup table built from the
+interner snapshot (regex), so no per-span Python runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Callable, Sequence
+
+import numpy as np
+
+from tempo_tpu.model.interner import INVALID_ID
+from tempo_tpu.model.span_batch import SpanBatch
+
+_KIND_STRS = ("SPAN_KIND_UNSPECIFIED", "SPAN_KIND_INTERNAL", "SPAN_KIND_SERVER",
+              "SPAN_KIND_CLIENT", "SPAN_KIND_PRODUCER", "SPAN_KIND_CONSUMER")
+_STATUS_STRS = ("STATUS_CODE_UNSET", "STATUS_CODE_OK", "STATUS_CODE_ERROR")
+
+
+@dataclasses.dataclass(frozen=True)
+class AttributeMatch:
+    key: str          # "kind", "status", "name", "span.<attr>", "resource.<attr>"
+    value: object     # str (or compiled pattern source for regex)
+
+
+@dataclasses.dataclass(frozen=True)
+class PolicyMatch:
+    match_type: str   # "strict" | "regex"
+    attributes: tuple[AttributeMatch, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class FilterPolicy:
+    include: PolicyMatch | None = None
+    exclude: PolicyMatch | None = None
+
+
+def _intrinsic_str_col(sb: SpanBatch, key: str) -> np.ndarray | None:
+    """Return an int32 'interned string id' column for intrinsic string keys."""
+    it = sb.interner
+    if key in ("kind", "span.kind"):
+        lut = it.intern_many(_KIND_STRS)
+        return lut[np.clip(sb.kind, 0, 5)]
+    if key in ("status", "span.status", "status.code"):
+        lut = it.intern_many(_STATUS_STRS)
+        return lut[np.clip(sb.status_code, 0, 2)]
+    if key in ("name", "span.name"):
+        return sb.name_id
+    return None
+
+
+def _match_one(sb: SpanBatch, am: AttributeMatch, match_type: str) -> np.ndarray:
+    col = _intrinsic_str_col(sb, am.key)
+    if col is None:
+        key = am.key
+        scope = "span"
+        if key.startswith("resource."):
+            scope, key = "resource", key[len("resource."):]
+        elif key.startswith("span."):
+            key = key[len("span."):]
+        col = sb.attr_sval_column(key, scope=scope)
+    if match_type == "strict":
+        want = sb.interner.get(str(am.value))
+        return (col == want) & (col != INVALID_ID)
+    # regex: build id→bool LUT over the interner snapshot
+    pat = re.compile(str(am.value))
+    strs = sb.interner.snapshot()
+    lut = np.fromiter((bool(pat.fullmatch(s)) for s in strs), bool, len(strs))
+    safe = np.clip(col, 0, max(len(strs) - 1, 0))
+    return np.where((col >= 0) & (col < len(strs)), lut[safe] if len(strs) else False, False)
+
+
+def _match_policy(sb: SpanBatch, pm: PolicyMatch) -> np.ndarray:
+    mask = np.ones(sb.capacity, bool)
+    for am in pm.attributes:
+        mask &= _match_one(sb, am, pm.match_type)
+    return mask
+
+
+def compile_policies(policies: Sequence[FilterPolicy]) -> Callable[[SpanBatch], np.ndarray] | None:
+    """Compile to keep-mask fn. Reference semantics (`spanfilter.go:53`):
+    a span is kept if, for every policy, (include absent or matched) and
+    (exclude absent or not matched)."""
+    pols = tuple(policies)
+    if not pols:
+        return None
+
+    def keep(sb: SpanBatch) -> np.ndarray:
+        mask = np.ones(sb.capacity, bool)
+        for p in pols:
+            if p.include is not None:
+                mask &= _match_policy(sb, p.include)
+            if p.exclude is not None:
+                mask &= ~_match_policy(sb, p.exclude)
+        return mask
+
+    return keep

@@ -1,0 +1,21 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip sharding paths (tempo_tpu.parallel) are exercised without TPU
+hardware via xla_force_host_platform_device_count, mirroring how the
+reference tests multi-node behavior with in-memory fakes (SURVEY.md §4.2).
+Must run before the first `import jax` anywhere in the test session.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # tests never touch the real chip
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (after env setup, before any test imports)
+
+# Persistent compile cache: XLA:CPU compiles cost ~1s each and dominate the
+# suite; cache them across runs.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)

@@ -1,0 +1,134 @@
+"""SpanBatch / interner / OTLP decode tests (pkg/model + receiver analog)."""
+
+import json
+
+import numpy as np
+
+from tempo_tpu.model import (
+    KIND_SERVER,
+    STATUS_ERROR,
+    SpanBatchBuilder,
+    StringInterner,
+    otlp_json_to_batch,
+    otlp_proto_to_batch,
+)
+from tempo_tpu.model import proto_wire as pw
+from tempo_tpu.model.interner import INVALID_ID
+from tempo_tpu.model.span_batch import synthetic_batch
+
+
+def test_interner_roundtrip():
+    it = StringInterner()
+    a, b, a2 = it.intern("alpha"), it.intern("beta"), it.intern("alpha")
+    assert a == a2 != b
+    assert it.lookup(b) == "beta"
+    assert it.get("gamma") == INVALID_ID
+    assert it.lookup_many(np.array([a, b, INVALID_ID])) == ["alpha", "beta", ""]
+
+
+def test_builder_padding_and_columns():
+    b = SpanBatchBuilder()
+    for i in range(10):
+        b.append(
+            trace_id=bytes([i]) * 16, span_id=bytes([i]) * 8,
+            name=f"op-{i % 3}", service="svc", kind=KIND_SERVER,
+            status_code=STATUS_ERROR if i == 0 else 0,
+            start_unix_nano=1_000 + i, end_unix_nano=2_000 + i,
+            attrs={"http.status_code": 500, "route": f"/r/{i % 2}"},
+            res_attrs={"service.name": "svc", "cluster": "c1"},
+        )
+    sb = b.build()
+    assert sb.n == 10 and sb.capacity == 256  # padded to bucket
+    assert sb.valid[:10].all() and not sb.valid[10:].any()
+    assert (sb.duration_ns[:10] == 1000).all()
+    col = sb.attr_sval_column("route")
+    routes = set(sb.interner.lookup_many(col[:10]))
+    assert routes == {"/r/0", "/r/1"}
+    assert (col[10:] == INVALID_ID).all()
+    # numeric attr exposed through fval
+    kid = sb.interner.get("http.status_code")
+    hit = sb.span_attr_key[:10] == kid
+    assert (sb.span_attr_fval[:10][hit] == 500.0).all()
+
+
+def test_synthetic_batch_shapes():
+    sb = synthetic_batch(1000, n_services=4, seed=1)
+    assert sb.n == 1000 and sb.capacity == 1024
+    dv, base = sb.device_view()
+    assert dv["duration_ns"].shape == (1024,)
+    assert dv["valid"].sum() == 1000
+    assert (dv["start_rel_s"][:1000] >= 0).all()
+
+
+def test_otlp_json_decode():
+    payload = {
+        "resourceSpans": [{
+            "resource": {"attributes": [
+                {"key": "service.name", "value": {"stringValue": "frontend"}}]},
+            "scopeSpans": [{"spans": [{
+                "traceId": "0102030405060708090a0b0c0d0e0f10",
+                "spanId": "0102030405060708",
+                "name": "GET /",
+                "kind": "SPAN_KIND_SERVER",
+                "startTimeUnixNano": "1000000000",
+                "endTimeUnixNano": "1500000000",
+                "status": {"code": "STATUS_CODE_ERROR", "message": "boom"},
+                "attributes": [
+                    {"key": "http.status_code", "value": {"intValue": "500"}}],
+            }]}],
+        }]
+    }
+    sb = otlp_json_to_batch(json.loads(json.dumps(payload)))
+    assert sb.n == 1
+    assert sb.interner.lookup(int(sb.name_id[0])) == "GET /"
+    assert sb.interner.lookup(int(sb.service_id[0])) == "frontend"
+    assert int(sb.kind[0]) == KIND_SERVER
+    assert int(sb.status_code[0]) == STATUS_ERROR
+    assert int(sb.duration_ns[0]) == 500000000
+    assert sb.trace_id[0, 0] == 1 and sb.trace_id[0, 15] == 0x10
+
+
+def _build_otlp_proto() -> bytes:
+    def kv(key, buf):
+        return pw.enc_field_msg(1, pw.enc_field_str(1, key)[2:]) if False else None
+
+    def keyvalue(key: str, anyvalue: bytes) -> bytes:
+        return pw.enc_field_str(1, key) + pw.enc_field_msg(2, anyvalue)
+
+    sv = lambda s: pw.enc_field_str(1, s)
+    iv = lambda i: pw.enc_field_varint(3, i)
+    resource = pw.enc_field_msg(1, keyvalue("service.name", sv("cart")))
+    status = pw.enc_field_varint(3, 2) + pw.enc_field_str(2, "err")
+    span = (
+        pw.enc_field_bytes(1, bytes(range(16)))
+        + pw.enc_field_bytes(2, bytes(range(8)))
+        + pw.enc_field_str(5, "checkout")
+        + pw.enc_field_varint(6, 3)  # client
+        + pw.enc_field_fixed64(7, 10**9)
+        + pw.enc_field_fixed64(8, 2 * 10**9)
+        + pw.enc_field_msg(9, keyvalue("retries", iv(4)))
+        + pw.enc_field_msg(15, status)
+    )
+    scope_spans = pw.enc_field_msg(2, span)
+    resource_spans = pw.enc_field_msg(1, resource) + pw.enc_field_msg(2, scope_spans)
+    return pw.enc_field_msg(1, resource_spans)
+
+
+def test_otlp_proto_decode():
+    sb = otlp_proto_to_batch(_build_otlp_proto())
+    assert sb.n == 1
+    assert sb.interner.lookup(int(sb.name_id[0])) == "checkout"
+    assert sb.interner.lookup(int(sb.service_id[0])) == "cart"
+    assert int(sb.kind[0]) == 3
+    assert int(sb.status_code[0]) == 2
+    assert int(sb.duration_ns[0]) == 10**9
+    kid = sb.interner.get("retries")
+    hit = sb.span_attr_key[0] == kid
+    assert hit.any() and (sb.span_attr_fval[0][hit] == 4.0).all()
+
+
+def test_varint_roundtrip():
+    for v in (0, 1, 127, 128, 300, 2**32, 2**63 - 1):
+        enc = pw.enc_varint(v)
+        dec, pos = pw.read_varint(enc, 0)
+        assert dec == v and pos == len(enc)

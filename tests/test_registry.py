@@ -1,0 +1,124 @@
+"""Registry tests — semantics from the reference registry unit tests
+(`modules/generator/registry/{counter,gauge,histogram}_test.go`): collection
+values, series limits, staleness markers, histogram bucket expansion."""
+
+import math
+
+import numpy as np
+
+from tempo_tpu.registry import ManagedRegistry, RegistryOverrides
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_registry(**kw):
+    clock = FakeClock()
+    reg = ManagedRegistry("t1", RegistryOverrides(**kw), now=clock)
+    return reg, clock
+
+
+def sample_map(samples):
+    return {(s.name, s.labels): s.value for s in samples if not s.is_stale_marker}
+
+
+def test_counter_inc_and_collect():
+    reg, _ = make_registry()
+    c = reg.new_counter("traces_spanmetrics_calls_total", ("service", "span_name"))
+    rows = reg.interner.intern_many(["svc-a", "op1", "svc-a", "op1", "svc-b", "op2"]).reshape(3, 2)
+    c.inc_batch(rows)
+    c.inc(["svc-a", "op1"], 2.0)
+    got = sample_map(reg.collect(ts_ms=5))
+    by_svc = {lbls: v for (_, lbls), v in got.items()}
+    vals = sorted(by_svc.values())
+    assert vals == [1.0, 4.0]
+    assert reg.active_series == 2
+
+
+def test_histogram_buckets_cumulative():
+    reg, _ = make_registry()
+    h = reg.new_histogram("latency", ("service",), edges=(1.0, 2.0, 4.0))
+    rows = reg.interner.intern_many(["a"] * 4).reshape(4, 1)
+    h.observe_batch(rows, np.array([0.5, 1.5, 3.0, 100.0], np.float32))
+    samples = reg.collect(ts_ms=1)
+    m = sample_map(samples)
+    count = [v for (n, l), v in m.items() if n == "latency_count"][0]
+    total = [v for (n, l), v in m.items() if n == "latency_sum"][0]
+    assert count == 4 and abs(total - 105.0) < 1e-3
+    les = {dict(l)["le"]: v for (n, l), v in m.items() if n == "latency_bucket"}
+    assert les["1"] == 1 and les["2"] == 2 and les["4"] == 3 and les["+Inf"] == 4
+
+
+def test_le_inclusive_boundary():
+    reg, _ = make_registry()
+    h = reg.new_histogram("lat", ("s",), edges=(1.0, 2.0))
+    rows = reg.interner.intern_many(["x"]).reshape(1, 1)
+    h.observe_batch(rows, np.array([2.0], np.float32))  # le="2" must include 2.0
+    les = {dict(l)["le"]: v for (n, l), v in sample_map(reg.collect(1)).items()
+           if n == "lat_bucket"}
+    assert les["2"] == 1 and les["1"] == 0
+
+
+def test_max_active_series_rejects_new():
+    reg, _ = make_registry(max_active_series=2)
+    c = reg.new_counter("c", ("k",))
+    rows = reg.interner.intern_many(["a", "b", "c", "a"]).reshape(4, 1)
+    slots = c.inc_batch(rows)
+    assert (slots >= 0).sum() == 3  # a, b allocated; c rejected; second a ok
+    assert slots[2] == -1
+    assert reg.discarded_series == 1
+    vals = sorted(sample_map(reg.collect(1)).values())
+    assert vals == [1.0, 2.0]  # "c" never counted
+
+
+def test_staleness_purge_zeroes_and_marks():
+    reg, clock = make_registry(stale_duration_s=10.0)
+    c = reg.new_counter("c", ("k",))
+    c.inc(["old"], 5.0)
+    clock.t += 100.0
+    c.inc(["new"], 1.0)
+    evicted = reg.purge_stale()
+    assert evicted == 1 and reg.active_series == 1
+    samples = reg.collect(1)
+    markers = [s for s in samples if s.is_stale_marker]
+    assert len(markers) == 1 and math.isnan(markers[0].value)
+    assert dict(markers[0].labels)["k"] == "old"
+    # slot must be reusable with zeroed state
+    c.inc(["old2"], 7.0)
+    live = sample_map(reg.collect(2))
+    assert sorted(live.values()) == [1.0, 7.0]
+
+
+def test_gauge_last_wins():
+    reg, _ = make_registry()
+    g = reg.new_gauge("g", ("k",))
+    rows = reg.interner.intern_many(["a", "a", "a"]).reshape(3, 1)
+    g.set_batch(rows, np.array([1.0, 2.0, 3.0], np.float32))
+    assert list(sample_map(reg.collect(1)).values()) == [3.0]
+
+
+def test_external_labels_and_name_label():
+    reg, _ = make_registry(external_labels={"cluster": "eu-1"})
+    c = reg.new_counter("c_total", ("k",))
+    c.inc(["v"], 1.0)
+    (s,) = [s for s in reg.collect(1)]
+    d = dict(s.labels)
+    assert d["cluster"] == "eu-1" and d["__name__"] == "c_total" and d["k"] == "v"
+
+
+def test_native_histogram_counts():
+    reg, _ = make_registry()
+    nh = reg.new_native_histogram("lat", ("svc",))
+    rows = reg.interner.intern_many(["a"] * 3).reshape(3, 1)
+    nh.observe_batch(rows, np.array([0.0, 1.0, 8.0], np.float32))
+    m = sample_map(reg.collect(1))
+    assert [v for (n, _), v in m.items() if n == "lat_count"] == [3.0]
+    slots, labels, hist, sums, counts, zeros = nh.native_payload()
+    assert counts[0] == 3.0 and zeros[0] == 1.0 and sums[0] == 9.0
+    # all 3 observations land in log2 buckets; the 0.0 goes to bucket 0
+    assert hist[0].sum() == 3.0 and hist[0][0] == 1.0

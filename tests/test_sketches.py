@@ -1,0 +1,193 @@
+"""Property tests for the sketch kernels against scalar references.
+
+Mirrors the reference's sketch-layer unit tests
+(`pkg/traceqlmetrics/metrics_test.go` LatencyHistogram record/combine/
+percentile) plus accuracy-budget checks for HLL / count-min / DDSketch.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tempo_tpu import ops
+
+
+def test_log2_bucket_matches_bit_length():
+    vals = np.array([0, 1, 2, 3, 4, 7, 8, 1023, 1024, 2**40, 2**62], dtype=np.float64)
+    got = np.asarray(ops.log2_bucket(jnp.asarray(vals, jnp.float32)))
+    want = np.array([int(v).bit_length() if v < 2**53 else min(63, math.floor(math.log2(v)) + 1)
+                     for v in vals])
+    np.testing.assert_array_equal(got, np.minimum(want, 63))
+
+
+def test_log2_hist_update_and_counts():
+    h = ops.log2_hist_init(num_series=3)
+    sids = jnp.array([0, 0, 1, 2, 2, 2])
+    vals = jnp.array([1.0, 3.0, 100.0, 0.0, 5.0, 5.0])
+    h = ops.log2_hist_update(h, sids, vals)
+    c = np.asarray(h.counts)
+    assert c[0, 1] == 1  # v=1 → bucket 1
+    assert c[0, 2] == 1  # v=3 → bucket 2
+    assert c[1, 7] == 1  # v=100 → bit_length(100)=7
+    assert c[2, 0] == 1  # zero bucket
+    assert c[2, 3] == 2  # v=5 → bucket 3
+    assert c.sum() == 6
+
+
+def test_log2_hist_mask_drops_padding():
+    h = ops.log2_hist_init(1)
+    sids = jnp.zeros(4, jnp.int32)
+    vals = jnp.array([1.0, 2.0, 4.0, 8.0])
+    mask = jnp.array([True, True, False, False])
+    h = ops.log2_hist_update(h, sids, vals, mask=mask)
+    assert float(h.counts.sum()) == 2.0
+
+
+def test_log2_quantile_within_bucket_bounds():
+    rng = np.random.default_rng(0)
+    vals = rng.lognormal(mean=10, sigma=2, size=20000)
+    h = ops.log2_hist_init(1)
+    h = ops.log2_hist_update(h, jnp.zeros(vals.size, jnp.int32), jnp.asarray(vals, jnp.float32))
+    for q in (0.5, 0.9, 0.99):
+        est = float(ops.log2_quantile(h, q)[0])
+        true = np.quantile(vals, q)
+        # Power-of-two buckets: estimate within 2x of truth, monotone in q.
+        assert true / 2 <= est <= true * 2, (q, est, true)
+    qs = [float(ops.log2_quantile(h, q)[0]) for q in (0.1, 0.5, 0.9, 0.99)]
+    assert qs == sorted(qs)
+
+
+def test_log2_hist_merge_equals_concat():
+    rng = np.random.default_rng(1)
+    a_vals, b_vals = rng.exponential(1e6, 500), rng.exponential(1e3, 500)
+    mk = lambda v: ops.log2_hist_update(ops.log2_hist_init(2),
+                                        jnp.asarray(rng.integers(0, 2, v.size), jnp.int32),
+                                        jnp.asarray(v, jnp.float32))
+    rng = np.random.default_rng(1)
+    a = mk(a_vals)
+    rng = np.random.default_rng(1)
+    # merged counts = sum of counts
+    m = ops.log2_hist_merge(a, a)
+    np.testing.assert_allclose(np.asarray(m.counts), 2 * np.asarray(a.counts))
+
+
+def test_ddsketch_relative_error_budget():
+    rng = np.random.default_rng(2)
+    vals = rng.lognormal(mean=3, sigma=1.5, size=50000)
+    dd = ops.dd_init(1, rel_err=0.01)
+    dd = ops.dd_update(dd, jnp.zeros(vals.size, jnp.int32), jnp.asarray(vals, jnp.float32))
+    for q in (0.5, 0.9, 0.99, 0.999):
+        est = float(ops.dd_quantile(dd, q)[0])
+        true = np.quantile(vals, q)
+        rel = abs(est - true) / true
+        assert rel < 0.02, (q, est, true, rel)  # 1% sketch + sampling slack
+
+
+def test_ddsketch_merge_and_zeros():
+    dd = ops.dd_init(1, rel_err=0.01)
+    dd = ops.dd_update(dd, jnp.zeros(3, jnp.int32), jnp.array([0.0, 0.0, 10.0]))
+    assert float(dd.zeros[0]) == 2.0
+    m = ops.dd_merge(dd, dd)
+    assert float(m.zeros[0]) == 4.0
+    assert float(ops.dd_quantile(m, 0.25)[0]) == 0.0
+
+
+def _hash_pair(n, seed=0):
+    items = np.arange(n, dtype=np.uint32)
+    h1 = ops.splitmix32(jnp.asarray(items))
+    h2 = ops.murmur_fmix32(jnp.asarray(items) ^ jnp.uint32(0xDEADBEEF))
+    return h1, h2
+
+
+@pytest.mark.parametrize("n", [100, 10000, 200000])
+def test_hll_estimate_within_error(n):
+    hll = ops.hll_init(1, precision=14)
+    h1, h2 = _hash_pair(n)
+    hll = ops.hll_update(hll, jnp.zeros(n, jnp.int32), h1, h2)
+    est = float(ops.hll_estimate(hll)[0])
+    # Standard error for p=14 is ~0.81%; allow 5 sigma.
+    assert abs(est - n) / n < 0.05, (n, est)
+
+
+def test_hll_merge_is_union():
+    a_items = jnp.arange(5000, dtype=jnp.uint32)
+    b_items = jnp.arange(2500, 7500, dtype=jnp.uint32)
+    mk = lambda it: ops.hll_update(
+        ops.hll_init(1), jnp.zeros(it.shape[0], jnp.int32),
+        ops.splitmix32(it), ops.murmur_fmix32(it ^ jnp.uint32(0xDEADBEEF)))
+    merged = ops.hll_merge(mk(a_items), mk(b_items))
+    est = float(ops.hll_estimate(merged)[0])
+    assert abs(est - 7500) / 7500 < 0.05
+
+
+def test_cms_overestimates_only_and_accurate_heavy_hitters():
+    rng = np.random.default_rng(3)
+    # Zipf-ish: item i appears ~ 10000/i times.
+    items, true_counts = [], {}
+    for i in range(1, 200):
+        c = max(1, 10000 // i)
+        items += [i] * c
+        true_counts[i] = c
+    items = np.array(items, dtype=np.uint32)
+    rng.shuffle(items)
+    h1 = ops.splitmix32(jnp.asarray(items))
+    h2 = ops.murmur_fmix32(jnp.asarray(items) ^ jnp.uint32(0xDEADBEEF))
+    cms = ops.cms_init(1, depth=4, width=2048)
+    cms = ops.cms_update(cms, jnp.zeros(items.size, jnp.int32), h1, h2)
+    q_items = np.array(sorted(true_counts), dtype=np.uint32)
+    qh1 = ops.splitmix32(jnp.asarray(q_items))
+    qh2 = ops.murmur_fmix32(jnp.asarray(q_items) ^ jnp.uint32(0xDEADBEEF))
+    est = np.asarray(ops.cms_estimate(cms, jnp.zeros(q_items.size, jnp.int32), qh1, qh2))
+    want = np.array([true_counts[int(i)] for i in q_items], dtype=np.float32)
+    assert (est >= want - 1e-3).all()  # count-min never underestimates
+    # Top heavy hitters essentially exact (error ≤ eN/w, N≈58k, w=2048 → ~77)
+    heavy = want >= 1000
+    assert (np.abs(est[heavy] - want[heavy]) <= 100).all()
+
+
+def test_cms_merge_adds():
+    items = jnp.arange(100, dtype=jnp.uint32)
+    h1, h2 = ops.splitmix32(items), ops.murmur_fmix32(items ^ jnp.uint32(1))
+    cms = ops.cms_update(ops.cms_init(1), jnp.zeros(100, jnp.int32), h1, h2)
+    m = ops.cms_merge(cms, cms)
+    est = np.asarray(ops.cms_estimate(m, jnp.zeros(100, jnp.int32), h1, h2))
+    assert (est >= 2.0 - 1e-6).all()
+
+
+def test_updates_are_jittable_and_donate():
+    @jax.jit
+    def step(h, sids, vals):
+        return ops.log2_hist_update(h, sids, vals)
+
+    h = ops.log2_hist_init(4)
+    h = step(h, jnp.array([0, 1, 2, 3]), jnp.array([1.0, 2.0, 3.0, 4.0]))
+    assert float(h.counts.sum()) == 4.0
+
+
+def test_fnv_reference_vectors():
+    # Known FNV-1a 32 test vectors ("" -> offset, "a" -> 0xe40c292c).
+    assert int(ops.fnv1a_32(np.frombuffer(b"a", dtype=np.uint8))[0]) == 0xE40C292C
+    assert int(ops.fnv1a_64(np.frombuffer(b"a", dtype=np.uint8))[0]) == 0xAF63DC4C8601EC8C
+    # FNV-1 32 ("a" -> 0x050c5d7e).
+    assert int(ops.fnv1_32(np.frombuffer(b"a", dtype=np.uint8))[0]) == 0x050C5D7E
+
+
+def test_token_for_batches():
+    tids = np.zeros((3, 16), dtype=np.uint8)
+    tids[1, -1] = 1
+    toks = ops.token_for("tenant-a", tids)
+    assert toks.shape == (3,)
+    assert toks[0] == toks[2] and toks[0] != toks[1]
+
+
+def test_hash_columns32_deterministic_and_spread():
+    cols = jnp.asarray(np.random.default_rng(4).integers(0, 50, size=(1000, 5)), jnp.int32)
+    h1 = np.asarray(ops.hash_columns32(cols))
+    h2 = np.asarray(ops.hash_columns32(cols))
+    np.testing.assert_array_equal(h1, h2)
+    # distinct rows should essentially never collide at n=1000
+    uniq_rows = np.unique(np.asarray(cols), axis=0).shape[0]
+    assert np.unique(h1).size >= uniq_rows - 2
