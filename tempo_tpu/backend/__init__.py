@@ -1,0 +1,46 @@
+"""Object-storage plane: raw interfaces, local/mem/cloud impls, block meta,
+tenant index, role-keyed caching (SURVEY.md §2.2 'backend abstraction')."""
+
+from tempo_tpu.backend.cache import CacheProvider, CachingReader, LRUCache
+from tempo_tpu.backend.cloud import open_backend
+from tempo_tpu.backend.local import LocalBackend
+from tempo_tpu.backend.mem import MemBackend
+from tempo_tpu.backend.meta import (
+    BlockMeta,
+    CompactedBlockMeta,
+    DedicatedColumn,
+    TenantIndex,
+    clear_block,
+    has_meta,
+    mark_block_compacted,
+    read_block_meta,
+    read_compacted_block_meta,
+    read_tenant_index,
+    write_block_meta,
+    write_tenant_index,
+)
+from tempo_tpu.backend.raw import (
+    AlreadyExists,
+    CompactedMetaName,
+    DoesNotExist,
+    KeyPath,
+    MetaName,
+    RawReader,
+    RawWriter,
+    TenantIndexName,
+    block_keypath,
+    blocks,
+    copy_block,
+    tenants,
+)
+
+__all__ = [
+    "AlreadyExists", "BlockMeta", "CacheProvider", "CachingReader",
+    "CompactedBlockMeta", "CompactedMetaName", "DedicatedColumn",
+    "DoesNotExist", "KeyPath", "LRUCache", "LocalBackend", "MemBackend",
+    "MetaName", "RawReader", "RawWriter", "TenantIndex", "TenantIndexName",
+    "block_keypath", "blocks", "clear_block", "copy_block", "has_meta",
+    "mark_block_compacted", "open_backend", "read_block_meta",
+    "read_compacted_block_meta", "read_tenant_index", "tenants",
+    "write_block_meta", "write_tenant_index",
+]

@@ -1,0 +1,76 @@
+"""Cloud object-store backends: S3 / GCS / Azure.
+
+The reference ships full impls (`tempodb/backend/{s3,gcs,azure}/`) against
+cloud SDKs plus hedged HTTP requests (`s3/s3.go:129`). This environment has
+no cloud SDKs and zero egress, so these are config-compatible gated adapters:
+construction succeeds only if the SDK import works, otherwise a clear error
+points at the `local`/`mem` backends. The interface surface (RawReader/
+RawWriter) is identical, so swapping backends is a config change, as in the
+reference.
+"""
+
+from __future__ import annotations
+
+
+
+
+class _GatedCloudBackend:
+    sdk_module: str = ""
+    scheme: str = ""
+
+    def __init__(self, **config: object) -> None:
+        try:
+            __import__(self.sdk_module)
+        except ImportError as e:
+            raise RuntimeError(
+                f"{self.scheme} backend requires the '{self.sdk_module}' SDK, "
+                f"which is not available in this environment; use the 'local' "
+                f"backend (same RawReader/RawWriter interface) instead"
+            ) from e
+        self.config = config
+        raise NotImplementedError(
+            f"{self.scheme} backend: SDK present but adapter not wired; "
+            f"see tempo_tpu/backend/local.py for the reference implementation shape"
+        )
+
+
+class S3Backend(_GatedCloudBackend):
+    """`tempodb/backend/s3/s3.go` analog (hedged requests via
+    pkg/hedgedmetrics are a no-op here). Implements RawReader/RawWriter
+    when wired."""
+
+    sdk_module = "boto3"
+    scheme = "s3"
+
+
+class GCSBackend(_GatedCloudBackend):
+    """`tempodb/backend/gcs/` analog."""
+
+    sdk_module = "google.cloud.storage"
+    scheme = "gcs"
+
+
+class AzureBackend(_GatedCloudBackend):
+    """`tempodb/backend/azure/` analog."""
+
+    sdk_module = "azure.storage.blob"
+    scheme = "azure"
+
+
+def open_backend(kind: str, **config: object):
+    """Backend factory keyed by config string — `tempodb/backend` dispatch."""
+    if kind == "local":
+        from tempo_tpu.backend.local import LocalBackend
+
+        return LocalBackend(str(config.get("path", "/tmp/tempo_tpu/blocks")))
+    if kind in ("mem", "memory"):
+        from tempo_tpu.backend.mem import MemBackend
+
+        return MemBackend()
+    if kind == "s3":
+        return S3Backend(**config)
+    if kind == "gcs":
+        return GCSBackend(**config)
+    if kind == "azure":
+        return AzureBackend(**config)
+    raise ValueError(f"unknown backend {kind!r} (want local|mem|s3|gcs|azure)")

@@ -165,7 +165,12 @@ class SpanMetricsProcessor:
         """Per-series latency quantile from the DDSketch plane (<1% error)."""
         if self.dd is None:
             return {}
+        # The sketch plane may be smaller than the series table
+        # (sketch_max_series < max_active_series); slots beyond it were
+        # masked out of dd_update and have no quantile.
+        nrows = self.dd.counts.shape[0]
         slots = self.calls.table.active_slots()
+        slots = slots[slots < nrows]
         vals = np.asarray(sketches.dd_quantile(self.dd, q))
         return {self.calls.labels_of(int(s)): float(vals[int(s)]) for s in slots}
 

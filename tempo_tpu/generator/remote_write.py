@@ -75,14 +75,14 @@ def _zigzag(v: int) -> int:
 
 
 def encode_native_histogram(log2_counts: np.ndarray, total: float, zeros: float,
-                            sum_: float, ts_ms: int) -> bytes:
+                            sum_: float, ts_ms: int, offset: int = 0) -> bytes:
     """Encode a log2-bucket row as a schema-0 native histogram.
 
-    Our log2 bucket b>0 covers (2^(b-2), 2^(b-1)]; Prometheus schema-0 index i
-    covers (2^(i-1), 2^i], so i = b-1. Contiguous nonzero runs become
-    BucketSpans with delta-encoded counts.
+    Our log2 bucket b>0 covers [2^(b-1-offset), 2^(b-offset)); Prometheus
+    schema-0 index i covers (2^(i-1), 2^i], so i = b - offset. Contiguous
+    nonzero runs become BucketSpans with delta-encoded counts.
     """
-    nz = np.flatnonzero(log2_counts[1:])  # skip zero-bucket; index = b-1
+    nz = np.flatnonzero(log2_counts[1:])  # skip zero-bucket; b = idx+1
     spans = b""
     deltas = b""
     prev_count = 0
@@ -97,7 +97,7 @@ def encode_native_histogram(log2_counts: np.ndarray, total: float, zeros: float,
 
     prev_end = None
     for idx in nz.tolist():
-        i = idx  # prometheus index = b-1 where b = idx+1
+        i = idx + 1 - offset  # prometheus index = b - offset where b = idx+1
         if run_start is None:
             run_start, run_len = i, 1
         elif i == run_start + run_len:
@@ -139,9 +139,10 @@ def encode_write_request(samples: Iterable[Sample],
                   + pw.enc_field_varint(3, s.exemplar.ts_ms))
             body += pw.enc_field_msg(3, ex)
         out += pw.enc_field_msg(1, body)
-    for labels, log2_counts, sum_, count, zeros, ts in native_histograms:
+    for labels, log2_counts, sum_, count, zeros, ts, *rest in native_histograms:
+        offset = rest[0] if rest else 0
         body = _enc_labels(labels) + pw.enc_field_msg(
-            4, encode_native_histogram(log2_counts, count, zeros, sum_, ts))
+            4, encode_native_histogram(log2_counts, count, zeros, sum_, ts, offset))
         out += pw.enc_field_msg(1, body)
     return bytes(out)
 

@@ -45,32 +45,38 @@ NUM_LOG2_BUCKETS = 64
 # Log2 histogram (power-of-two buckets)
 # ---------------------------------------------------------------------------
 
-@partial(jax.tree_util.register_dataclass, data_fields=["counts"], meta_fields=[])
+@partial(jax.tree_util.register_dataclass, data_fields=["counts"], meta_fields=["offset"])
 @dataclasses.dataclass(frozen=True)
 class Log2Histogram:
     """Per-series power-of-two histograms: counts[S, 64].
 
-    Bucket 0 holds exact zeros; bucket b>0 holds values in (2^(b-2), 2^(b-1)]
-    i.e. b = floor(log2(v)) + 1 clamped to 63 — the bit-length bucketing the
-    reference uses (`pkg/traceqlmetrics/metrics.go:36-44`).
+    Bucket 0 holds zeros (and underflow below 2^-offset); bucket b>0 holds
+    values in [2^(b-1-offset), 2^(b-offset)) i.e. b = floor(log2(v))+1+offset
+    clamped to 63 — the bit-length bucketing the reference uses on integer
+    nanoseconds (`pkg/traceqlmetrics/metrics.go:36-44`). `offset` (static)
+    shifts the covered range down so second-scale floats keep sub-second
+    resolution (offset=32 → 2^-32 s .. 2^31 s).
     """
 
     counts: jax.Array  # [S, 64] float32 (float so psum/weighted counts work)
+    offset: int = 0    # static bucket shift
 
 
-def log2_bucket(values: jax.Array) -> jax.Array:
-    """Bit-length bucket of non-negative values: 0→0, v>0 → floor(log2 v)+1, ≤63."""
+def log2_bucket(values: jax.Array, offset: int = 0) -> jax.Array:
+    """Bucket of non-negative values: 0→0, v>0 → floor(log2 v)+1+offset, ≤63."""
     v = jnp.maximum(jnp.asarray(values), 0.0)
     # floor(log2(v)) via frexp-free math; v in [2^(b-1), 2^b) → bucket b.
     # The 1e-4 nudge absorbs float32 log2 rounding at exact power-of-two
     # boundaries (2^62 must land in bucket 63, not 62).
-    b = jnp.floor(jnp.log2(jnp.maximum(v, 1e-30)) + 1e-4) + 1.0
+    b = jnp.floor(jnp.log2(jnp.maximum(v, 1e-30)) + 1e-4) + 1.0 + offset
     b = jnp.where(v > 0, b, 0.0)
     return jnp.clip(b, 0, NUM_LOG2_BUCKETS - 1).astype(jnp.int32)
 
 
-def log2_hist_init(num_series: int) -> Log2Histogram:
-    return Log2Histogram(counts=jnp.zeros((num_series, NUM_LOG2_BUCKETS), jnp.float32))
+def log2_hist_init(num_series: int, offset: int = 0) -> Log2Histogram:
+    return Log2Histogram(
+        counts=jnp.zeros((num_series, NUM_LOG2_BUCKETS), jnp.float32),
+        offset=offset)
 
 
 def log2_hist_update(
@@ -90,22 +96,24 @@ def log2_hist_update(
     if mask is not None:
         w = jnp.where(mask, w, 0.0)
         sids = jnp.where(mask, sids, 0)
-    buckets = log2_bucket(values)
+    buckets = log2_bucket(values, state.offset)
     flat = sids * NUM_LOG2_BUCKETS + buckets
     counts = state.counts.reshape(-1).at[flat].add(w, mode="drop").reshape(state.counts.shape)
-    return Log2Histogram(counts=counts)
+    return dataclasses.replace(state, counts=counts)
 
 
 def log2_hist_merge(a: Log2Histogram, b: Log2Histogram) -> Log2Histogram:
     """Combine = elementwise add (`metrics.go:52-58` Combine)."""
-    return Log2Histogram(counts=a.counts + b.counts)
+    assert a.offset == b.offset
+    return dataclasses.replace(a, counts=a.counts + b.counts)
 
 
 def log2_quantile(state: Log2Histogram, q: float | jax.Array) -> jax.Array:
     """Interpolated quantile per series, [S]. Matches the reference's
     exponential interpolation (`metrics.go:60-98` Percentile,
     `engine_metrics.go:1402-1468` Log2Quantile): position within the selected
-    bucket interpolates the exponent, i.e. value = 2^(b-1+frac).
+    bucket interpolates the exponent, i.e. value = 2^(b-1-offset+frac) for
+    bucket b spanning [2^(b-1-offset), 2^(b-offset)).
     """
     counts = state.counts  # [S, B]
     total = counts.sum(axis=-1)  # [S]
@@ -117,8 +125,7 @@ def log2_quantile(state: Log2Histogram, q: float | jax.Array) -> jax.Array:
     cum_before = jnp.where(b > 0, take(cum, jnp.maximum(b - 1, 0)[..., None], axis=-1)[..., 0], 0.0)
     in_bucket = take(counts, b[..., None], axis=-1)[..., 0]
     frac = jnp.where(in_bucket > 0, (target - cum_before) / jnp.maximum(in_bucket, 1e-30), 1.0)
-    # Bucket b>0 spans (2^(b-2), 2^(b-1)]: interpolate the exponent.
-    val = jnp.exp2(jnp.asarray(b, jnp.float32) - 2.0 + frac)
+    val = jnp.exp2(jnp.asarray(b, jnp.float32) - 1.0 - state.offset + frac)
     val = jnp.where(b == 0, 0.0, val)
     return jnp.where(total > 0, val, 0.0)
 

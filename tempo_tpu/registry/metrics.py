@@ -136,7 +136,10 @@ class NativeHistogramState:
 
     Device representation is the log2 sketch (= Prometheus native histogram
     schema 0: one bucket per power of two), plus sum/count/zero-count — enough
-    to emit remote-write `Histogram` protos losslessly at that schema.
+    to emit remote-write `Histogram` protos losslessly at that schema. The
+    sketch's bucket offset (default 32) keeps sub-second resolution for
+    second-scale latencies; the exporter shifts Prometheus bucket indices
+    back by the same amount.
     """
 
     hist: sketches.Log2Histogram  # [S, 64]
@@ -145,9 +148,12 @@ class NativeHistogramState:
     zeros: jax.Array              # [S]
 
 
-def native_histogram_init(capacity: int) -> NativeHistogramState:
+NATIVE_HISTOGRAM_OFFSET = 32
+
+
+def native_histogram_init(capacity: int, offset: int = NATIVE_HISTOGRAM_OFFSET) -> NativeHistogramState:
     return NativeHistogramState(
-        hist=sketches.log2_hist_init(capacity),
+        hist=sketches.log2_hist_init(capacity, offset=offset),
         sums=jnp.zeros((capacity,), jnp.float32),
         counts=jnp.zeros((capacity,), jnp.float32),
         zeros=jnp.zeros((capacity,), jnp.float32),

@@ -276,7 +276,13 @@ class ManagedRegistry:
 
     @property
     def active_series(self) -> int:
-        return sum(mt.table.active_count for mt in self._metrics.values())
+        # Families may share a SeriesTable (the spanmetrics trio); count each
+        # table once so the figure is comparable to max_active_series, which
+        # gates allocation per table.
+        seen: dict[int, int] = {}
+        for mt in self._metrics.values():
+            seen[id(mt.table)] = mt.table.active_count
+        return sum(seen.values())
 
     @property
     def discarded_series(self) -> int:
@@ -320,8 +326,8 @@ class ManagedRegistry:
         return total
 
     def native_histograms(self, ts_ms: int | None = None) -> list[tuple]:
-        """(labels, log2_counts, sum, count, zeros, ts) per active native-
-        histogram series, in the shape encode_write_request consumes."""
+        """(labels, log2_counts, sum, count, zeros, ts, offset) per active
+        native-histogram series, in the shape encode_write_request consumes."""
         ts = int(self.now() * 1000) if ts_ms is None else ts_ms
         out = []
         for mt in self._metrics.values():
@@ -329,9 +335,10 @@ class ManagedRegistry:
             if payload is None:
                 continue
             slots, labels, hists, sums, counts, zeros = payload()
+            offset = mt.state.hist.offset
             for i in range(len(labels)):
                 out.append((labels[i], hists[i], float(sums[i]),
-                            float(counts[i]), float(zeros[i]), ts))
+                            float(counts[i]), float(zeros[i]), ts, offset))
         return out
 
     def metric(self, name: str) -> _MetricBase:

@@ -56,6 +56,27 @@ def _intrinsic_str_col(sb: SpanBatch, key: str) -> np.ndarray | None:
     return None
 
 
+# (pattern, interner id) → grown-in-place boolean LUT. The interner only
+# appends, so a cached LUT stays valid for ids it covers; each batch only the
+# newly interned tail is regex-matched instead of the whole string table.
+_regex_luts: dict[tuple[str, int], np.ndarray] = {}
+
+
+def _regex_lut(pattern: str, interner) -> np.ndarray:
+    strs = interner.snapshot()
+    key = (pattern, id(interner))
+    lut = _regex_luts.get(key)
+    start = 0 if lut is None else len(lut)
+    if start == len(strs):
+        return lut if lut is not None else np.zeros(0, bool)
+    pat = re.compile(pattern)
+    tail = np.fromiter((bool(pat.fullmatch(s)) for s in strs[start:]), bool,
+                       len(strs) - start)
+    lut = tail if lut is None else np.concatenate([lut, tail])
+    _regex_luts[key] = lut
+    return lut
+
+
 def _match_one(sb: SpanBatch, am: AttributeMatch, match_type: str) -> np.ndarray:
     col = _intrinsic_str_col(sb, am.key)
     if col is None:
@@ -69,12 +90,10 @@ def _match_one(sb: SpanBatch, am: AttributeMatch, match_type: str) -> np.ndarray
     if match_type == "strict":
         want = sb.interner.get(str(am.value))
         return (col == want) & (col != INVALID_ID)
-    # regex: build id→bool LUT over the interner snapshot
-    pat = re.compile(str(am.value))
-    strs = sb.interner.snapshot()
-    lut = np.fromiter((bool(pat.fullmatch(s)) for s in strs), bool, len(strs))
-    safe = np.clip(col, 0, max(len(strs) - 1, 0))
-    return np.where((col >= 0) & (col < len(strs)), lut[safe] if len(strs) else False, False)
+    # regex: incrementally-maintained id→bool LUT over the interner
+    lut = _regex_lut(str(am.value), sb.interner)
+    safe = np.clip(col, 0, max(len(lut) - 1, 0))
+    return np.where((col >= 0) & (col < len(lut)), lut[safe] if len(lut) else False, False)
 
 
 def _match_policy(sb: SpanBatch, pm: PolicyMatch) -> np.ndarray:

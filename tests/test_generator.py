@@ -227,7 +227,7 @@ def test_write_request_encoding_decodes():
 
 def test_native_histogram_encoding():
     counts = np.zeros(64)
-    counts[3] = 5  # bucket b=3 -> prom index 2: (2,4]
+    counts[3] = 5  # bucket b=3 covers [4,8) -> prom schema-0 index 3: (4,8]
     counts[4] = 2
     counts[10] = 1
     body = rw.encode_native_histogram(counts, total=8, zeros=0, sum_=40.0, ts_ms=7)
@@ -235,9 +235,21 @@ def test_native_histogram_encoding():
     assert f[1][0] == 8          # count_int
     assert pw.f64(f[3][0]) == 40.0
     spans = [pw.decode_fields(bytes(s)) for s in f[11]]
-    # two spans: [idx2 len2], [idx9 len1]
-    assert pw.zigzag_decode(spans[0][1][0]) == 2 and spans[0][2][0] == 2
-    # second span starts at prom idx 9; previous span ended at idx 4 -> gap 5
+    # two spans: [idx3 len2], [idx10 len1]
+    assert pw.zigzag_decode(spans[0][1][0]) == 3 and spans[0][2][0] == 2
+    # second span starts at prom idx 10; previous span ended at idx 5 -> gap 5
     assert pw.zigzag_decode(spans[1][1][0]) == 5 and spans[1][2][0] == 1
     deltas = [pw.zigzag_decode(d) for d in f[12]]
     assert np.cumsum(deltas).tolist() == [5, 2, 1]
+
+
+def test_native_histogram_encoding_with_offset():
+    # offset=32: bucket b covers [2^(b-33), 2^(b-32)). A 0.5s latency has
+    # b = floor(log2 .5)+1+32 = 32 -> prom index b-32 = 0: (0.5, 1].
+    counts = np.zeros(64)
+    counts[32] = 4
+    body = rw.encode_native_histogram(counts, total=4, zeros=0, sum_=2.0,
+                                      ts_ms=7, offset=32)
+    f = pw.decode_fields(body)
+    spans = [pw.decode_fields(bytes(s)) for s in f[11]]
+    assert pw.zigzag_decode(spans[0][1][0]) == 0 and spans[0][2][0] == 1

@@ -60,6 +60,28 @@ def test_log2_quantile_within_bucket_bounds():
     assert qs == sorted(qs)
 
 
+def test_log2_quantile_stays_inside_the_hit_bucket():
+    # 1000 observations all equal to 3.5 (bucket 2 = [2,4)): every quantile
+    # estimate must land inside [2,4], never below the bucket's lower edge.
+    h = ops.log2_hist_init(1)
+    h = ops.log2_hist_update(h, jnp.zeros(1000, jnp.int32),
+                             jnp.full(1000, 3.5, jnp.float32))
+    for q in (0.01, 0.5, 0.99):
+        est = float(ops.log2_quantile(h, q)[0])
+        assert 2.0 <= est <= 4.0, (q, est)
+
+
+def test_log2_offset_keeps_subsecond_resolution():
+    # offset=32 separates 1ms / 30ms / 500ms instead of collapsing (0,1)->0.
+    h = ops.log2_hist_init(1, offset=32)
+    vals = jnp.asarray([0.001, 0.03, 0.5], jnp.float32)
+    h = ops.log2_hist_update(h, jnp.zeros(3, jnp.int32), vals)
+    c = np.asarray(h.counts[0])
+    assert c[0] == 0 and (c > 0).sum() == 3
+    est = float(ops.log2_quantile(h, 0.99)[0])
+    assert 0.25 <= est <= 1.0  # inside 500ms's bucket [2^-1, 2^0)
+
+
 def test_log2_hist_merge_equals_concat():
     rng = np.random.default_rng(1)
     a_vals, b_vals = rng.exponential(1e6, 500), rng.exponential(1e3, 500)
