@@ -1,0 +1,21 @@
+"""Block encoding plane: columnar (parquet) blocks, bloom filters, WAL
+(SURVEY.md §2.2 'encoding/vparquet4' + 'wal', re-designed one-row-per-span
+with trace segment keys + nested-set coordinates for TPU-friendly scans)."""
+
+from tempo_tpu.block.bloom import BloomFilter, ShardedBloom, shard_name
+from tempo_tpu.block.reader import BackendBlock
+from tempo_tpu.block.schema import (
+    VERSION,
+    block_schema,
+    nested_set,
+    spans_by_trace,
+    traces_to_table,
+)
+from tempo_tpu.block.wal import WALBlock, rescan_blocks
+from tempo_tpu.block.writer import DATA_NAME, INDEX_NAME, write_block
+
+__all__ = [
+    "BackendBlock", "BloomFilter", "DATA_NAME", "INDEX_NAME", "ShardedBloom",
+    "VERSION", "WALBlock", "block_schema", "nested_set", "rescan_blocks",
+    "shard_name", "spans_by_trace", "traces_to_table", "write_block",
+]

@@ -1,0 +1,249 @@
+"""Columnar block schema: flat span rows + nested-set tree coordinates.
+
+The TPU-first re-design of vparquet4's nested one-row-per-trace schema
+(`tempodb/encoding/vparquet4/schema.go:120-258`). Instead of nested lists
+(trace → resource → scope → span), a block is ONE ROW PER SPAN with a
+`trace_idx` segment key: rows of a trace are contiguous (sorted by trace id),
+so per-trace reductions are `segment_sum`-style ops over a monotone key — the
+shape XLA wants — and span columns map 1:1 onto SpanBatch SoA tensors with
+zero restructuring at fetch time.
+
+Structural TraceQL operators (`>`, `>>`, `~`, `&>>`) use the same nested-set
+model the reference computes (`vparquet4/nested_set_model.go`): each span
+gets (nested_left, nested_right, parent_row); descendant = interval
+containment, child = parent_row equality — both pure vector compares.
+
+Attributes: per-type parallel list columns (string/int/double/bool × span/
+resource scope), matching vparquet4's typed attr columns, plus dedicated
+promoted columns from `BlockMeta.dedicated_columns`
+(`vparquet4/dedicated_columns.go`). Resource attrs are denormalized onto
+span rows; parquet dictionary+RLE encoding reclaims the redundancy on disk.
+
+Events and links are kept as list columns (vparquet4 event/link columns,
+`schema.go:162-236`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+VERSION = "vtpu1"
+
+# Columns every block carries, in schema order.
+CORE_FIELDS = [
+    ("trace_id", pa.binary(16)),
+    ("trace_idx", pa.int32()),
+    ("span_id", pa.binary(8)),
+    ("parent_span_id", pa.binary(8)),
+    ("parent_row", pa.int32()),      # absolute row of parent within block; -1 root
+    ("nested_left", pa.int32()),
+    ("nested_right", pa.int32()),
+    ("is_root", pa.bool_()),
+    ("name", pa.string()),
+    ("service", pa.string()),
+    ("kind", pa.int8()),
+    ("status_code", pa.int8()),
+    ("status_message", pa.string()),
+    ("start_unix_nano", pa.int64()),
+    ("duration_ns", pa.int64()),
+    # typed generic attributes (span scope)
+    ("sattr_str_keys", pa.list_(pa.string())),
+    ("sattr_str_vals", pa.list_(pa.string())),
+    ("sattr_int_keys", pa.list_(pa.string())),
+    ("sattr_int_vals", pa.list_(pa.int64())),
+    ("sattr_f64_keys", pa.list_(pa.string())),
+    ("sattr_f64_vals", pa.list_(pa.float64())),
+    ("sattr_bool_keys", pa.list_(pa.string())),
+    ("sattr_bool_vals", pa.list_(pa.bool_())),
+    # typed generic attributes (resource scope)
+    ("rattr_str_keys", pa.list_(pa.string())),
+    ("rattr_str_vals", pa.list_(pa.string())),
+    ("rattr_int_keys", pa.list_(pa.string())),
+    ("rattr_int_vals", pa.list_(pa.int64())),
+    ("rattr_f64_keys", pa.list_(pa.string())),
+    ("rattr_f64_vals", pa.list_(pa.float64())),
+    ("rattr_bool_keys", pa.list_(pa.string())),
+    ("rattr_bool_vals", pa.list_(pa.bool_())),
+    # events / links
+    ("event_times", pa.list_(pa.int64())),
+    ("event_names", pa.list_(pa.string())),
+    ("link_trace_ids", pa.list_(pa.binary(16))),
+    ("link_span_ids", pa.list_(pa.binary(8))),
+]
+
+
+def dedicated_field_name(scope: str, index: int) -> str:
+    return f"ded_{'s' if scope == 'span' else 'r'}_{index:02d}"
+
+
+def block_schema(dedicated: Sequence[Any] = ()) -> pa.Schema:
+    fields = [pa.field(n, t) for n, t in CORE_FIELDS]
+    for i, col in enumerate(dedicated):
+        fields.append(pa.field(dedicated_field_name(col.scope, i), pa.string()))
+    return pa.schema(fields)
+
+
+# ---------------------------------------------------------------------------
+# Nested-set numbering (vparquet4/nested_set_model.go)
+# ---------------------------------------------------------------------------
+
+def nested_set(span_ids: list[bytes], parent_ids: list[bytes]) -> tuple[list, list, list]:
+    """Assign (left, right, parent_idx) per span of ONE trace.
+
+    Orphans (parent not present) and cycle remnants are treated as roots,
+    as the reference does. Iterative DFS; left/right are 1-based within the
+    trace; parent_idx is the LOCAL span index (-1 for roots).
+    """
+    n = len(span_ids)
+    row_of = {sid: i for i, sid in enumerate(span_ids)}
+    children: list[list[int]] = [[] for _ in range(n)]
+    parent_idx = [-1] * n
+    for i, pid in enumerate(parent_ids):
+        p = row_of.get(pid) if pid and pid != b"\x00" * 8 else None
+        if p is not None and p != i:
+            parent_idx[i] = p
+            children[p].append(i)
+    roots = [i for i in range(n) if parent_idx[i] == -1]
+    left = [0] * n
+    right = [0] * n
+    counter = 1
+    visited = [False] * n
+    for r in roots:
+        # stack of (node, child_cursor)
+        stack = [(r, 0)]
+        visited[r] = True
+        left[r] = counter
+        counter += 1
+        while stack:
+            node, cur = stack[-1]
+            if cur < len(children[node]):
+                stack[-1] = (node, cur + 1)
+                c = children[node][cur]
+                if not visited[c]:
+                    visited[c] = True
+                    left[c] = counter
+                    counter += 1
+                    stack.append((c, 0))
+            else:
+                right[node] = counter
+                counter += 1
+                stack.pop()
+    # cycles unreachable from any root: break them as roots
+    for i in range(n):
+        if not visited[i]:
+            visited[i] = True
+            parent_idx[i] = -1
+            left[i] = counter
+            counter += 1
+            right[i] = counter
+            counter += 1
+    return left, right, parent_idx
+
+
+# ---------------------------------------------------------------------------
+# Trace spans → arrow rows
+# ---------------------------------------------------------------------------
+
+def _split_attrs(attrs: dict[str, Any]):
+    sk, sv, ik, iv, fk, fv, bk, bv = [], [], [], [], [], [], [], []
+    for k, v in (attrs or {}).items():
+        if isinstance(v, bool):
+            bk.append(k); bv.append(v)
+        elif isinstance(v, int):
+            ik.append(k); iv.append(v)
+        elif isinstance(v, float):
+            fk.append(k); fv.append(v)
+        elif isinstance(v, str):
+            sk.append(k); sv.append(v)
+        else:  # arrays/kvlists/bytes stringified, like attrToParquet (schema.go:253)
+            sk.append(k); sv.append(str(v))
+    return sk, sv, ik, iv, fk, fv, bk, bv
+
+
+def traces_to_table(traces: Iterable[tuple[bytes, list[dict]]],
+                    dedicated: Sequence[Any] = ()) -> pa.Table:
+    """[(trace_id, [span dicts])] → arrow table in block row order.
+
+    Traces MUST be pre-sorted by trace_id; spans of each trace are laid out
+    parent-before-child (DFS order is not required; rows keep input order).
+    """
+    cols: dict[str, list] = {name: [] for name, _ in CORE_FIELDS}
+    ded_names = [dedicated_field_name(c.scope, i) for i, c in enumerate(dedicated)]
+    for dn in ded_names:
+        cols[dn] = []
+    row_base = 0
+    for t_idx, (trace_id, spans) in enumerate(traces):
+        sids = [s.get("span_id", b"") for s in spans]
+        pids = [s.get("parent_span_id", b"") for s in spans]
+        left, right, parent_local = nested_set(sids, pids)
+        for j, s in enumerate(spans):
+            cols["trace_id"].append(trace_id.ljust(16, b"\0")[:16])
+            cols["trace_idx"].append(t_idx)
+            cols["span_id"].append((sids[j] or b"").ljust(8, b"\0")[:8])
+            cols["parent_span_id"].append((pids[j] or b"").ljust(8, b"\0")[:8])
+            cols["parent_row"].append(
+                row_base + parent_local[j] if parent_local[j] >= 0 else -1)
+            cols["nested_left"].append(left[j])
+            cols["nested_right"].append(right[j])
+            cols["is_root"].append(parent_local[j] < 0)
+            cols["name"].append(s.get("name", ""))
+            cols["service"].append(s.get("service", ""))
+            cols["kind"].append(s.get("kind", 0))
+            cols["status_code"].append(s.get("status_code", 0))
+            cols["status_message"].append(s.get("status_message", ""))
+            start = int(s.get("start_unix_nano", 0))
+            cols["start_unix_nano"].append(start)
+            cols["duration_ns"].append(max(int(s.get("end_unix_nano", start)) - start, 0))
+            sk, sv, ik, iv, fk, fv, bk, bv = _split_attrs(s.get("attrs"))
+            cols["sattr_str_keys"].append(sk); cols["sattr_str_vals"].append(sv)
+            cols["sattr_int_keys"].append(ik); cols["sattr_int_vals"].append(iv)
+            cols["sattr_f64_keys"].append(fk); cols["sattr_f64_vals"].append(fv)
+            cols["sattr_bool_keys"].append(bk); cols["sattr_bool_vals"].append(bv)
+            rk, rv, rik, riv, rfk, rfv, rbk, rbv = _split_attrs(s.get("res_attrs"))
+            cols["rattr_str_keys"].append(rk); cols["rattr_str_vals"].append(rv)
+            cols["rattr_int_keys"].append(rik); cols["rattr_int_vals"].append(riv)
+            cols["rattr_f64_keys"].append(rfk); cols["rattr_f64_vals"].append(rfv)
+            cols["rattr_bool_keys"].append(rbk); cols["rattr_bool_vals"].append(rbv)
+            evs = s.get("events") or []
+            cols["event_times"].append([int(e.get("time_unix_nano", 0)) for e in evs])
+            cols["event_names"].append([str(e.get("name", "")) for e in evs])
+            links = s.get("links") or []
+            cols["link_trace_ids"].append(
+                [bytes(l.get("trace_id", b"")).ljust(16, b"\0")[:16] for l in links])
+            cols["link_span_ids"].append(
+                [bytes(l.get("span_id", b"")).ljust(8, b"\0")[:8] for l in links])
+            for dn, dc in zip(ded_names, dedicated):
+                src = s.get("attrs") if dc.scope == "span" else s.get("res_attrs")
+                v = (src or {}).get(dc.name)
+                cols[dn].append(None if v is None else str(v))
+        row_base += len(spans)
+    schema = block_schema(dedicated)
+    return pa.Table.from_pydict({n: cols[n] for n in schema.names}, schema=schema)
+
+
+def table_stats(table: pa.Table) -> dict:
+    """Aggregates the writer stores in BlockMeta."""
+    n = table.num_rows
+    if n == 0:
+        return {"total_spans": 0, "total_objects": 0, "start_time": 0.0, "end_time": 0.0}
+    start = table.column("start_unix_nano").to_numpy()
+    dur = table.column("duration_ns").to_numpy()
+    tidx = table.column("trace_idx").to_numpy()
+    return {
+        "total_spans": int(n),
+        "total_objects": int(tidx.max()) + 1,
+        "start_time": float(start.min() / 1e9),
+        "end_time": float((start + dur).max() / 1e9),
+    }
+
+
+def spans_by_trace(spans: Iterable[dict]) -> list[tuple[bytes, list[dict]]]:
+    """Group flat span dicts by trace id, sorted by trace id (block order) —
+    the regroup the distributor does in `requestsByTraceID`."""
+    groups: dict[bytes, list[dict]] = {}
+    for s in spans:
+        groups.setdefault(bytes(s.get("trace_id", b"")), []).append(s)
+    return sorted(groups.items())

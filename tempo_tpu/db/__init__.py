@@ -1,0 +1,21 @@
+"""Storage engine facade (SURVEY.md §2.2 'tempodb core'): blocklist, poller,
+compaction, retention, bounded query pool, TempoDB Reader/Writer/Compactor."""
+
+from tempo_tpu.db.blocklist import List
+from tempo_tpu.db.compactor import (
+    CompactorConfig,
+    TimeWindowBlockSelector,
+    compact,
+    do_retention,
+    iter_trace_groups,
+    merge_blocks,
+)
+from tempo_tpu.db.pool import Pool
+from tempo_tpu.db.poller import Poller, PollerConfig
+from tempo_tpu.db.tempodb import TempoDB, TempoDBConfig
+
+__all__ = [
+    "CompactorConfig", "List", "Poller", "PollerConfig", "Pool", "TempoDB",
+    "TempoDBConfig", "TimeWindowBlockSelector", "compact", "do_retention",
+    "iter_trace_groups", "merge_blocks",
+]

@@ -1,0 +1,157 @@
+"""Compaction: time-window block selection + trace-merging rewrites.
+
+Analog of `tempodb/compactor.go:79-185` + `compaction_block_selector.go` +
+`vparquet4/compactor.go`: pick same-level blocks in the same time window,
+k-way merge their trace groups (dedup spans per trace id like
+`pkg/model/trace/combine.go`), emit size-targeted output blocks one level
+up, then mark inputs compacted. Ring ownership is a pluggable `owns`
+predicate (`modules/compactor/compactor.go:190`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import logging
+from typing import Callable, Iterable, Iterator
+
+from tempo_tpu.backend import meta as bm
+from tempo_tpu.backend.raw import RawReader, RawWriter
+from tempo_tpu.block.reader import BackendBlock, _rows_to_spans
+from tempo_tpu.block.writer import write_block
+from tempo_tpu.model.combine import combine_spans
+
+import numpy as np
+
+log = logging.getLogger("tempo_tpu.db.compactor")
+
+
+@dataclasses.dataclass
+class CompactorConfig:
+    """Subset of `tempodb/config.go` CompactorConfig."""
+
+    max_compaction_window_s: float = 3600.0
+    min_inputs: int = 2
+    max_inputs: int = 4               # MaxCompactionObjects guard analog
+    max_block_objects: int = 1_000_000
+    max_block_bytes: int = 100 << 30
+    compacted_grace_s: float = 3600.0  # retention grace for compacted markers
+    retention_s: float = 14 * 86400.0
+
+
+class TimeWindowBlockSelector:
+    """Group candidate blocks by (level, time window); oldest window first
+    (`compaction_block_selector.go:29,119`)."""
+
+    def __init__(self, cfg: CompactorConfig):
+        self.cfg = cfg
+
+    def blocks_to_compact(self, metas: list[bm.BlockMeta]) -> list[list[bm.BlockMeta]]:
+        win = self.cfg.max_compaction_window_s
+        groups: dict[tuple[int, int], list[bm.BlockMeta]] = {}
+        for m in metas:
+            groups.setdefault((m.compaction_level, int(m.end_time // win)), []).append(m)
+        out = []
+        for (_lvl, _w), ms in sorted(groups.items(), key=lambda kv: (kv[0][1], kv[0][0])):
+            ms.sort(key=lambda m: m.size_bytes)
+            while len(ms) >= self.cfg.min_inputs:
+                take = ms[: self.cfg.max_inputs]
+                ms = ms[self.cfg.max_inputs:]
+                if len(take) >= self.cfg.min_inputs:
+                    out.append(take)
+        return out
+
+
+def iter_trace_groups(block: BackendBlock) -> Iterator[tuple[bytes, list[dict]]]:
+    """Stream (trace_id, spans) in trace-id order from one block; rows of a
+    trace are contiguous, so groups fall out of row-group scans."""
+    pending_tid: bytes | None = None
+    pending: list[dict] = []
+    pf = block.parquet_file()
+    for rg in range(pf.num_row_groups):
+        tbl = pf.read_row_group(rg)
+        spans = _rows_to_spans(tbl, np.arange(tbl.num_rows))
+        for s in spans:
+            tid = bytes(s["trace_id"])
+            if tid != pending_tid:
+                if pending_tid is not None:
+                    yield pending_tid, pending
+                pending_tid, pending = tid, []
+            pending.append(s)
+    if pending_tid is not None:
+        yield pending_tid, pending
+
+
+def merge_blocks(blocks: Iterable[BackendBlock]) -> Iterator[tuple[bytes, list[dict]]]:
+    """K-way merge by trace id with span dedup across blocks."""
+    iters = [iter_trace_groups(b) for b in blocks]
+    merged = heapq.merge(*iters, key=lambda g: g[0])
+    cur_tid: bytes | None = None
+    cur_lists: list[list[dict]] = []
+    for tid, spans in merged:
+        if tid != cur_tid:
+            if cur_tid is not None:
+                yield cur_tid, combine_spans(*cur_lists)
+            cur_tid, cur_lists = tid, []
+        cur_lists.append(spans)
+    if cur_tid is not None:
+        yield cur_tid, combine_spans(*cur_lists)
+
+
+def compact(r: RawReader, w: RawWriter, tenant: str,
+            inputs: list[bm.BlockMeta], cfg: CompactorConfig) -> list[bm.BlockMeta]:
+    """Compact one input group → output metas (inputs marked compacted)."""
+    blocks = [BackendBlock(r, m) for m in inputs]
+    level = max(m.compaction_level for m in inputs) + 1
+    ded = inputs[0].dedicated_columns
+    out_metas: list[bm.BlockMeta] = []
+    batch: list[tuple[bytes, list[dict]]] = []
+    nspans = 0
+    ntraces = 0
+    est_bytes_per_span = max(
+        sum(m.size_bytes for m in inputs) // max(sum(m.total_spans for m in inputs), 1), 1)
+
+    def flush():
+        nonlocal batch, nspans, ntraces
+        if not batch:
+            return
+        meta = write_block(w, tenant, batch, dedicated_columns=ded,
+                           compaction_level=level,
+                           replication_factor=inputs[0].replication_factor)
+        out_metas.append(meta)
+        batch, nspans, ntraces = [], 0, 0
+
+    for tid, spans in merge_blocks(blocks):
+        batch.append((tid, spans))
+        nspans += len(spans)
+        ntraces += 1
+        if (ntraces >= cfg.max_block_objects
+                or nspans * est_bytes_per_span >= cfg.max_block_bytes):
+            flush()
+    flush()
+    for m in inputs:
+        bm.mark_block_compacted(r, w, m.block_id, tenant)
+    log.info("compacted %d blocks -> %d (tenant=%s level=%d)",
+             len(inputs), len(out_metas), tenant, level)
+    return out_metas
+
+
+def do_retention(r: RawReader, w: RawWriter, tenant: str,
+                 metas: list[bm.BlockMeta], compacted: list[bm.CompactedBlockMeta],
+                 cfg: CompactorConfig, now: Callable[[], float]) -> tuple[list, list]:
+    """Mark over-retention live blocks compacted; delete compacted blocks
+    past the grace period (`tempodb/retention.go:17-113`). Returns
+    (marked_metas, deleted_block_ids)."""
+    marked = []
+    deleted = []
+    cutoff = now() - cfg.retention_s
+    for m in metas:
+        if m.end_time < cutoff:
+            bm.mark_block_compacted(r, w, m.block_id, tenant)
+            marked.append(m)
+    grace = now() - cfg.compacted_grace_s
+    for c in compacted:
+        if c.compacted_time < grace:
+            bm.clear_block(w, c.meta.block_id, tenant)
+            deleted.append(c.meta.block_id)
+    return marked, deleted

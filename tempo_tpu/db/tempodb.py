@@ -1,0 +1,176 @@
+"""tempodb facade: Reader/Writer/Compactor over backend + blocks.
+
+Analog of `tempodb/tempodb.go:74-116` and its loops: block write (ingester
+flush target), trace lookup fan-out with time/shard pruning (`Find`
+`tempodb.go:624` includeBlock), blocklist polling (`EnablePolling`
+`tempodb.go:551`), compaction + retention loops (`EnableCompaction`
+`tempodb.go:518`, `compactor.go:79-185`). Loops run as explicit `*_once`
+ticks (tests) or daemon threads (services).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Iterable, Sequence
+
+from tempo_tpu.backend import meta as bm
+from tempo_tpu.backend.raw import RawReader, RawWriter
+from tempo_tpu.block.reader import BackendBlock
+from tempo_tpu.block.writer import write_block
+from tempo_tpu.db import compactor as comp
+from tempo_tpu.db.blocklist import List
+from tempo_tpu.db.pool import Pool
+from tempo_tpu.db.poller import Poller, PollerConfig
+from tempo_tpu.model.combine import combine_spans
+
+log = logging.getLogger("tempo_tpu.db")
+
+
+@dataclasses.dataclass
+class TempoDBConfig:
+    poller: PollerConfig = dataclasses.field(default_factory=PollerConfig)
+    compactor: comp.CompactorConfig = dataclasses.field(default_factory=comp.CompactorConfig)
+    pool_workers: int = 30
+    dedicated_columns: tuple = ()
+    row_group_rows: int = 50_000
+
+
+class TempoDB:
+    def __init__(self, r: RawReader, w: RawWriter,
+                 cfg: TempoDBConfig | None = None,
+                 now: Callable[[], float] = time.time):
+        self.r = r
+        self.w = w
+        self.cfg = cfg or TempoDBConfig()
+        self.now = now
+        self.blocklist = List()
+        self.poller = Poller(r, w, self.cfg.poller, now=now)
+        self.pool = Pool(self.cfg.pool_workers)
+        self.selector = comp.TimeWindowBlockSelector(self.cfg.compactor)
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._block_cache: dict[str, BackendBlock] = {}
+
+    # -- writer ------------------------------------------------------------
+
+    def write_block(self, tenant: str, traces: Iterable[tuple[bytes, list[dict]]],
+                    *, block_id: str | None = None,
+                    replication_factor: int = 3) -> bm.BlockMeta:
+        meta = write_block(
+            self.w, tenant, traces, block_id=block_id,
+            dedicated_columns=list(self.cfg.dedicated_columns),
+            row_group_rows=self.cfg.row_group_rows,
+            replication_factor=replication_factor)
+        self.blocklist.update(tenant, add=[meta])
+        return meta
+
+    # -- reader ------------------------------------------------------------
+
+    def backend_block(self, meta: bm.BlockMeta) -> BackendBlock:
+        b = self._block_cache.get(meta.block_id)
+        if b is None or b.meta is not meta:
+            b = self._block_cache[meta.block_id] = BackendBlock(self.r, meta)
+        return b
+
+    def blocks(self, tenant: str, start_s: float | None = None,
+               end_s: float | None = None,
+               shard_bounds: tuple[bytes, bytes] | None = None) -> list[bm.BlockMeta]:
+        """Blocklist pruned by time overlap and trace-id shard bounds
+        (includeBlock `tempodb.go:624`)."""
+        out = []
+        for m in self.blocklist.metas(tenant):
+            if start_s is not None and m.end_time < start_s:
+                continue
+            if end_s is not None and m.start_time > end_s:
+                continue
+            out.append(m)
+        return out
+
+    def find_trace_by_id(self, tenant: str, trace_id: bytes,
+                         start_s: float | None = None,
+                         end_s: float | None = None) -> list[dict] | None:
+        """Fan out across candidate blocks on the worker pool, combine spans
+        (RF dedup via combine_spans)."""
+        metas = self.blocks(tenant, start_s, end_s)
+        if not metas:
+            return None
+        results, errors = self.pool.run_jobs(
+            metas, lambda m: self.backend_block(m).find_trace_by_id(trace_id))
+        if errors and not results:
+            raise errors[0]
+        found = [spans for spans in results if spans]
+        return combine_spans(*found) if found else None
+
+    # -- polling -----------------------------------------------------------
+
+    def poll_now(self) -> None:
+        metas, compacted = self.poller.do()
+        self.blocklist.apply_poll_results(metas, compacted)
+
+    def enable_polling(self, interval_s: float | None = None) -> None:
+        self._spawn(self._poll_loop, interval_s or self.cfg.poller.poll_interval_s)
+
+    # -- compaction / retention -------------------------------------------
+
+    def compact_tenant_once(self, tenant: str,
+                            owns: Callable[[str], bool] = lambda key: True) -> int:
+        """One compaction sweep for a tenant; `owns` is the ring-ownership
+        predicate keyed like `modules/compactor/compactor.go:190`."""
+        metas = self.blocklist.metas(tenant)
+        jobs = self.selector.blocks_to_compact(metas)
+        done = 0
+        for group in jobs:
+            key = f"{tenant}-{group[0].block_id}"
+            if not owns(key):
+                continue
+            out = comp.compact(self.r, self.w, tenant, group, self.cfg.compactor)
+            self.blocklist.update(
+                tenant, add=out, remove=group,
+                compacted_add=[bm.CompactedBlockMeta(m, self.now()) for m in group])
+            done += 1
+        return done
+
+    def retention_once(self, tenant: str) -> tuple[list, list]:
+        marked, deleted = comp.do_retention(
+            self.r, self.w, tenant, self.blocklist.metas(tenant),
+            self.blocklist.compacted_metas(tenant), self.cfg.compactor, self.now)
+        self.blocklist.update(
+            tenant, remove=marked,
+            compacted_add=[bm.CompactedBlockMeta(m, self.now()) for m in marked],
+            compacted_remove=[c for c in self.blocklist.compacted_metas(tenant)
+                              if c.meta.block_id in set(deleted)])
+        return marked, deleted
+
+    def enable_compaction(self, interval_s: float = 30.0,
+                          owns: Callable[[str], bool] = lambda key: True) -> None:
+        self._spawn(self._compaction_loop, interval_s, owns)
+
+    # -- loops -------------------------------------------------------------
+
+    def _spawn(self, fn, *args) -> None:
+        t = threading.Thread(target=fn, args=args, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _poll_loop(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            try:
+                self.poll_now()
+            except Exception:
+                log.exception("poll cycle failed")
+
+    def _compaction_loop(self, interval_s: float, owns) -> None:
+        while not self._stop.wait(interval_s):
+            for tenant in self.blocklist.tenants():
+                try:
+                    self.compact_tenant_once(tenant, owns)
+                    self.retention_once(tenant)
+                except Exception:
+                    log.exception("compaction cycle failed (tenant=%s)", tenant)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self.pool.shutdown()

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
+import weakref
 from typing import Callable, Sequence
 
 import numpy as np
@@ -56,24 +57,31 @@ def _intrinsic_str_col(sb: SpanBatch, key: str) -> np.ndarray | None:
     return None
 
 
-# (pattern, interner id) → grown-in-place boolean LUT. The interner only
-# appends, so a cached LUT stays valid for ids it covers; each batch only the
-# newly interned tail is regex-matched instead of the whole string table.
-_regex_luts: dict[tuple[str, int], np.ndarray] = {}
+# interner (weak) → {pattern: boolean LUT}. The interner only appends, so a
+# cached LUT stays valid for ids it covers; each batch only the newly
+# interned tail is regex-matched instead of the whole string table. Weak keys
+# let dead interners' LUTs be collected (and make id-reuse aliasing
+# impossible).
+_regex_luts: "weakref.WeakKeyDictionary[object, dict[str, np.ndarray]]" = None  # type: ignore[assignment]
 
 
 def _regex_lut(pattern: str, interner) -> np.ndarray:
+    global _regex_luts
+    if _regex_luts is None:
+        _regex_luts = weakref.WeakKeyDictionary()
+    per = _regex_luts.setdefault(interner, {})
     strs = interner.snapshot()
-    key = (pattern, id(interner))
-    lut = _regex_luts.get(key)
+    lut = per.get(pattern)
     start = 0 if lut is None else len(lut)
-    if start == len(strs):
+    if start >= len(strs):
+        # A LUT longer than this snapshot (concurrent intern) is still
+        # correct for every id the snapshot covers.
         return lut if lut is not None else np.zeros(0, bool)
     pat = re.compile(pattern)
     tail = np.fromiter((bool(pat.fullmatch(s)) for s in strs[start:]), bool,
                        len(strs) - start)
     lut = tail if lut is None else np.concatenate([lut, tail])
-    _regex_luts[key] = lut
+    per[pattern] = lut
     return lut
 
 

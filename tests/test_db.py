@@ -1,0 +1,129 @@
+"""tempodb tests: polling/index, find fan-out, compaction dedup, retention
+(reference models: tempodb_test.go, blocklist/poller_test.go,
+compactor_test.go)."""
+
+import numpy as np
+
+from tempo_tpu.backend import MemBackend, has_meta, read_tenant_index
+from tempo_tpu.db import (
+    CompactorConfig,
+    Poller,
+    Pool,
+    TempoDB,
+    TempoDBConfig,
+    TimeWindowBlockSelector,
+)
+from tempo_tpu.backend.meta import BlockMeta
+from tests.test_block import mkspan, trace
+
+
+def _db(now=None):
+    be = MemBackend()
+    kw = {"now": now} if now else {}
+    return TempoDB(be, be, TempoDBConfig(row_group_rows=32), **kw), be
+
+
+def test_write_poll_find():
+    db, be = _db()
+    t5 = trace(5)
+    db.write_block("t1", [trace(1), trace(2), t5])
+    db.write_block("t1", [trace(8), trace(9)])
+    db.write_block("t2", [trace(3)])
+    # fresh db instance discovers blocks purely via polling
+    db2 = TempoDB(be, be)
+    db2.poll_now()
+    assert len(db2.blocks("t1")) == 2
+    spans = db2.find_trace_by_id("t1", t5[0])
+    assert spans is not None and len(spans) == 3
+    assert db2.find_trace_by_id("t2", t5[0]) is None
+    # tenant index written by the builder
+    assert len(read_tenant_index(be, "t1").metas) == 2
+
+
+def test_find_combines_rf_duplicates():
+    db, _ = _db()
+    tid, spans = trace(4)
+    # the same trace flushed by two "ingesters" (RF>1) into two blocks
+    db.write_block("t1", [(tid, spans[:2])])
+    db.write_block("t1", [(tid, spans)])  # overlap: spans[0:2] duplicated
+    got = db.find_trace_by_id("t1", tid)
+    assert len(got) == 3  # deduped by span id
+
+
+def test_time_pruned_blocks():
+    db, _ = _db()
+    db.write_block("t1", [trace(1)])   # start_time ~ 1.0s
+    db.write_block("t1", [trace(50)])  # start_time ~ 50.0s
+    assert len(db.blocks("t1")) == 2
+    assert len(db.blocks("t1", start_s=40.0)) == 1
+    assert len(db.blocks("t1", end_s=10.0)) == 1
+
+
+def test_selector_groups_by_level_and_window():
+    cfg = CompactorConfig(max_compaction_window_s=100.0, min_inputs=2, max_inputs=3)
+    sel = TimeWindowBlockSelector(cfg)
+    metas = [BlockMeta.new("t", end_time=t, compaction_level=lvl, total_spans=1)
+             for t, lvl in [(10, 0), (20, 0), (30, 0), (40, 0), (150, 0), (160, 0), (30, 1)]]
+    jobs = sel.blocks_to_compact(metas)
+    # window 0 level 0: 4 blocks -> one job of 3 (leftover 1 skipped);
+    # window 1 level 0: 2 blocks -> one job; level 1 single block -> none
+    assert [len(j) for j in jobs] == [3, 2]
+    assert all(m.compaction_level == 0 for j in jobs for m in j)
+
+
+def test_compaction_merges_and_marks():
+    db, be = _db()
+    tid, spans = trace(4, n_spans=3)
+    m1 = db.write_block("t1", [trace(1), (tid, spans[:2])])
+    m2 = db.write_block("t1", [(tid, spans), trace(9)])
+    n = db.compact_tenant_once("t1")
+    assert n == 1
+    metas = db.blocks("t1")
+    assert len(metas) == 1 and metas[0].compaction_level == 1
+    assert metas[0].total_objects == 3  # traces 1, 4, 9
+    assert metas[0].total_spans == 3 + 3 + 3
+    # inputs marked compacted in the backend
+    assert has_meta(be, m1.block_id, "t1") == (False, True)
+    assert has_meta(be, m2.block_id, "t1") == (False, True)
+    # merged trace deduped
+    got = db.find_trace_by_id("t1", tid)
+    assert len(got) == 3
+
+
+def test_retention_deletes_after_grace():
+    clock = [1000.0]
+    db, be = _db(now=lambda: clock[0])
+    db.cfg.compactor.retention_s = 100.0
+    db.cfg.compactor.compacted_grace_s = 50.0
+    db.write_block("t1", [trace(1)])  # end_time ~1s << cutoff
+    marked, deleted = db.retention_once("t1")
+    assert len(marked) == 1 and not deleted
+    assert db.blocks("t1") == []
+    clock[0] += 60.0
+    marked, deleted = db.retention_once("t1")
+    assert not marked and len(deleted) == 1
+    from tempo_tpu.backend.raw import KeyPath
+
+    assert be.list(KeyPath(("t1",))) == []
+
+
+def test_pool_stop_when():
+    pool = Pool(max_workers=4)
+    results, errors = pool.run_jobs(
+        range(100), lambda i: i if i % 10 == 0 else None,
+        stop_when=lambda rs: len(rs) >= 3)
+    assert len(results) >= 3
+    assert not errors
+
+
+def test_pool_collects_errors():
+    pool = Pool(max_workers=2)
+
+    def fn(i):
+        if i == 1:
+            raise ValueError("boom")
+        return i
+
+    results, errors = pool.run_jobs([0, 1, 2], fn)
+    assert sorted(results) == [0, 2]
+    assert len(errors) == 1
