@@ -69,6 +69,8 @@ class BlockMeta:
     footer_size: int = 0
     replication_factor: int = DEFAULT_REPLICATION_FACTOR
     dedicated_columns: list[DedicatedColumn] = dataclasses.field(default_factory=list)
+    min_trace_id: str = ""             # hex; trace-id shard pruning (includeBlock)
+    max_trace_id: str = ""
 
     @staticmethod
     def new(tenant: str, block_id: str | None = None, **kw: Any) -> "BlockMeta":

@@ -108,17 +108,18 @@ class BackendBlock:
         if not self._bloom_maybe(tid):
             return None
         hexid = tid.hex()
-        groups = [
-            g for g in self.row_group_index()
-            if g["min_trace_id"] <= hexid <= g["max_trace_id"]
-        ]
-        if not groups:
-            return None
         pf = self.parquet_file()
-        idx_of = {g2["row_offset"]: i for i, g2 in enumerate(self.row_group_index())}
+        index = self.row_group_index()
+        if index:
+            rgs = [i for i, g in enumerate(index)
+                   if g["min_trace_id"] <= hexid <= g["max_trace_id"]]
+        else:
+            rgs = list(range(pf.num_row_groups))  # index lost: full scan
+        if not rgs:
+            return None
         out: list[dict] = []
-        for g in groups:
-            tbl = pf.read_row_group(idx_of[g["row_offset"]])
+        for rg in rgs:
+            tbl = pf.read_row_group(rg)
             sel = np.asarray(tbl.column("trace_id").to_numpy(zero_copy_only=False)) == tid
             if sel.any():
                 out.extend(_rows_to_spans(tbl, np.flatnonzero(sel)))

@@ -131,15 +131,42 @@ def nested_set(span_ids: list[bytes], parent_ids: list[bytes]) -> tuple[list, li
                 right[node] = counter
                 counter += 1
                 stack.pop()
-    # cycles unreachable from any root: break them as roots
-    for i in range(n):
-        if not visited[i]:
-            visited[i] = True
-            parent_idx[i] = -1
-            left[i] = counter
+    # components unreachable from any root contain a parent cycle. Break ONE
+    # edge per cycle (making that node a root) and DFS-number the component,
+    # preserving every non-cycle parent link.
+    for start in range(n):
+        if visited[start]:
+            continue
+        # walk up the parent chain to find the cycle node
+        path_set = set()
+        node = start
+        while node not in path_set and not visited[node] and parent_idx[node] != -1:
+            path_set.add(node)
+            node = parent_idx[node]
+        if not visited[node]:
+            # `node` is on the cycle: break its parent edge
+            p = parent_idx[node]
+            if p != -1:
+                children[p].remove(node)
+                parent_idx[node] = -1
+            stack = [(node, 0)]
+            visited[node] = True
+            left[node] = counter
             counter += 1
-            right[i] = counter
-            counter += 1
+            while stack:
+                cur_node, cur = stack[-1]
+                if cur < len(children[cur_node]):
+                    stack[-1] = (cur_node, cur + 1)
+                    c = children[cur_node][cur]
+                    if not visited[c]:
+                        visited[c] = True
+                        left[c] = counter
+                        counter += 1
+                        stack.append((c, 0))
+                else:
+                    right[cur_node] = counter
+                    counter += 1
+                    stack.pop()
     return left, right, parent_idx
 
 

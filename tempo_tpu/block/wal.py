@@ -53,6 +53,12 @@ class WALBlock:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, os.path.join(self.dir, f"{self._next_seg:07d}.parquet"))
+        # fsync the directory so the rename itself survives power loss
+        dfd = os.open(self.dir, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
         self._next_seg += 1
         self.spans_appended += table.num_rows
 

@@ -113,6 +113,9 @@ def write_block_from_table(
     for i in range(bloom.shard_count):
         w.write(shard_name(i), kp, bloom.shard_bytes(i))
 
+    if groups:
+        meta.min_trace_id = groups[0]["min_trace_id"]
+        meta.max_trace_id = groups[-1]["max_trace_id"]
     stats = bs.table_stats(table)
     meta.total_spans = stats["total_spans"]
     meta.total_objects = stats["total_objects"]

@@ -52,7 +52,7 @@ class TempoDB:
         self.selector = comp.TimeWindowBlockSelector(self.cfg.compactor)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
-        self._block_cache: dict[str, BackendBlock] = {}
+        self._block_cache: dict[tuple[str, str], BackendBlock] = {}
 
     # -- writer ------------------------------------------------------------
 
@@ -70,21 +70,34 @@ class TempoDB:
     # -- reader ------------------------------------------------------------
 
     def backend_block(self, meta: bm.BlockMeta) -> BackendBlock:
-        b = self._block_cache.get(meta.block_id)
-        if b is None or b.meta is not meta:
-            b = self._block_cache[meta.block_id] = BackendBlock(self.r, meta)
+        key = (meta.tenant_id, meta.block_id)
+        b = self._block_cache.get(key)
+        if b is None or b.meta.block_id != meta.block_id:
+            b = self._block_cache[key] = BackendBlock(self.r, meta)
         return b
+
+    def _evict_dead_blocks(self, tenant: str) -> None:
+        live = {m.block_id for m in self.blocklist.metas(tenant)}
+        for key in [k for k in self._block_cache
+                    if k[0] == tenant and k[1] not in live]:
+            del self._block_cache[key]
 
     def blocks(self, tenant: str, start_s: float | None = None,
                end_s: float | None = None,
                shard_bounds: tuple[bytes, bytes] | None = None) -> list[bm.BlockMeta]:
         """Blocklist pruned by time overlap and trace-id shard bounds
         (includeBlock `tempodb.go:624`)."""
+        lo = shard_bounds[0].hex() if shard_bounds else None
+        hi = shard_bounds[1].hex() if shard_bounds else None
         out = []
         for m in self.blocklist.metas(tenant):
             if start_s is not None and m.end_time < start_s:
                 continue
             if end_s is not None and m.start_time > end_s:
+                continue
+            if lo is not None and m.max_trace_id and m.max_trace_id < lo:
+                continue
+            if hi is not None and m.min_trace_id and m.min_trace_id > hi:
                 continue
             out.append(m)
         return out
@@ -109,6 +122,8 @@ class TempoDB:
     def poll_now(self) -> None:
         metas, compacted = self.poller.do()
         self.blocklist.apply_poll_results(metas, compacted)
+        for tenant in self.blocklist.tenants():
+            self._evict_dead_blocks(tenant)
 
     def enable_polling(self, interval_s: float | None = None) -> None:
         self._spawn(self._poll_loop, interval_s or self.cfg.poller.poll_interval_s)

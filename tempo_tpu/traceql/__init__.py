@@ -1,0 +1,12 @@
+"""TraceQL: the traces-first query language (reference `pkg/traceql/`).
+
+Re-designed for columnar/TPU execution: the parser and AST mirror the
+reference grammar (`pkg/traceql/expr.y`, `lexer.go`), but evaluation is
+mask algebra over struct-of-arrays span columns instead of per-span
+interpreter loops, and the metrics engine scatters into
+[series x steps (x buckets)] device grids.
+"""
+
+from tempo_tpu.traceql.ast import *  # noqa: F401,F403
+from tempo_tpu.traceql.parser import parse, ParseError  # noqa: F401
+from tempo_tpu.traceql.conditions import extract_conditions  # noqa: F401

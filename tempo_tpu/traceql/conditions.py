@@ -1,0 +1,148 @@
+"""Condition pushdown: AST → FetchSpansRequest (reference
+`pkg/traceql/ast_conditions.go`, `storage.go`).
+
+The fetch layer receives a flat list of per-attribute predicates plus the
+`all_conditions` flag: when True every condition must hold on a span for it
+to be a candidate (pure AND tree → storage can intersect masks and skip the
+second pass for simple queries); when False conditions are hints (OR
+semantics) and the engine's second pass decides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from tempo_tpu.traceql import ast as A
+
+
+@dataclasses.dataclass(frozen=True)
+class Condition:
+    attr: A.Attribute
+    op: Optional[A.Op] = None        # None = fetch the column only (select)
+    operands: tuple = ()             # tuple[Static]
+
+    def __str__(self) -> str:
+        ops = ",".join(str(o) for o in self.operands)
+        return f"{self.attr}{'' if self.op is None else self.op.value}{ops}"
+
+
+@dataclasses.dataclass
+class FetchSpansRequest:
+    conditions: list
+    all_conditions: bool
+    start_ns: int = 0
+    end_ns: int = 0
+    second_pass_conditions: list = dataclasses.field(default_factory=list)
+
+    def add(self, c: Condition) -> None:
+        if c not in self.conditions:
+            self.conditions.append(c)
+
+
+_ALWAYS_SECOND_PASS = {A.Op.NOT}  # negations can't prune at storage
+
+
+def extract_conditions(q: A.Pipeline, start_ns: int = 0,
+                       end_ns: int = 0) -> FetchSpansRequest:
+    req = FetchSpansRequest(conditions=[], all_conditions=True,
+                            start_ns=start_ns, end_ns=end_ns)
+    # all_conditions only survives a single-filter pipeline with a pure AND
+    # tree (ast_conditions.go SpansetFilter.extractConditions)
+    filters = [s for s in q.stages if isinstance(s, A.SpansetFilter)]
+    non_filters = [s for s in q.stages if not isinstance(s, A.SpansetFilter)]
+    structural = any(isinstance(s, (A.StructuralExpr, A.SpansetCombine))
+                     for s in q.stages)
+    if len(filters) != 1 or structural:
+        req.all_conditions = False
+    for stage in q.stages:
+        _extract_stage(stage, req)
+    if q.metrics is not None:
+        if q.metrics.attr is not None:
+            _collect_columns(q.metrics.attr, req)
+        for e in q.metrics.by:
+            _collect_columns(e, req)
+        if q.metrics.compare_filter is not None:
+            _collect_columns(q.metrics.compare_filter, req)
+        # metrics need span start time for step bucketing
+        req.add(Condition(A.Attribute.intrinsic_of(A.Intrinsic.SPAN_START_TIME)))
+    # aggregates/scalar filters pull their referenced columns too
+    for s in non_filters:
+        if isinstance(s, A.ScalarFilter):
+            for side in (s.lhs, s.rhs):
+                if isinstance(side, A.AggregateExpr) and side.expr is not None:
+                    _collect_columns(side.expr, req)
+        elif isinstance(s, (A.GroupOp,)):
+            for e in s.by:
+                _collect_columns(e, req)
+        elif isinstance(s, A.SelectOp):
+            for e in s.attrs:
+                _collect_columns(e, req)
+    return req
+
+
+def _extract_stage(stage, req: FetchSpansRequest) -> None:
+    if isinstance(stage, A.SpansetFilter):
+        _extract_expr(stage.expr, req, top_level=True)
+    elif isinstance(stage, (A.StructuralExpr, A.SpansetCombine)):
+        _extract_stage(stage.lhs, req)
+        _extract_stage(stage.rhs, req)
+
+
+def _extract_expr(e, req: FetchSpansRequest, top_level: bool = False) -> None:
+    """Walk a boolean field expression, emitting Conditions.
+
+    AND keeps all_conditions; OR flips it off (conditions become hints);
+    anything non-extractable (cross-attribute compare, arithmetic) also
+    clears the flag but still registers column fetches.
+    """
+    if isinstance(e, A.Static):
+        return
+    if isinstance(e, A.BinaryOp):
+        if e.op == A.Op.AND:
+            _extract_expr(e.lhs, req, top_level)
+            _extract_expr(e.rhs, req, top_level)
+            return
+        if e.op == A.Op.OR:
+            req.all_conditions = False
+            _extract_expr(e.lhs, req)
+            _extract_expr(e.rhs, req)
+            return
+        # comparison attr <op> static (either side)
+        lhs, rhs, op = e.lhs, e.rhs, e.op
+        if isinstance(rhs, A.Attribute) and isinstance(lhs, A.Static):
+            lhs, rhs = rhs, lhs
+            op = _flip(op)
+        if isinstance(lhs, A.Attribute) and isinstance(rhs, A.Static) and \
+                op in (A.Op.EQ, A.Op.NEQ, A.Op.REGEX, A.Op.NOT_REGEX,
+                       A.Op.GT, A.Op.GTE, A.Op.LT, A.Op.LTE):
+            req.add(Condition(lhs, op, (rhs,)))
+            return
+        # non-pushable comparison: fetch referenced columns, clear the flag
+        req.all_conditions = False
+        _collect_columns(e.lhs, req)
+        _collect_columns(e.rhs, req)
+        return
+    if isinstance(e, A.UnaryOp):
+        req.all_conditions = False
+        _collect_columns(e.expr, req)
+        return
+    if isinstance(e, A.Attribute):
+        # bare boolean attribute `{ .error }`
+        req.add(Condition(e, A.Op.EQ, (A.Static(A.StaticType.BOOL, True),)))
+        return
+
+
+def _collect_columns(e, req: FetchSpansRequest) -> None:
+    if isinstance(e, A.Attribute):
+        req.add(Condition(e))
+    elif isinstance(e, A.BinaryOp):
+        _collect_columns(e.lhs, req)
+        _collect_columns(e.rhs, req)
+    elif isinstance(e, A.UnaryOp):
+        _collect_columns(e.expr, req)
+
+
+def _flip(op: A.Op) -> A.Op:
+    return {A.Op.GT: A.Op.LT, A.Op.GTE: A.Op.LTE,
+            A.Op.LT: A.Op.GT, A.Op.LTE: A.Op.GTE}.get(op, op)

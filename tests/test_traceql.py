@@ -1,0 +1,299 @@
+"""TraceQL parser + evaluator tests (modeled on the reference's
+`pkg/traceql/parse_test.go` and `ast_execute_test.go` table style)."""
+
+import numpy as np
+import pytest
+
+from tempo_tpu.traceql import ast as A
+from tempo_tpu.traceql import parse, ParseError
+from tempo_tpu.traceql.conditions import extract_conditions
+from tempo_tpu.traceql.eval import evaluate_pipeline
+from tempo_tpu.traceql.memview import view_from_traces
+
+# ---------------------------------------------------------------------------
+# parse
+# ---------------------------------------------------------------------------
+
+ROUND_TRIPS = [
+    "{ }",
+    '{ .foo = "bar" }',
+    "{ span.http.status_code >= 500 }",
+    '{ (resource.service.name = "api") && (duration > 100ms) }',
+    "{ (status = error) || (status = unset) }",
+    "{ kind = server }",
+    '{ name =~ "GET.*" } | count() > 2',
+    "{ .a } && { .b }",
+    "{ .a } >> { .b } | avg(duration) > 1s",
+    "{ } | by(resource.service.name) | count() > 10 | coalesce()",
+    "{ parent.span.foo = 1 }",
+    '{ (trace:id = "abc") && (span:id != "def") }',
+    '{ event:name = "exception" }',
+    "{ duration > 1s } | rate() by(span.http.status_code)",
+    "{ } | quantile_over_time(duration, 0.5, 0.99) by(span.region)",
+    "{ status = error } | count_over_time() with (exemplars=true)",
+    "{ } | histogram_over_time(duration)",
+    "{ .a = 1 } !>> { .b = 2 }",
+    "{ .a = 1 } &~ { .b = 2 }",
+    "{ childCount > 3 }",
+    '{ span."attr with space" = true }',
+    "{ nestedSetParent = -1 }",
+]
+
+
+@pytest.mark.parametrize("q", ROUND_TRIPS)
+def test_parse_round_trip(q):
+    assert str(parse(str(parse(q)))) == str(parse(q))
+
+
+@pytest.mark.parametrize("q", [
+    "{",
+    "{ .foo = }",
+    "{ .foo ! 3 }",
+    "{ } | frobnicate()",
+    "{ } | count(",
+    "{ } | rate() by(",
+    "{ span: }",
+    "{ trace:nope = 1 }",
+])
+def test_parse_errors(q):
+    with pytest.raises(ParseError):
+        parse(q)
+
+
+def test_duration_units():
+    p = parse("{ duration > 1h30m }")
+    cond = p.stages[0].expr
+    assert cond.rhs.value == 90 * 60 * 1_000_000_000
+    assert parse("{ duration > 100ms }").stages[0].expr.rhs.value == 100_000_000
+
+
+def test_status_enum_order_matches_reference():
+    # error=0, ok=1, unset=2 (enum_statics.go)
+    assert parse("{ status = error }").stages[0].expr.rhs.value == 0
+    assert parse("{ status = ok }").stages[0].expr.rhs.value == 1
+    assert parse("{ status = unset }").stages[0].expr.rhs.value == 2
+
+
+# ---------------------------------------------------------------------------
+# condition extraction
+# ---------------------------------------------------------------------------
+
+def test_conditions_all_and():
+    req = extract_conditions(parse('{ .foo = "bar" && duration > 1s }'))
+    assert req.all_conditions
+    assert len(req.conditions) == 2
+
+
+def test_conditions_or_clears_flag():
+    req = extract_conditions(parse('{ .foo = "bar" || duration > 1s }'))
+    assert not req.all_conditions
+    assert len(req.conditions) == 2
+
+
+def test_conditions_cross_attr_fetch_only():
+    req = extract_conditions(parse("{ span.a > span.b }"))
+    assert not req.all_conditions
+    ops = {c.op for c in req.conditions}
+    assert ops == {None}  # column fetches only
+
+
+def test_conditions_structural_clears_flag():
+    req = extract_conditions(parse("{ .a = 1 } >> { .b = 2 }"))
+    assert not req.all_conditions
+    assert len(req.conditions) == 2
+
+
+# ---------------------------------------------------------------------------
+# evaluation
+# ---------------------------------------------------------------------------
+
+def make_trace(tid, spans):
+    """spans: list of (span_id, parent_id, name, dur_ms, extra)"""
+    out = []
+    for sid, pid, name, dur_ms, extra in spans:
+        s = {
+            "span_id": sid, "parent_span_id": pid, "name": name,
+            "service": extra.get("service", "svc"),
+            "kind": extra.get("kind", 2),
+            "status_code": extra.get("status_code", 0),
+            "start_unix_nano": extra.get("start", 1_000_000_000_000),
+            "end_unix_nano": extra.get("start", 1_000_000_000_000) + dur_ms * 1_000_000,
+            "attrs": extra.get("attrs", {}),
+            "res_attrs": extra.get("res_attrs", {}),
+            "events": extra.get("events", []),
+        }
+        out.append(s)
+    return (tid, out)
+
+
+@pytest.fixture
+def view():
+    t1 = make_trace(b"\x01" * 16, [
+        (b"a" * 8, b"", "root", 100, {"attrs": {"http.status_code": 200}}),
+        (b"b" * 8, b"a" * 8, "child1", 50,
+         {"attrs": {"http.status_code": 500, "err": True}, "status_code": 2}),
+        (b"c" * 8, b"b" * 8, "leaf", 20, {"attrs": {"region": "us"}}),
+    ])
+    t2 = make_trace(b"\x02" * 16, [
+        (b"d" * 8, b"", "root2", 10, {"service": "other"}),
+        (b"e" * 8, b"d" * 8, "child2", 5, {"attrs": {"region": "eu"}}),
+    ])
+    return view_from_traces([t1, t2])
+
+
+def q(view, src):
+    return evaluate_pipeline(parse(src), view)
+
+
+def test_eval_name_filter(view):
+    res = q(view, '{ name = "child1" }')
+    assert len(res) == 1 and len(res[0].rows) == 1
+
+
+def test_eval_attr_number(view):
+    res = q(view, "{ span.http.status_code >= 500 }")
+    assert sum(len(s.rows) for s in res) == 1
+
+
+def test_eval_unscoped_fallback(view):
+    res = q(view, "{ .region = `us` }")
+    assert sum(len(s.rows) for s in res) == 1
+
+
+def test_eval_status_error(view):
+    res = q(view, "{ status = error }")
+    assert sum(len(s.rows) for s in res) == 1
+
+
+def test_eval_bool_bare_attr(view):
+    res = q(view, "{ .err }")
+    assert sum(len(s.rows) for s in res) == 1
+
+
+def test_eval_duration(view):
+    res = q(view, "{ duration >= 50ms }")
+    assert sum(len(s.rows) for s in res) == 2  # root(100ms) + child1(50ms)
+
+
+def test_eval_nil(view):
+    res = q(view, "{ .region != nil }")
+    assert sum(len(s.rows) for s in res) == 2
+
+
+def test_eval_regex(view):
+    res = q(view, '{ name =~ "child.*" }')
+    assert sum(len(s.rows) for s in res) == 2
+    res = q(view, '{ name !~ "child.*" }')
+    assert sum(len(s.rows) for s in res) == 3  # root, leaf, root2
+
+
+def test_eval_mismatched_types_false(view):
+    res = q(view, '{ span.http.status_code = "500" }')
+    assert sum(len(s.rows) for s in res) == 0
+
+
+def test_eval_child_op(view):
+    # {root} > {child}: children of root-matching spans
+    res = q(view, '{ name = "root" } > { }')
+    assert sum(len(s.rows) for s in res) == 1
+    names = view.col("name").values[res[0].rows]
+    assert list(names) == ["child1"]
+
+
+def test_eval_descendant_op(view):
+    res = q(view, '{ name = "root" } >> { }')
+    assert sum(len(s.rows) for s in res) == 2  # child1, leaf
+
+
+def test_eval_ancestor_op(view):
+    res = q(view, '{ name = "leaf" } << { }')
+    assert sum(len(s.rows) for s in res) == 2  # root, child1
+
+
+def test_eval_sibling_none(view):
+    res = q(view, '{ name = "child1" } ~ { }')
+    assert sum(len(s.rows) for s in res) == 0
+
+
+def test_eval_not_descendant(view):
+    res = q(view, '{ name = "root" } !>> { }')
+    # falseForAll semantics (ast_execute.go:114): B spans where the relation
+    # holds for NO A span — trace2 has no A spans, so all its spans match
+    names = {str(n) for s in res for n in view.col("name").values[s.rows]}
+    assert names == {"root", "root2", "child2"}
+
+
+def test_eval_union_descendant(view):
+    res = q(view, '{ name = "root" } &>> { name = "leaf" }')
+    names = {str(n) for s in res for n in view.col("name").values[s.rows]}
+    assert names == {"root", "leaf"}
+
+
+def test_eval_spanset_and(view):
+    res = q(view, '{ name = "root" } && { name = "leaf" }')
+    assert sum(len(s.rows) for s in res) == 2
+    res = q(view, '{ name = "root" } && { name = "nope" }')
+    assert len(res) == 0
+
+
+def test_eval_spanset_or(view):
+    res = q(view, '{ name = "root2" } || { name = "leaf" }')
+    assert sum(len(s.rows) for s in res) == 2
+
+
+def test_eval_count_filter(view):
+    res = q(view, "{ } | count() > 2")
+    assert len(res) == 1  # only trace1 has 3 spans
+    assert res[0].scalars["count()"] == 3.0
+
+
+def test_eval_avg_duration(view):
+    res = q(view, "{ } | avg(duration) > 50ms")
+    assert len(res) == 1  # trace1 avg ≈ 56.7ms; trace2 7.5ms
+
+
+def test_eval_by_group(view):
+    res = q(view, "{ } | by(resource.service.name)")
+    keys = {s.group_attrs[0][1] for s in res}
+    assert keys == {"svc", "other"}
+
+
+def test_eval_parent_attr(view):
+    res = q(view, '{ parent.http.status_code = 200 }')
+    # child1's parent (root) has status 200
+    names = {str(n) for s in res for n in view.col("name").values[s.rows]}
+    assert names == {"child1"}
+
+
+def test_eval_childcount(view):
+    res = q(view, "{ childCount = 1 }")
+    assert sum(len(s.rows) for s in res) == 3  # root, child1, root2
+
+
+def test_eval_root_intrinsics(view):
+    res = q(view, '{ rootName = "root2" }')
+    assert sum(len(s.rows) for s in res) == 2  # whole trace2
+    res = q(view, '{ rootServiceName = "svc" }')
+    assert sum(len(s.rows) for s in res) == 3
+
+
+def test_eval_trace_duration(view):
+    res = q(view, "{ traceDuration >= 100ms }")
+    assert sum(len(s.rows) for s in res) == 3  # all of trace1
+
+
+def test_eval_arithmetic(view):
+    res = q(view, "{ duration * 2 > 150ms }")
+    assert sum(len(s.rows) for s in res) == 1  # root only (100ms*2)
+
+
+def test_eval_events(view):
+    t = make_trace(b"\x03" * 16, [
+        (b"f" * 8, b"", "evspan", 10,
+         {"events": [{"name": "exception", "time_unix_nano": 1}]}),
+    ])
+    v = view_from_traces([t])
+    res = q(v, '{ event:name = "exception" }')
+    assert sum(len(s.rows) for s in res) == 1
+    res = q(v, '{ event:name = "other" }')
+    assert sum(len(s.rows) for s in res) == 0
