@@ -38,7 +38,7 @@ CORE_FIELDS = [
     ("trace_idx", pa.int32()),
     ("span_id", pa.binary(8)),
     ("parent_span_id", pa.binary(8)),
-    ("parent_row", pa.int32()),      # absolute row of parent within block; -1 root
+    ("parent_row", pa.int32()),      # parent span's index WITHIN its trace; -1 root
     ("nested_left", pa.int32()),
     ("nested_right", pa.int32()),
     ("is_root", pa.bool_()),
@@ -201,7 +201,6 @@ def traces_to_table(traces: Iterable[tuple[bytes, list[dict]]],
     ded_names = [dedicated_field_name(c.scope, i) for i, c in enumerate(dedicated)]
     for dn in ded_names:
         cols[dn] = []
-    row_base = 0
     for t_idx, (trace_id, spans) in enumerate(traces):
         sids = [s.get("span_id", b"") for s in spans]
         pids = [s.get("parent_span_id", b"") for s in spans]
@@ -211,8 +210,7 @@ def traces_to_table(traces: Iterable[tuple[bytes, list[dict]]],
             cols["trace_idx"].append(t_idx)
             cols["span_id"].append((sids[j] or b"").ljust(8, b"\0")[:8])
             cols["parent_span_id"].append((pids[j] or b"").ljust(8, b"\0")[:8])
-            cols["parent_row"].append(
-                row_base + parent_local[j] if parent_local[j] >= 0 else -1)
+            cols["parent_row"].append(parent_local[j])
             cols["nested_left"].append(left[j])
             cols["nested_right"].append(right[j])
             cols["is_root"].append(parent_local[j] < 0)
@@ -246,7 +244,6 @@ def traces_to_table(traces: Iterable[tuple[bytes, list[dict]]],
                 src = s.get("attrs") if dc.scope == "span" else s.get("res_attrs")
                 v = (src or {}).get(dc.name)
                 cols[dn].append(None if v is None else str(v))
-        row_base += len(spans)
     schema = block_schema(dedicated)
     return pa.Table.from_pydict({n: cols[n] for n in schema.names}, schema=schema)
 

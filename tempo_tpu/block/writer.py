@@ -54,6 +54,34 @@ def write_block(
         compaction_level=compaction_level, compression=compression)
 
 
+def _trace_aligned_slices(table: pa.Table, target_rows: int) -> list[tuple[int, int]]:
+    """Row ranges for row groups: >= target_rows each but never splitting a
+    trace (trace_idx runs are kept whole)."""
+    n = table.num_rows
+    if n == 0:
+        return []
+    import numpy as np
+
+    tidx = table.column("trace_idx").to_numpy()
+    # first row of each trace
+    starts = np.flatnonzero(np.diff(tidx, prepend=tidx[0] - 1))
+    out = []
+    lo = 0
+    while lo < n:
+        want = lo + target_rows
+        if want >= n:
+            out.append((lo, n))
+            break
+        # next trace boundary at or after `want`
+        j = int(np.searchsorted(starts, want, side="left"))
+        hi = int(starts[j]) if j < len(starts) else n
+        if hi <= lo:
+            hi = n
+        out.append((lo, hi))
+        lo = hi
+    return out
+
+
 def write_block_from_table(
     w: RawWriter,
     tenant: str,
@@ -80,11 +108,16 @@ def write_block_from_table(
     )
     kp = block_keypath(meta.block_id, tenant)
 
-    # data.parquet — dictionary+RLE on string columns, zstd pages.
+    # data.parquet — dictionary+RLE on string columns, zstd pages. Row groups
+    # are cut at TRACE boundaries (unlike naive row_group_size) so every scan
+    # batch holds whole traces: structural operators and per-trace reductions
+    # evaluate within one row group with no stitching.
     buf = io.BytesIO()
-    pq.write_table(table, buf, row_group_size=max(row_group_rows, 1),
-                   compression=compression, use_dictionary=True,
-                   write_statistics=True)
+    writer = pq.ParquetWriter(buf, table.schema, compression=compression,
+                              use_dictionary=True, write_statistics=True)
+    for lo, hi in _trace_aligned_slices(table, max(row_group_rows, 1)):
+        writer.write_table(table.slice(lo, hi - lo), row_group_size=hi - lo)
+    writer.close()
     data = buf.getvalue()
     w.write(DATA_NAME, kp, data)
 

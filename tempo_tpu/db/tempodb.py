@@ -72,8 +72,12 @@ class TempoDB:
     def backend_block(self, meta: bm.BlockMeta) -> BackendBlock:
         key = (meta.tenant_id, meta.block_id)
         b = self._block_cache.get(key)
-        if b is None or b.meta.block_id != meta.block_id:
+        if b is None or b.meta.size_bytes != meta.size_bytes:
+            # size change means the object was rewritten; otherwise refresh
+            # the meta reference and keep the parsed parquet footer
             b = self._block_cache[key] = BackendBlock(self.r, meta)
+        else:
+            b.meta = meta
         return b
 
     def _evict_dead_blocks(self, tenant: str) -> None:
@@ -122,7 +126,7 @@ class TempoDB:
     def poll_now(self) -> None:
         metas, compacted = self.poller.do()
         self.blocklist.apply_poll_results(metas, compacted)
-        for tenant in self.blocklist.tenants():
+        for tenant in {k[0] for k in self._block_cache}:
             self._evict_dead_blocks(tenant)
 
     def enable_polling(self, interval_s: float | None = None) -> None:

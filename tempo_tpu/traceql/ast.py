@@ -203,7 +203,8 @@ class Attribute:
             return self.intrinsic.value
         p = "parent." if self.parent else ""
         name = self.name
-        if re.search(r'[\s{}()|,=!<>~&+*/%^"]', name):
+        # quote unless the lexer's raw-attr scanner would re-read it intact
+        if not re.fullmatch(r'[^\s{}()|,=!<>~&+\-*/%^"]+', name):
             name = '"' + name.replace("\\", "\\\\").replace('"', '\\"') + '"'
         if self.scope == Scope.NONE:
             return f"{p}.{name}"

@@ -21,6 +21,10 @@ class Condition:
     attr: A.Attribute
     op: Optional[A.Op] = None        # None = fetch the column only (select)
     operands: tuple = ()             # tuple[Static]
+    # True when this fetch-only condition came from a filter expression the
+    # storage layer can't evaluate (negation / cross-attribute compare): the
+    # prefilter must not exclude rows based on sibling predicates then
+    from_filter: bool = False
 
     def __str__(self) -> str:
         ops = ",".join(str(o) for o in self.operands)
@@ -34,6 +38,10 @@ class FetchSpansRequest:
     start_ns: int = 0
     end_ns: int = 0
     second_pass_conditions: list = dataclasses.field(default_factory=list)
+    # True when some pipeline arm matches spans unconditionally (`{ }` in an
+    # OR, rhs of a structural op, ...): the storage prefilter must pass every
+    # row through, since any span may participate in the second pass
+    has_unconditioned_arm: bool = False
 
     def add(self, c: Condition) -> None:
         if c not in self.conditions:
@@ -83,7 +91,11 @@ def extract_conditions(q: A.Pipeline, start_ns: int = 0,
 
 def _extract_stage(stage, req: FetchSpansRequest) -> None:
     if isinstance(stage, A.SpansetFilter):
+        before = len(req.conditions)
         _extract_expr(stage.expr, req, top_level=True)
+        pushed = any(c.op is not None for c in req.conditions[before:])
+        if not pushed:
+            req.has_unconditioned_arm = True
     elif isinstance(stage, (A.StructuralExpr, A.SpansetCombine)):
         _extract_stage(stage.lhs, req)
         _extract_stage(stage.rhs, req)
@@ -120,12 +132,12 @@ def _extract_expr(e, req: FetchSpansRequest, top_level: bool = False) -> None:
             return
         # non-pushable comparison: fetch referenced columns, clear the flag
         req.all_conditions = False
-        _collect_columns(e.lhs, req)
-        _collect_columns(e.rhs, req)
+        _collect_columns(e.lhs, req, from_filter=True)
+        _collect_columns(e.rhs, req, from_filter=True)
         return
     if isinstance(e, A.UnaryOp):
         req.all_conditions = False
-        _collect_columns(e.expr, req)
+        _collect_columns(e.expr, req, from_filter=True)
         return
     if isinstance(e, A.Attribute):
         # bare boolean attribute `{ .error }`
@@ -133,14 +145,14 @@ def _extract_expr(e, req: FetchSpansRequest, top_level: bool = False) -> None:
         return
 
 
-def _collect_columns(e, req: FetchSpansRequest) -> None:
+def _collect_columns(e, req: FetchSpansRequest, from_filter: bool = False) -> None:
     if isinstance(e, A.Attribute):
-        req.add(Condition(e))
+        req.add(Condition(e, from_filter=from_filter))
     elif isinstance(e, A.BinaryOp):
-        _collect_columns(e.lhs, req)
-        _collect_columns(e.rhs, req)
+        _collect_columns(e.lhs, req, from_filter)
+        _collect_columns(e.rhs, req, from_filter)
     elif isinstance(e, A.UnaryOp):
-        _collect_columns(e.expr, req)
+        _collect_columns(e.expr, req, from_filter)
 
 
 def _flip(op: A.Op) -> A.Op:

@@ -1,0 +1,542 @@
+"""TraceQL metrics engine: `query_range` aggregation on device grids.
+
+Reference: `pkg/traceql/engine_metrics.go`. The reference's aggregator stack
+(`GroupingAggregator` → per-series `StepAggregator` → `VectorAggregator`,
+engine_metrics.go:332-537) walks spans one at a time; here each batch of
+matching spans becomes three aligned vectors (series slot, step index,
+value) and ONE scatter op updates a `[series, steps]` (or
+`[series, steps, 64]` for histograms) device grid:
+
+    rate/count_over_time  → grid.at[slot, step].add(w)
+    min/max_over_time     → grid.at[slot, step].min/max(v)
+    sum/avg_over_time     → add grids (+ count grid for avg)
+    quantile/histogram    → grid.at[slot, step, log2bucket(v)].add(w)
+
+Job-level results are raw series (AggregateModeSum); the frontend combiner
+sums them and computes quantiles from log2 buckets with linear interpolation
+— `Log2Quantile` (engine_metrics.go:1402-1468) — so cross-shard merges stay
+pure tensor adds (psum-able across a mesh).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Iterable, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tempo_tpu.traceql import ast as A
+from tempo_tpu.traceql.conditions import extract_conditions
+from tempo_tpu.traceql.eval import (NUM, Col, ColumnView, eval_expr,
+                                    evaluate_pipeline, resolve_attr)
+from tempo_tpu.traceql.parser import parse
+
+# log2 histogram geometry (shared with `pkg/traceqlmetrics` 64-bucket layout)
+HBUCKETS = 64
+# bucket b holds values in (2^(b-1), 2^b] nanoseconds; b=0 holds <=1ns
+_LABEL_BUCKET = "__bucket"
+_LABEL_META = "__meta_type"
+
+
+def log2_bucket_np(values_ns: np.ndarray) -> np.ndarray:
+    v = np.maximum(values_ns.astype(np.float64), 1.0)
+    return np.clip(np.ceil(np.log2(v)), 0, HBUCKETS - 1).astype(np.int32)
+
+
+def log2_quantile(q: float, buckets: np.ndarray) -> float:
+    """Interpolated quantile from a [HBUCKETS] count vector; returns seconds.
+
+    Mirrors `Log2Quantile` (engine_metrics.go:1402): find the bucket holding
+    the q-th sample, then interpolate within its (2^(b-1), 2^b] range.
+    """
+    total = buckets.sum()
+    if total <= 0:
+        return 0.0
+    target = max(q * total, 1e-12)  # q=0 → lower edge of first nonempty bucket
+    csum = np.cumsum(buckets)
+    b = int(np.searchsorted(csum, target, side="left"))
+    b = min(b, HBUCKETS - 1)
+    prev = csum[b - 1] if b > 0 else 0.0
+    inbucket = buckets[b]
+    frac = (target - prev) / inbucket if inbucket > 0 else 0.0
+    lo = 0.0 if b == 0 else 2.0 ** (b - 1)
+    hi = 2.0 ** b
+    return (lo + (hi - lo) * frac) / 1e9
+
+
+@dataclasses.dataclass
+class QueryRangeRequest:
+    query: str
+    start_ns: int
+    end_ns: int
+    step_ns: int
+    exemplars: int = 100
+
+    @property
+    def n_steps(self) -> int:
+        return max(int(math.ceil((self.end_ns - self.start_ns) / self.step_ns)), 1)
+
+    def step_timestamps_ms(self) -> list[int]:
+        # samples are stamped at interval END, like IntervalOfMs consumers
+        return [int((self.start_ns + (i + 1) * self.step_ns) / 1e6)
+                for i in range(self.n_steps)]
+
+
+@dataclasses.dataclass
+class TimeSeries:
+    labels: tuple            # ((name, value), ...)
+    samples: np.ndarray      # [n_steps] float64
+    exemplars: list = dataclasses.field(default_factory=list)
+
+    def key(self) -> tuple:
+        return self.labels
+
+    def to_json(self, ts_ms: list[int]) -> dict:
+        return {
+            "labels": [{"key": k, "value": {"stringValue": str(v)}}
+                       for k, v in self.labels],
+            "samples": [{"timestampMs": str(t), "value": float(v)}
+                        for t, v in zip(ts_ms, self.samples)],
+            "exemplars": self.exemplars,
+        }
+
+
+# ---------------------------------------------------------------------------
+# device kernels (jit-cached per (capacity, steps) shape bucket)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, donate_argnums=0)
+def _scatter_add2(grid, slots, steps, w):
+    return grid.at[slots, steps].add(w, mode="drop")
+
+
+@partial(jax.jit, donate_argnums=0)
+def _scatter_min2(grid, slots, steps, v):
+    return grid.at[slots, steps].min(v, mode="drop")
+
+
+@partial(jax.jit, donate_argnums=0)
+def _scatter_max2(grid, slots, steps, v):
+    return grid.at[slots, steps].max(v, mode="drop")
+
+
+@partial(jax.jit, donate_argnums=0)
+def _scatter_add3(grid, slots, steps, buckets, w):
+    return grid.at[slots, steps, buckets].add(w, mode="drop")
+
+
+def _pad_pow2(n: int, lo: int = 256) -> int:
+    c = lo
+    while c < n:
+        c *= 2
+    return c
+
+
+class _SeriesIndex:
+    """Host-side series table: group-key tuple → dense slot (the string side
+    of `GroupingAggregator`; device arrays never see strings)."""
+
+    def __init__(self):
+        self.slots: dict[tuple, int] = {}
+        self.keys: list[tuple] = []
+
+    def lookup(self, keys: list[tuple]) -> np.ndarray:
+        out = np.empty(len(keys), np.int32)
+        for i, k in enumerate(keys):
+            s = self.slots.get(k)
+            if s is None:
+                s = self.slots[k] = len(self.keys)
+                self.keys.append(k)
+            out[i] = s
+        return out
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+
+class MetricsEvaluator:
+    """Raw (storage-level) evaluator: observe batches, hold device grids.
+
+    `CompileMetricsQueryRange` analog (engine_metrics.go:802): one instance
+    per job; `observe(view)` per scan batch; `results()` → job-level series.
+    """
+
+    def __init__(self, req: QueryRangeRequest):
+        self.req = req
+        self.q = parse(req.query)
+        if self.q.metrics is None:
+            raise ValueError("not a metrics query: " + req.query)
+        self.m = self.q.metrics
+        self.fetch_req = extract_conditions(self.q, req.start_ns, req.end_ns)
+        self.series = _SeriesIndex()
+        self.n_steps = req.n_steps
+        self._cap = 0
+        self._grids: dict[str, jax.Array] = {}
+        self._exemplars: dict[int, list] = {}
+        self._ex_total = 0
+        k = self.m.kind
+        self._hist = k in (A.MetricsKind.QUANTILE_OVER_TIME,
+                           A.MetricsKind.HISTOGRAM_OVER_TIME)
+        self._is_compare = k == A.MetricsKind.COMPARE
+        # `| rate()` with a single filter needs no second pass when the
+        # pushdown covers it (optimize() engine_metrics.go:885)
+        self._need_second_pass = not (
+            self.fetch_req.all_conditions
+            and k in (A.MetricsKind.RATE, A.MetricsKind.COUNT_OVER_TIME)
+            and not self._is_compare)
+
+    # -- state management ---------------------------------------------------
+
+    def _ensure_capacity(self) -> None:
+        need = _pad_pow2(max(len(self.series), 1))
+        if need <= self._cap:
+            return
+        old, self._cap = self._grids, need
+
+        def grow(name, fill, shape_tail=()):
+            g = jnp.full((need, self.n_steps) + shape_tail, fill, jnp.float32)
+            if name in old:
+                o = old[name]
+                g = g.at[: o.shape[0]].set(o)
+            self._grids[name] = g
+
+        k = self.m.kind
+        if self._hist:
+            grow("hist", 0.0, (HBUCKETS,))
+        elif k in (A.MetricsKind.RATE, A.MetricsKind.COUNT_OVER_TIME):
+            grow("count", 0.0)
+        elif k == A.MetricsKind.MIN_OVER_TIME:
+            grow("min", jnp.inf)
+        elif k == A.MetricsKind.MAX_OVER_TIME:
+            grow("max", -jnp.inf)
+        elif k == A.MetricsKind.SUM_OVER_TIME:
+            grow("sum", 0.0)
+        elif k == A.MetricsKind.AVG_OVER_TIME:
+            grow("sum", 0.0)
+            grow("count", 0.0)
+        elif self._is_compare:
+            grow("sel", 0.0)
+            grow("base", 0.0)
+
+    # -- observation --------------------------------------------------------
+
+    def observe(self, view: ColumnView) -> None:
+        rows = self._matching_rows(view)
+        if len(rows) == 0:
+            return
+        st = view.col("__startTime")
+        if st is None:
+            return
+        ts = st.values[rows]
+        step = ((ts - self.req.start_ns) / self.req.step_ns).astype(np.int64)
+        inside = (step >= 0) & (step < self.n_steps) & \
+                 (ts >= self.req.start_ns) & (ts < self.req.end_ns)
+        rows, step = rows[inside], step[inside]
+        if len(rows) == 0:
+            return
+
+        if self._is_compare:
+            self._observe_compare(view, rows, step)
+            return
+
+        # group-by key columns → host series slots
+        grouped = self._group_keys(view, rows)
+        if grouped is None:
+            slots = np.zeros(len(rows), np.int32)
+            self.series.lookup([()])
+        else:
+            keep, key_tuples = grouped
+            rows, step = rows[keep], step[keep]
+            if len(rows) == 0:
+                return
+            slots = self.series.lookup(key_tuples)
+        self._ensure_capacity()
+
+        vals = None
+        if self.m.attr is not None:
+            c = eval_expr(view, self.m.attr)
+            if c.t != NUM:
+                return
+            vexists = c.exists[rows]
+            rows, step, slots = rows[vexists], step[vexists], slots[vexists]
+            if len(rows) == 0:
+                return
+            vals = c.values[rows].astype(np.float64)
+            # duration intrinsics aggregate in SECONDS (reference converts
+            # ns→s before the vector aggregators); histogram buckets keep ns
+            # since log2 geometry is scale-consistent (labels divide by 1e9)
+            if not self._hist and _is_duration_attr(self.m.attr):
+                vals = vals / 1e9
+
+        # pad update vectors to pow2 sizes: stable shapes → one jit cache
+        # entry per bucket. Padding rows use slot index == capacity, which is
+        # out of bounds and dropped (mode="drop"); never -1 (jax wraps it).
+        size = _pad_pow2(len(rows), 64)
+        pad = size - len(rows)
+        jslots = jnp.asarray(np.pad(slots, (0, pad), constant_values=self._cap))
+        jsteps = jnp.asarray(np.pad(step.astype(np.int32), (0, pad)))
+        ones = jnp.asarray(np.pad(np.ones(len(rows), np.float32), (0, pad)))
+        jvals = (jnp.asarray(np.pad(vals.astype(np.float32), (0, pad)))
+                 if vals is not None else None)
+        k = self.m.kind
+        if self._hist:
+            b = jnp.asarray(np.pad(log2_bucket_np(vals), (0, pad)))
+            self._grids["hist"] = _scatter_add3(
+                self._grids["hist"], jslots, jsteps, b, ones)
+        elif k in (A.MetricsKind.RATE, A.MetricsKind.COUNT_OVER_TIME):
+            self._grids["count"] = _scatter_add2(
+                self._grids["count"], jslots, jsteps, ones)
+        elif k == A.MetricsKind.MIN_OVER_TIME:
+            self._grids["min"] = _scatter_min2(
+                self._grids["min"], jslots, jsteps, jvals)
+        elif k == A.MetricsKind.MAX_OVER_TIME:
+            self._grids["max"] = _scatter_max2(
+                self._grids["max"], jslots, jsteps, jvals)
+        elif k == A.MetricsKind.SUM_OVER_TIME:
+            self._grids["sum"] = _scatter_add2(
+                self._grids["sum"], jslots, jsteps, jvals)
+        elif k == A.MetricsKind.AVG_OVER_TIME:
+            self._grids["sum"] = _scatter_add2(
+                self._grids["sum"], jslots, jsteps, jvals)
+            self._grids["count"] = _scatter_add2(
+                self._grids["count"], jslots, jsteps, ones)
+        self._note_exemplars(view, rows, slots)
+
+    def _matching_rows(self, view: ColumnView) -> np.ndarray:
+        if not self._need_second_pass:
+            from tempo_tpu.block.fetch import condition_mask
+
+            return np.flatnonzero(condition_mask(view, self.fetch_req))
+        stripped = A.Pipeline(self.q.stages)  # pipeline minus metrics stage
+        spansets = evaluate_pipeline(stripped, view)
+        if not spansets:
+            return np.empty(0, np.int64)
+        return np.unique(np.concatenate([ss.rows for ss in spansets]))
+
+    def _group_keys(self, view: ColumnView, rows: np.ndarray):
+        """(keep_mask, [key tuples]) or None when there's no by()."""
+        if not self.m.by:
+            return None
+        cols = [(str(e), eval_expr(view, e)) for e in self.m.by]
+        keep = np.ones(len(rows), bool)
+        for _, c in cols:
+            keep &= c.exists[rows]  # spans missing a group key are dropped
+        kept = rows[keep]
+        keys: list[tuple] = []
+        vals = [(name, c.values, c.t) for name, c in cols]
+        for r in kept:
+            keys.append(tuple((name, _fmt_label(v[r], t)) for name, v, t in vals))
+        return keep, keys
+
+    def _observe_compare(self, view: ColumnView, rows: np.ndarray,
+                         step: np.ndarray) -> None:
+        sel_mask = eval_expr(view, self.m.compare_filter).bool_mask()[rows]
+        # count by (attr, value) across a default set of comparison columns:
+        # status + every span attribute present (approximation of the
+        # reference's dynamic attr diff, engine_metrics_compare.go)
+        self._ensure_capacity()
+        for which, m in (("selection", sel_mask), ("baseline", ~sel_mask)):
+            r, s = rows[m], step[m]
+            if len(r) == 0:
+                continue
+            status = view.col("status")
+            keys = [((_LABEL_META, which), ("status", _fmt_label(status.values[x], "status")))
+                    for x in r]
+            slots = self.series.lookup(keys)
+            self._ensure_capacity()
+            size = _pad_pow2(len(r), 64)
+            pad = size - len(r)
+            g = "sel" if which == "selection" else "base"
+            self._grids[g] = _scatter_add2(
+                self._grids[g],
+                jnp.asarray(np.pad(slots, (0, pad), constant_values=self._cap)),
+                jnp.asarray(np.pad(s.astype(np.int32), (0, pad))),
+                jnp.asarray(np.pad(np.ones(len(r), np.float32), (0, pad))))
+
+    def _note_exemplars(self, view, rows, slots) -> None:
+        if self.req.exemplars <= 0 or self._ex_total >= self.req.exemplars:
+            return
+        tid = view.col("trace:id")
+        dur = view.col("duration")
+        if tid is None:
+            return
+        for r, s in zip(rows[:8], slots[:8]):
+            lst = self._exemplars.setdefault(int(s), [])
+            if len(lst) < 2 and self._ex_total < self.req.exemplars:
+                lst.append({
+                    "traceId": str(tid.values[r]),
+                    "value": float(dur.values[r]) if dur is not None else 0.0,
+                    "timestampMs": int(view.col("__startTime").values[r] / 1e6),
+                })
+                self._ex_total += 1
+
+    # -- results ------------------------------------------------------------
+
+    def results(self) -> list[TimeSeries]:
+        """Job-level series (AggregateModeSum — raw sums, no rate division;
+        the frontend applies final math after combining)."""
+        out: list[TimeSeries] = []
+        nseries = len(self.series)
+        if nseries == 0:
+            return out
+        k = self.m.kind
+        if self._hist:
+            hist = np.asarray(self._grids["hist"])[:nseries]
+            for i, key in enumerate(self.series.keys):
+                for b in range(HBUCKETS):
+                    col = hist[i, :, b]
+                    if col.any():
+                        labels = key + ((_LABEL_BUCKET, 2.0 ** b / 1e9),)
+                        out.append(TimeSeries(labels, col.astype(np.float64),
+                                              self._exemplars.get(i, [])))
+            return out
+        if self._is_compare:
+            for g, which in (("sel", "selection"), ("base", "baseline")):
+                grid = np.asarray(self._grids[g])[:nseries]
+                for i, key in enumerate(self.series.keys):
+                    if dict(key).get(_LABEL_META) != which:
+                        continue
+                    if grid[i].any():
+                        out.append(TimeSeries(key, grid[i].astype(np.float64)))
+            return out
+        name = {A.MetricsKind.RATE: "count", A.MetricsKind.COUNT_OVER_TIME: "count",
+                A.MetricsKind.MIN_OVER_TIME: "min", A.MetricsKind.MAX_OVER_TIME: "max",
+                A.MetricsKind.SUM_OVER_TIME: "sum", A.MetricsKind.AVG_OVER_TIME: "sum"}[k]
+        grid = np.asarray(self._grids[name])[:nseries]
+        counts = (np.asarray(self._grids["count"])[:nseries]
+                  if k == A.MetricsKind.AVG_OVER_TIME else None)
+        for i, key in enumerate(self.series.keys):
+            samples = grid[i].astype(np.float64)
+            ts = TimeSeries(key, samples, self._exemplars.get(i, []))
+            out.append(ts)
+            if counts is not None:
+                out.append(TimeSeries(key + (("__meta", "count"),),
+                                      counts[i].astype(np.float64)))
+        return out
+
+
+def _is_duration_attr(attr) -> bool:
+    return isinstance(attr, A.Attribute) and attr.intrinsic in (
+        A.Intrinsic.DURATION, A.Intrinsic.TRACE_DURATION)
+
+
+def _fmt_label(v, t: str) -> str:
+    if t == "status":
+        return A.STATUS_NAMES.get(int(v), "unset")
+    if t == "kind":
+        return A.KIND_NAMES.get(int(v), "unspecified")
+    if t == NUM or t == "num":
+        f = float(v)
+        return str(int(f)) if f.is_integer() else repr(f)
+    if t == "bool":
+        return "true" if v else "false"
+    return str(v)
+
+
+# ---------------------------------------------------------------------------
+# combiner + final pass (frontend level)
+# ---------------------------------------------------------------------------
+
+class SeriesCombiner:
+    """Cross-job series merge: tensor adds (min/max for those aggregates),
+    the `SimpleAggregator`/`HistogramAggregator` combine step
+    (engine_metrics.go:1124,1287)."""
+
+    def __init__(self, kind: A.MetricsKind, n_steps: int):
+        self.kind = kind
+        self.n_steps = n_steps
+        self.series: dict[tuple, TimeSeries] = {}
+
+    def add_all(self, series: Iterable[TimeSeries]) -> None:
+        take_min = self.kind == A.MetricsKind.MIN_OVER_TIME
+        take_max = self.kind == A.MetricsKind.MAX_OVER_TIME
+        for ts in series:
+            cur = self.series.get(ts.key())
+            if cur is None:
+                self.series[ts.key()] = TimeSeries(
+                    ts.labels, ts.samples.copy(), list(ts.exemplars))
+            else:
+                if take_min:
+                    cur.samples = np.minimum(cur.samples, ts.samples)
+                elif take_max:
+                    cur.samples = np.maximum(cur.samples, ts.samples)
+                else:
+                    cur.samples = cur.samples + ts.samples
+                cur.exemplars.extend(ts.exemplars)
+
+    def final(self, req: QueryRangeRequest) -> list[TimeSeries]:
+        """Final pass: rate division, avg division, quantiles from buckets."""
+        q = parse(req.query)
+        kind = q.metrics.kind
+        out: list[TimeSeries] = []
+        if kind == A.MetricsKind.RATE:
+            step_s = req.step_ns / 1e9
+            for ts in self.series.values():
+                out.append(TimeSeries(ts.labels, ts.samples / step_s, ts.exemplars))
+            return out
+        if kind == A.MetricsKind.AVG_OVER_TIME:
+            sums = {k: v for k, v in self.series.items()
+                    if dict(k).get("__meta") != "count"}
+            for key, ts in sums.items():
+                ckey = key + (("__meta", "count"),)
+                cnt = self.series.get(ckey)
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    vals = (ts.samples / cnt.samples) if cnt is not None else ts.samples
+                out.append(TimeSeries(ts.labels, np.nan_to_num(vals), ts.exemplars))
+            return out
+        if kind == A.MetricsKind.QUANTILE_OVER_TIME:
+            return self._quantile_series(q.metrics.params, req)
+        if kind == A.MetricsKind.MIN_OVER_TIME:
+            for ts in self.series.values():
+                s = np.where(np.isfinite(ts.samples), ts.samples, 0.0)
+                out.append(TimeSeries(ts.labels, s, ts.exemplars))
+            return out
+        if kind == A.MetricsKind.MAX_OVER_TIME:
+            for ts in self.series.values():
+                s = np.where(np.isfinite(ts.samples), ts.samples, 0.0)
+                out.append(TimeSeries(ts.labels, s, ts.exemplars))
+            return out
+        return list(self.series.values())
+
+    def _quantile_series(self, qs: tuple, req: QueryRangeRequest) -> list[TimeSeries]:
+        # regroup bucket series by base labels → [steps, HBUCKETS] grids
+        grids: dict[tuple, np.ndarray] = {}
+        exemplars: dict[tuple, list] = {}
+        for ts in self.series.values():
+            labels = dict(ts.labels)
+            if _LABEL_BUCKET not in labels:
+                continue
+            le = float(labels.pop(_LABEL_BUCKET))
+            b = int(np.clip(round(math.log2(max(le * 1e9, 1.0))), 0, HBUCKETS - 1))
+            base = tuple(sorted(labels.items()))
+            g = grids.setdefault(base, np.zeros((req.n_steps, HBUCKETS)))
+            g[:, b] += ts.samples
+            exemplars.setdefault(base, []).extend(ts.exemplars)
+        out = []
+        for base, g in grids.items():
+            for qv in qs:
+                samples = np.fromiter(
+                    (log2_quantile(qv, g[s]) for s in range(req.n_steps)),
+                    np.float64, count=req.n_steps)
+                labels = base + (("p", qv),)
+                out.append(TimeSeries(labels, samples, exemplars.get(base, [])))
+        return out
+
+
+def query_range(req: QueryRangeRequest,
+                view_iter: Iterable[tuple[ColumnView, np.ndarray]],
+                ) -> list[TimeSeries]:
+    """Single-node convenience: evaluate + combine + final in one call."""
+    ev = MetricsEvaluator(req)
+    for view, cand in view_iter:
+        if len(cand) == 0:
+            continue
+        ev.observe(view)
+    comb = SeriesCombiner(ev.m.kind, req.n_steps)
+    comb.add_all(ev.results())
+    return comb.final(req)

@@ -26,6 +26,7 @@ from tempo_tpu.traceql import ast as A
 # column type tags
 NUM, STR, BOOL, STATUS, KIND = "num", "str", "bool", "status", "kind"
 STRLIST, NUMLIST = "strlist", "numlist"  # per-span lists (events/links): "any element matches"
+MIXED = "mixed"  # unscoped attr with different span/resource types (object values)
 
 _STATIC_T = {
     A.StaticType.INT: NUM, A.StaticType.FLOAT: NUM, A.StaticType.DURATION: NUM,
@@ -184,11 +185,13 @@ def resolve_attr(view: ColumnView, a: A.Attribute) -> Col:
             return s
         if s.t == r.t:
             vals = np.where(s.exists, s.values, r.values)
-        else:
-            vals = s.values  # mixed types: span wins where it exists
-            if not s.exists.all():
-                vals = vals.copy()
-        return Col(s.t, vals, s.exists | (r.exists & (s.t == r.t)))
+            return Col(s.t, vals, s.exists | r.exists)
+        # mixed span/resource types: per-row precedence into an object
+        # column; comparisons take the scoped-variant path (_eval_binary)
+        vals = np.empty(len(s.values), object)
+        vals[r.exists] = r.values[r.exists]
+        vals[s.exists] = s.values[s.exists]
+        return Col(MIXED, vals, s.exists | r.exists)
     c = view.col(attr_key(a))
     return c if c is not None else view.missing()
 
@@ -278,6 +281,13 @@ def _eval_binary(view: ColumnView, e: A.BinaryOp) -> Col:
             hits = _strlist_match(l, lambda s: _regex(pattern).fullmatch(s) is not None)
         elif l.t == STR:
             hits = regex_match_col(l.values, l.exists, pattern)
+        elif l.t == MIXED:
+            p = _regex(pattern)
+            hits = np.zeros(n, bool)
+            for i in np.flatnonzero(l.exists):
+                v = l.values[i]
+                if isinstance(v, str) and p.fullmatch(v):
+                    hits[i] = True
         else:
             hits = np.zeros(n, bool)
         if op == A.Op.NOT_REGEX:
@@ -321,9 +331,35 @@ def _strlist_match(c: Col, pred) -> np.ndarray:
 _LIST_CMP = {A.Op.EQ: lambda a, b: a == b, A.Op.NEQ: lambda a, b: a != b,
              A.Op.GT: lambda a, b: a > b, A.Op.GTE: lambda a, b: a >= b,
              A.Op.LT: lambda a, b: a < b, A.Op.LTE: lambda a, b: a <= b}
+_FLIP = {A.Op.GT: A.Op.LT, A.Op.GTE: A.Op.LTE,
+         A.Op.LT: A.Op.GT, A.Op.LTE: A.Op.GTE}
+
+
+def _py_cmp(op: A.Op, v, rv, rt: str) -> bool:
+    if isinstance(v, bool):
+        ok = rt == BOOL
+    elif isinstance(v, (int, float, np.integer, np.floating)):
+        ok = rt == NUM
+    else:
+        ok = rt == STR
+        v = str(v)
+    if not ok:
+        return False
+    return bool(_LIST_CMP[op](v, rv))
 
 
 def _compare(n: int, op: A.Op, l: Col, r: Col) -> Col:
+    if r.t == MIXED and l.t != MIXED:
+        return _compare(n, _FLIP.get(op, op), r, l)
+    if l.t == MIXED:
+        # per-row typed compare over the object column (mixed-type unscoped
+        # attrs are rare; correctness over vectorization here)
+        out = np.zeros(n, bool)
+        if r.t in (NUM, STR, BOOL):
+            rv0 = r.values[0] if len(r.values) else None
+            for i in np.flatnonzero(l.exists & r.exists):
+                out[i] = _py_cmp(op, l.values[i], rv0, r.t)
+        return Col(BOOL, out, np.ones(n, bool))
     # list columns: "any element matches" (event:name, event:timeSinceStart)
     if l.t == STRLIST and r.t == STR:
         rv0 = r.values[0] if len(r.values) else ""

@@ -140,6 +140,10 @@ def view_from_traces(traces: Sequence[tuple[bytes, list[dict]]]) -> ColumnView:
         if key == "resource.service.name":
             continue  # intrinsic service column wins
         view.set_col(key, Col(t, vals, exists))
+    view.meta["span_attr_keys"] = {k.partition(".")[2] for k in attr_cols
+                                   if k.startswith("span.")}
+    view.meta["resource_attr_keys"] = {k.partition(".")[2] for k in attr_cols
+                                       if k.startswith("resource.")}
     view.meta["trace_id"] = tid_hex
     view.meta["span_id"] = sid_hex
     view.meta["start_unix_nano"] = start.astype(np.int64)

@@ -1,0 +1,247 @@
+"""End-to-end engine tests: block fetch → search → query_range (the paths of
+SURVEY.md §3.3/§3.4, tested like `vparquet4/block_traceql_test.go` — build a
+real block, run queries against it)."""
+
+import numpy as np
+import pytest
+
+from tempo_tpu.backend.local import LocalBackend
+from tempo_tpu.block.fetch import scan_views
+from tempo_tpu.block.writer import write_block
+from tempo_tpu.block.reader import BackendBlock
+from tempo_tpu.traceql.engine import (execute_search, execute_tag_values,
+                                      compile_query)
+from tempo_tpu.traceql.engine_metrics import (HBUCKETS, MetricsEvaluator,
+                                              QueryRangeRequest,
+                                              SeriesCombiner, log2_quantile,
+                                              query_range)
+
+T0 = 1_700_000_000_000_000_000  # base time (ns)
+
+
+def build_block(tmp_path, n_traces=50, spans_per_trace=4):
+    be = LocalBackend(str(tmp_path))
+    traces = []
+    for i in range(n_traces):
+        tid = i.to_bytes(2, "big") * 8
+        spans = []
+        for j in range(spans_per_trace):
+            spans.append({
+                "trace_id": tid,
+                "span_id": bytes([j + 1]) * 8,
+                "parent_span_id": b"" if j == 0 else bytes([j]) * 8,
+                "name": f"op-{j}",
+                "service": f"svc-{i % 3}",
+                "kind": 2,
+                "status_code": 2 if (i % 10 == 0 and j == 1) else 0,
+                "start_unix_nano": T0 + i * 1_000_000_000,
+                "end_unix_nano": T0 + i * 1_000_000_000 + (j + 1) * 10_000_000,
+                "attrs": {"http.status_code": 200 + (i % 2) * 300,
+                          "region": ["us", "eu", "ap"][i % 3]},
+                "res_attrs": {"cluster": f"c{i % 2}"},
+            })
+        traces.append((tid, spans))
+    traces.sort()
+    meta = write_block(be, "t1", traces, row_group_rows=64)
+    return be, meta, traces
+
+
+@pytest.fixture(scope="module")
+def block(tmp_path_factory):
+    be, meta, traces = build_block(tmp_path_factory.mktemp("blk"))
+    return BackendBlock(be, meta), traces
+
+
+def views(block, query, start_ns=0, end_ns=0):
+    _, req = compile_query(query, start_ns, end_ns)
+    return scan_views(block, req)
+
+
+def test_row_groups_trace_aligned(block):
+    b, _ = block
+    pf = b.parquet_file()
+    assert pf.num_row_groups > 1  # 200 rows, 64-row target
+    seen = set()
+    for rg in range(pf.num_row_groups):
+        tbl = pf.read_row_group(rg, columns=["trace_idx"])
+        tids = set(tbl.column("trace_idx").to_numpy().tolist())
+        assert not (tids & seen)  # no trace spans two groups
+        seen |= tids
+
+
+def test_search_basic(block):
+    b, _ = block
+    res = execute_search('{ name = "op-1" }', views(b, '{ name = "op-1" }'),
+                         limit=100)
+    assert len(res) == 50
+    assert all(md.span_sets[0]["matched"] == 1 for md in res)
+
+
+def test_search_attr_pushdown(block):
+    b, _ = block
+    q = "{ span.http.status_code >= 500 }"
+    res = execute_search(q, views(b, q), limit=100)
+    assert len(res) == 25  # odd traces
+
+
+def test_search_resource_attr(block):
+    b, _ = block
+    q = '{ resource.cluster = "c1" }'
+    res = execute_search(q, views(b, q), limit=100)
+    assert len(res) == 25
+
+
+def test_search_structural_on_block(block):
+    b, _ = block
+    q = '{ name = "op-0" } > { name = "op-1" }'
+    res = execute_search(q, views(b, q), limit=100)
+    assert len(res) == 50
+    q = '{ name = "op-0" } >> { name = "op-3" }'
+    res = execute_search(q, views(b, q), limit=100)
+    assert len(res) == 50
+
+
+def test_search_limit_and_order(block):
+    b, _ = block
+    res = execute_search("{ }", views(b, "{ }"), limit=7)
+    assert len(res) == 7
+    starts = [md.start_time_unix_nano for md in res]
+    assert starts == sorted(starts, reverse=True)  # most recent first
+
+
+def test_search_time_window(block):
+    b, _ = block
+    start = T0 + 10 * 1_000_000_000
+    end = T0 + 20 * 1_000_000_000
+    q = "{ }"
+    res = execute_search(q, views(b, q, start, end), limit=100,
+                         start_ns=start, end_ns=end)
+    assert 0 < len(res) <= 11
+
+
+def test_search_root_metadata(block):
+    b, _ = block
+    md = execute_search('{ name = "op-2" }', views(b, '{ name = "op-2" }'),
+                        limit=1)[0]
+    assert md.root_trace_name == "op-0"
+    assert md.root_service_name.startswith("svc-")
+
+
+def test_tag_values(block):
+    b, _ = block
+    from tempo_tpu.traceql.engine import tag_values_request
+    vals = execute_tag_values(
+        "span.region", scan_views(b, tag_values_request("span.region")))
+    assert {v["value"] for v in vals} == {"us", "eu", "ap"}
+
+
+def test_rate_by_group(block):
+    b, _ = block
+    req = QueryRangeRequest(
+        query="{ } | rate() by(resource.cluster)",
+        start_ns=T0, end_ns=T0 + 50 * 1_000_000_000,
+        step_ns=10 * 1_000_000_000)
+    series = query_range(req, views(b, req.query, req.start_ns, req.end_ns))
+    assert len(series) == 2  # c0/c1
+    total = sum(ts.samples.sum() for ts in series)
+    # 200 spans over 50s at step 10s → rate sums to 200/10 per label split
+    assert total == pytest.approx(200 / 10.0)
+
+
+def test_count_over_time(block):
+    b, _ = block
+    req = QueryRangeRequest(
+        query="{ } | count_over_time()",
+        start_ns=T0, end_ns=T0 + 50 * 1_000_000_000,
+        step_ns=10 * 1_000_000_000)
+    series = query_range(req, views(b, req.query, req.start_ns, req.end_ns))
+    assert len(series) == 1
+    assert series[0].samples.sum() == 200
+    assert series[0].samples.shape == (5,)
+
+
+def test_min_max_avg_sum_over_time(block):
+    b, _ = block
+    base = dict(start_ns=T0, end_ns=T0 + 50 * 1_000_000_000,
+                step_ns=50 * 1_000_000_000)
+    # duration aggregates are reported in seconds (ns→s like the reference)
+    for fn, expect in [("min_over_time", 0.010), ("max_over_time", 0.040),
+                       ("avg_over_time", 0.025), ("sum_over_time", 200 * 0.025)]:
+        req = QueryRangeRequest(query=f"{{ }} | {fn}(duration)", **base)
+        series = query_range(req, views(b, req.query, req.start_ns, req.end_ns))
+        assert len(series) == 1, fn
+        assert series[0].samples[0] == pytest.approx(expect, rel=1e-4), fn
+
+
+def test_quantile_over_time(block):
+    b, _ = block
+    req = QueryRangeRequest(
+        query="{ } | quantile_over_time(duration, .5)",
+        start_ns=T0, end_ns=T0 + 50 * 1_000_000_000,
+        step_ns=50 * 1_000_000_000)
+    series = query_range(req, views(b, req.query, req.start_ns, req.end_ns))
+    assert len(series) == 1
+    # durations 10/20/30/40ms uniformly; log2-bucketed median within 2x
+    p50 = series[0].samples[0]
+    assert 0.01 <= p50 <= 0.045
+
+
+def test_histogram_over_time_bucket_series(block):
+    b, _ = block
+    req = QueryRangeRequest(
+        query="{ } | histogram_over_time(duration)",
+        start_ns=T0, end_ns=T0 + 50 * 1_000_000_000,
+        step_ns=50 * 1_000_000_000)
+    ev = MetricsEvaluator(req)
+    for view, cand in views(b, req.query, req.start_ns, req.end_ns):
+        ev.observe(view)
+    series = ev.results()
+    assert all(any(k == "__bucket" for k, _ in ts.labels) for ts in series)
+    assert sum(ts.samples.sum() for ts in series) == 200
+
+
+def test_sharded_combine_equals_single(block):
+    """Job-level series from split row-group shards combine to the same
+    result as one pass — the frontend combiner contract."""
+    b, _ = block
+    req = QueryRangeRequest(
+        query="{ } | quantile_over_time(duration, .9) by(span.region)",
+        start_ns=T0, end_ns=T0 + 50 * 1_000_000_000,
+        step_ns=25 * 1_000_000_000)
+    single = query_range(req, views(b, req.query, req.start_ns, req.end_ns))
+
+    pf = b.parquet_file()
+    comb = SeriesCombiner(MetricsEvaluator(req).m.kind, req.n_steps)
+    for rg in range(pf.num_row_groups):
+        _, freq = compile_query(req.query, req.start_ns, req.end_ns)
+        ev = MetricsEvaluator(req)
+        for view, cand in scan_views(b, freq, row_groups=[rg]):
+            ev.observe(view)
+        comb.add_all(ev.results())
+    sharded = comb.final(req)
+
+    def as_map(series):
+        return {ts.labels: ts.samples for ts in series}
+
+    s1, s2 = as_map(single), as_map(sharded)
+    assert set(s1) == set(s2)
+    for k in s1:
+        np.testing.assert_allclose(s1[k], s2[k], rtol=1e-9)
+
+
+def test_log2_quantile_math():
+    buckets = np.zeros(HBUCKETS)
+    buckets[10] = 100  # values in (512, 1024] ns
+    assert 512 / 1e9 < log2_quantile(0.5, buckets) <= 1024 / 1e9
+    assert log2_quantile(0.0, buckets) == pytest.approx(512 / 1e9)
+    assert log2_quantile(1.0, buckets) == pytest.approx(1024 / 1e9)
+
+
+def test_metrics_second_pass_filter(block):
+    b, _ = block
+    req = QueryRangeRequest(
+        query="{ status = error } | count_over_time()",
+        start_ns=T0, end_ns=T0 + 50 * 1_000_000_000,
+        step_ns=50 * 1_000_000_000)
+    series = query_range(req, views(b, req.query, req.start_ns, req.end_ns))
+    assert sum(ts.samples.sum() for ts in series) == 5  # i%10==0 traces

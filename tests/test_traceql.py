@@ -297,3 +297,62 @@ def test_eval_events(view):
     assert sum(len(s.rows) for s in res) == 1
     res = q(v, '{ event:name = "other" }')
     assert sum(len(s.rows) for s in res) == 0
+
+
+# ---------------------------------------------------------------------------
+# review regressions
+# ---------------------------------------------------------------------------
+
+def test_or_with_empty_arm_matches_everything(tmp_path):
+    """'{ .b = 2 } || { }' must match every trace even in hint-mode
+    prefiltering (has_unconditioned_arm)."""
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.block.fetch import scan_views
+    from tempo_tpu.block.reader import BackendBlock
+    from tempo_tpu.block.writer import write_block
+    from tempo_tpu.traceql.engine import compile_query, execute_search
+
+    be = LocalBackend(str(tmp_path))
+    traces = []
+    for i in range(4):
+        tid = bytes([i]) * 16
+        traces.append((tid, [{
+            "trace_id": tid, "span_id": b"\x01" * 8, "name": "s",
+            "start_unix_nano": 10 ** 18, "end_unix_nano": 10 ** 18 + 1000,
+            "attrs": ({"b": 2} if i == 0 else {}),
+        }]))
+    meta = write_block(be, "t", traces, row_group_rows=1)
+    b = BackendBlock(be, meta)
+    q = "{ .b = 2 } || { }"
+    _, req = compile_query(q)
+    res = execute_search(q, scan_views(b, req), limit=100)
+    assert len(res) == 4
+
+
+def test_dashed_attr_round_trip():
+    p = parse('{ span."x-y" = 1 }')
+    assert str(parse(str(p))) == str(p)
+
+
+def test_mixed_type_unscoped_fallback():
+    """Span attr foo=5 (num) on one span, resource attr foo='bar' (str) on
+    another: '{ .foo = \"bar\" }' must match the resource-only span."""
+    t = make_trace(b"\x09" * 16, [
+        (b"a" * 8, b"", "s1", 1, {"attrs": {"foo": 5}}),
+        (b"b" * 8, b"", "s2", 1, {"res_attrs": {"foo": "bar"}}),
+    ])
+    v = view_from_traces([t])
+    res = q(v, '{ .foo = "bar" }')
+    assert sum(len(s.rows) for s in res) == 1
+    res = q(v, "{ .foo = 5 }")
+    assert sum(len(s.rows) for s in res) == 1
+    res = q(v, "{ .foo != nil }")
+    assert sum(len(s.rows) for s in res) == 2
+
+
+def test_tag_names_populated(view):
+    from tempo_tpu.traceql.engine import execute_tag_names
+
+    names = execute_tag_names([(view, np.arange(view.n))])
+    assert "http.status_code" in names["span"]
+    assert "region" in names["span"]
