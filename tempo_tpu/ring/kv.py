@@ -1,0 +1,60 @@
+"""In-memory CAS key-value store standing in for memberlist gossip.
+
+The reference propagates ring state via dskit memberlist gossip KV
+(`cmd/tempo/app/modules.go:593-625`). Within one process (the single-binary
+target, `modules.go:711,742`) every module shares one KV; multi-process
+deployments would swap this for an RPC-backed store — the interface
+(`get/cas/watch_key`) matches dskit's `kv.Client` semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+
+class KVStore:
+    """Thread-safe CAS store with key watches (dskit `kv.Client` analog)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._data: dict[str, tuple[int, Any]] = {}  # key -> (version, value)
+        self._watches: dict[str, list[Callable[[Any], None]]] = {}
+
+    def get(self, key: str) -> Any:
+        with self._lock:
+            v = self._data.get(key)
+            return v[1] if v else None
+
+    def cas(self, key: str, update: Callable[[Any], Any],
+            retries: int = 10) -> Any:
+        """Read-modify-write with optimistic concurrency, like kv CAS loops
+        (usage-stats leader election `pkg/usagestats/reporter.go:239`)."""
+        for _ in range(retries):
+            with self._lock:
+                ver, cur = self._data.get(key, (0, None))
+            new = update(cur)
+            if new is None:
+                return cur
+            with self._lock:
+                ver2, _ = self._data.get(key, (0, None))
+                if ver2 != ver:
+                    continue  # raced; retry with fresh value
+                self._data[key] = (ver + 1, new)
+                watchers = list(self._watches.get(key, ()))
+            for w in watchers:
+                w(new)
+            return new
+        raise RuntimeError(f"CAS contention on {key!r}")
+
+    def watch_key(self, key: str, cb: Callable[[Any], None]) -> None:
+        with self._lock:
+            self._watches.setdefault(key, []).append(cb)
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            return list(self._data)

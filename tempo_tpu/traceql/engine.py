@@ -122,7 +122,7 @@ def execute_search(
     once the combiner has `limit` traces, like `ExecuteSearch`'s streaming
     second pass (`engine.go:82-155`).
     """
-    q, _req = compile_query(query, start_ns, end_ns)
+    q = parse(query) if isinstance(query, str) else query
     combiner = MetadataCombiner(limit)
     for view, cand in view_iter:
         if len(cand) == 0:

@@ -22,6 +22,7 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 
 from tempo_tpu.traceql import ast as A
+from tempo_tpu.traceql.conditions import _flip as _flip_op
 
 # column type tags
 NUM, STR, BOOL, STATUS, KIND = "num", "str", "bool", "status", "kind"
@@ -55,6 +56,14 @@ class Col:
 
     def bool_mask(self) -> np.ndarray:
         """Boolean filter view: missing → false."""
+        if self.t == MIXED:
+            # object column: rows whose value is a true bool pass
+            out = np.zeros(len(self.values), bool)
+            for i in np.flatnonzero(self.exists):
+                v = self.values[i]
+                if isinstance(v, (bool, np.bool_)) and v:
+                    out[i] = True
+            return out
         if self.t != BOOL:
             return np.zeros(len(self.values), bool)
         return self.values & self.exists
@@ -331,13 +340,11 @@ def _strlist_match(c: Col, pred) -> np.ndarray:
 _LIST_CMP = {A.Op.EQ: lambda a, b: a == b, A.Op.NEQ: lambda a, b: a != b,
              A.Op.GT: lambda a, b: a > b, A.Op.GTE: lambda a, b: a >= b,
              A.Op.LT: lambda a, b: a < b, A.Op.LTE: lambda a, b: a <= b}
-_FLIP = {A.Op.GT: A.Op.LT, A.Op.GTE: A.Op.LTE,
-         A.Op.LT: A.Op.GT, A.Op.LTE: A.Op.GTE}
-
 
 def _py_cmp(op: A.Op, v, rv, rt: str) -> bool:
-    if isinstance(v, bool):
+    if isinstance(v, (bool, np.bool_)):
         ok = rt == BOOL
+        v = bool(v)
     elif isinstance(v, (int, float, np.integer, np.floating)):
         ok = rt == NUM
     else:
@@ -350,15 +357,14 @@ def _py_cmp(op: A.Op, v, rv, rt: str) -> bool:
 
 def _compare(n: int, op: A.Op, l: Col, r: Col) -> Col:
     if r.t == MIXED and l.t != MIXED:
-        return _compare(n, _FLIP.get(op, op), r, l)
+        return _compare(n, _flip_op(op), r, l)
     if l.t == MIXED:
         # per-row typed compare over the object column (mixed-type unscoped
         # attrs are rare; correctness over vectorization here)
         out = np.zeros(n, bool)
         if r.t in (NUM, STR, BOOL):
-            rv0 = r.values[0] if len(r.values) else None
             for i in np.flatnonzero(l.exists & r.exists):
-                out[i] = _py_cmp(op, l.values[i], rv0, r.t)
+                out[i] = _py_cmp(op, l.values[i], r.values[i], r.t)
         return Col(BOOL, out, np.ones(n, bool))
     # list columns: "any element matches" (event:name, event:timeSinceStart)
     if l.t == STRLIST and r.t == STR:

@@ -1,0 +1,142 @@
+"""Ring, KV, shuffle sharding, quorum batch, overrides resolution."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tempo_tpu.backend.mem import MemBackend
+from tempo_tpu.overrides import Limits, Overrides, UserConfigurableOverrides
+from tempo_tpu.ring import (
+    ACTIVE,
+    InstanceDesc,
+    KVStore,
+    Lifecycler,
+    Ring,
+    do_batch,
+)
+from tempo_tpu.ring.ring import _instance_tokens
+
+
+def make_ring(n=4, rf=3, now=None):
+    r = Ring(replication_factor=rf, now=now or (lambda: 1000.0))
+    for i in range(n):
+        r.register(InstanceDesc(id=f"ing-{i}", addr=f"host{i}",
+                                tokens=_instance_tokens(f"ing-{i}", 64),
+                                state=ACTIVE, heartbeat_ts=1000.0))
+    return r
+
+
+def test_replication_set_distinct_and_deterministic():
+    r = make_ring(5)
+    rs1 = r.get(12345)
+    rs2 = r.get(12345)
+    assert [i.id for i in rs1.instances] == [i.id for i in rs2.instances]
+    assert len(rs1.instances) == 3
+    assert len({i.id for i in rs1.instances}) == 3
+    assert rs1.max_errors == 1  # rf=3, quorum=2
+
+
+def test_unhealthy_eats_error_budget():
+    clock = [1000.0]
+    r = make_ring(4, now=lambda: clock[0])
+    rs = r.get(777)
+    # age out one replica's heartbeat (others stay within the 60s timeout)
+    dead = rs.instances[0].id
+    r._instances[dead].heartbeat_ts = 900.0
+    clock[0] = 1050.0
+    rs2 = r.get(777)
+    assert dead not in {i.id for i in rs2.instances}
+    assert rs2.max_errors == 0
+
+
+def test_ownership_single_owner():
+    r = make_ring(4)
+    owners = [m for m in ("ing-0", "ing-1", "ing-2", "ing-3")
+              if r.owns(m, "tenant-a/job-1")]
+    assert len(owners) == 1
+
+
+def test_shuffle_shard_deterministic_subset():
+    r = make_ring(10, rf=2)
+    s1 = r.shuffle_shard("tenant-a", 3)
+    s2 = r.shuffle_shard("tenant-a", 3)
+    ids1 = {i.id for i in s1.instances()}
+    assert ids1 == {i.id for i in s2.instances()}
+    assert len(ids1) == 3
+    sb = r.shuffle_shard("tenant-b", 3)
+    # different tenants usually land on different shards (not guaranteed, but
+    # with 10 choose 3 the collision chance for this seed pair is nil)
+    assert {i.id for i in sb.instances()} != ids1
+
+
+def test_lifecycler_joins_and_leaves_via_kv():
+    kv = KVStore()
+    ring = Ring(kv=kv, replication_factor=1, now=lambda: 1000.0)
+    lc = Lifecycler(kv, "gen-0", n_tokens=32, now=lambda: 1000.0)
+    assert len(ring) == 1
+    assert ring.get(42).instances[0].id == "gen-0"
+    lc.leave()
+    assert len(ring) == 0
+
+
+def test_do_batch_quorum_tolerates_one_failure():
+    r = make_ring(5)
+    got: dict[str, list] = {}
+
+    def send(inst, items):
+        if inst.id == "ing-0":
+            raise RuntimeError("down")
+        got.setdefault(inst.id, []).extend(items)
+
+    tokens = np.arange(50, dtype=np.uint32) * 77_000_000
+    do_batch(r, tokens, list(range(50)), send)
+    assert sum(len(v) for v in got.values()) >= 100  # each item at 2+ replicas
+
+
+def test_do_batch_fails_without_quorum():
+    r = make_ring(3)
+
+    def send(inst, items):
+        if inst.id in ("ing-0", "ing-1"):
+            raise RuntimeError("down")
+
+    with pytest.raises(RuntimeError):
+        do_batch(r, np.array([5], np.uint32), ["x"], send)
+
+
+def test_overrides_layering(tmp_path):
+    p = tmp_path / "rc.yaml"
+    p.write_text(
+        "overrides:\n"
+        "  '*':\n"
+        "    ingestion: {rate_limit_bytes: 1000}\n"
+        "  tenant-a:\n"
+        "    ingestion: {rate_limit_bytes: 2000}\n"
+        "    generator: {processors: [span-metrics]}\n")
+    o = Overrides(runtime_config_path=str(p))
+    assert o.for_tenant("tenant-a").ingestion.rate_limit_bytes == 2000
+    assert o.for_tenant("tenant-a").generator.processors == ("span-metrics",)
+    assert o.for_tenant("other").ingestion.rate_limit_bytes == 1000
+    assert o.for_tenant("other").generator.processors == ()
+    # mtime-gated reload
+    assert o.reload() is False
+
+
+def test_user_configurable_overrides_api_and_validation():
+    be = MemBackend()
+    uc = UserConfigurableOverrides(be, be)
+    o = Overrides(user_configurable=uc)
+    v1 = uc.set("t1", {"generator": {"collection_interval_s": 30.0}})
+    assert o.for_tenant("t1").generator.collection_interval_s == 30.0
+    # version conflict
+    with pytest.raises(RuntimeError):
+        uc.set("t1", {"generator": {"collection_interval_s": 60.0}}, version="99")
+    uc.set("t1", {"generator": {"collection_interval_s": 60.0}}, version=v1)
+    assert o.for_tenant("t1").generator.collection_interval_s == 60.0
+    # non-user-configurable field rejected
+    with pytest.raises(ValueError):
+        uc.set("t1", {"ingestion": {"rate_limit_bytes": 1}})
+    uc.delete("t1")
+    assert o.for_tenant("t1").generator.collection_interval_s == \
+        Limits().generator.collection_interval_s

@@ -350,6 +350,37 @@ def test_mixed_type_unscoped_fallback():
     assert sum(len(s.rows) for s in res) == 2
 
 
+def test_mixed_type_row_aligned_compare():
+    """MIXED columns must compare row-aligned, not against row 0's rhs:
+    { .foo = .bar } with per-row bar values (regression: rv0 bug)."""
+    t = make_trace(b"\x0a" * 16, [
+        (b"a" * 8, b"", "s1", 1, {"attrs": {"foo": "x", "bar": "x"}}),
+        (b"b" * 8, b"", "s2", 1, {"attrs": {"foo": "y", "bar": "z"},
+                                  "res_attrs": {}}),
+        (b"c" * 8, b"", "s3", 1, {"res_attrs": {"foo": 5}}),
+    ])
+    v = view_from_traces([t])
+    res = q(v, "{ .foo = .bar }")
+    assert sum(len(s.rows) for s in res) == 1  # only s1 (x == x)
+
+
+def test_mixed_type_bool_filter():
+    """Bare boolean filter over a MIXED column matches the bool-true rows
+    (regression: bool_mask returned all-False for MIXED)."""
+    t = make_trace(b"\x0b" * 16, [
+        (b"a" * 8, b"", "s1", 1, {"attrs": {"flag": True}}),
+        (b"b" * 8, b"", "s2", 1, {"attrs": {"flag": False}}),
+        (b"c" * 8, b"", "s3", 1, {"res_attrs": {"flag": "on"}}),
+    ])
+    v = view_from_traces([t])
+    res = q(v, "{ .flag }")
+    assert sum(len(s.rows) for s in res) == 1
+    res = q(v, "{ .flag = true }")
+    assert sum(len(s.rows) for s in res) == 1
+    res = q(v, "{ .flag = false }")
+    assert sum(len(s.rows) for s in res) == 1
+
+
 def test_tag_names_populated(view):
     from tempo_tpu.traceql.engine import execute_tag_names
 
