@@ -63,7 +63,10 @@ class WALBlock:
         self.spans_appended += table.num_rows
 
     def segments(self) -> list[str]:
-        return sorted(f for f in os.listdir(self.dir) if f.endswith(".parquet"))
+        try:
+            return sorted(f for f in os.listdir(self.dir) if f.endswith(".parquet"))
+        except FileNotFoundError:
+            return []  # cleared by a concurrent completion — read as empty
 
     def iter_spans(self) -> Iterator[dict]:
         for seg in self.segments():

@@ -1,0 +1,15 @@
+"""Distributor: write-path entry — validate, limit, regroup, replicate.
+
+Analog of `modules/distributor`: receives decoded span batches, enforces
+per-tenant rate limits (`ingestion_rate_strategy.go`), validates and
+truncates, regroups spans by trace id with vectorized token hashing
+(`requestsByTraceID` `distributor.go:694-801` + `pkg/util/hash.go:8`),
+replicates to ingesters over the ring with RF quorum
+(`sendToIngestersViaBytes` `distributor.go:490`), and tees to the
+metrics-generators (`sendToGenerators` `distributor.go:563`).
+"""
+
+from tempo_tpu.distributor.distributor import Distributor, DistributorConfig
+from tempo_tpu.distributor.limiter import RateLimiter
+
+__all__ = ["Distributor", "DistributorConfig", "RateLimiter"]

@@ -1,0 +1,230 @@
+"""The distributor service.
+
+The hot regrouping loop (`requestsByTraceID` `distributor.go:694-801`)
+becomes a vectorized pass: trace ids stack into an [n,16] uint8 matrix, ring
+tokens come from one batched fnv hash (`token_for`), and replication sets
+resolve with a single searchsorted per unique trace (ring.do_batch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Protocol, Sequence
+
+import numpy as np
+
+from tempo_tpu.distributor.limiter import RateLimiter, effective_rate
+from tempo_tpu.ops.hashing import token_for
+from tempo_tpu.overrides import Overrides
+from tempo_tpu.ring import InstanceDesc, Ring, do_batch
+from tempo_tpu.utils.livetraces import _approx_size
+
+# discard reasons (mirroring the reference's discard metric reasons,
+# `modules/distributor/distributor.go` reasonRateLimited etc.)
+REASON_RATE_LIMITED = "rate_limited"
+REASON_TRACE_TOO_LARGE = "trace_too_large"
+REASON_INVALID_TRACE_ID = "invalid_trace_id"
+REASON_INTERNAL = "internal_error"
+REASON_UNKNOWN_ERROR = "unknown_error"
+
+
+class IngesterClient(Protocol):
+    def push(self, tenant: str,
+             traces: Sequence[tuple[bytes, list[dict]]]) -> list[str | None]: ...
+
+
+class GeneratorClient(Protocol):
+    def push_spans(self, tenant: str, spans: Sequence[dict]) -> None: ...
+
+
+@dataclasses.dataclass
+class DistributorConfig:
+    rf: int = 3
+    generator_rf: int = 1            # generator forwarding is RF1
+
+
+class RateLimited(RuntimeError):
+    """Maps to gRPC ResourceExhausted + RetryInfo at the receiver shim
+    (`modules/distributor/receiver/shim.go` RetryableError)."""
+
+    def __init__(self, tenant: str, n_bytes: int):
+        super().__init__(f"tenant {tenant} over ingestion rate ({n_bytes}B)")
+        self.tenant = tenant
+
+
+class Distributor:
+    def __init__(self,
+                 ingester_ring: Ring,
+                 ingester_clients: dict[str, IngesterClient],
+                 overrides: Overrides | None = None,
+                 generator_ring: Ring | None = None,
+                 generator_clients: dict[str, GeneratorClient] | None = None,
+                 cfg: DistributorConfig | None = None,
+                 n_distributors: Callable[[], int] = lambda: 1,
+                 now: Callable[[], float] = time.time) -> None:
+        self.cfg = cfg or DistributorConfig()
+        self.overrides = overrides or Overrides()
+        self.ingester_ring = ingester_ring
+        self.ingester_clients = ingester_clients
+        self.generator_ring = generator_ring
+        self.generator_clients = generator_clients or {}
+        self.limiter = RateLimiter(now=now)
+        self.n_distributors = n_distributors
+        # self-metrics (tempo_distributor_* naming)
+        self.metrics: dict[str, float] = {
+            "spans_received_total": 0, "bytes_received_total": 0,
+            "traces_pushed_total": 0, "push_failures_total": 0,
+        }
+        self.discarded: dict[str, int] = {}
+
+    # -- entry -------------------------------------------------------------
+
+    def push_spans(self, tenant: str, spans: Sequence[dict],
+                   size_bytes: int | None = None) -> dict[str, int]:
+        """The PushTraces path (`distributor.go:398-488`): returns discard
+        reason counts for partial failures; raises RateLimited when the
+        tenant bucket is empty."""
+        lim = self.overrides.for_tenant(tenant)
+        sz = size_bytes if size_bytes is not None else _approx_bytes(spans)
+        rate = effective_rate(lim.ingestion.rate_strategy,
+                              lim.ingestion.rate_limit_bytes,
+                              self.n_distributors())
+        if not self.limiter.allow(tenant, sz, rate,
+                                  lim.ingestion.burst_size_bytes):
+            self._discard(REASON_RATE_LIMITED, len(spans))
+            raise RateLimited(tenant, sz)
+
+        self.metrics["spans_received_total"] += len(spans)
+        self.metrics["bytes_received_total"] += sz
+
+        spans, errs = self._validate(spans, lim)
+        if not spans:
+            return errs
+
+        groups, tid_matrix = _group_by_trace(spans)
+        tokens = token_for(tenant, tid_matrix)
+        errs2 = self._send_to_ingesters(tenant, groups, tokens, lim)
+        for k, v in errs2.items():
+            errs[k] = errs.get(k, 0) + v
+        self._send_to_generators(tenant, groups, tokens, lim)
+        return errs
+
+    # -- stages ------------------------------------------------------------
+
+    def _validate(self, spans: Sequence[dict],
+                  lim) -> tuple[list[dict], dict[str, int]]:
+        """Trace-id validation + attribute truncation
+        (`pkg/validation` + distributor attr limits)."""
+        errs: dict[str, int] = {}
+        out: list[dict] = []
+        max_attr = lim.ingestion.max_attribute_bytes
+        for s in spans:
+            tid = s.get("trace_id") or b""
+            if not tid or len(tid) > 16:
+                errs[REASON_INVALID_TRACE_ID] = errs.get(REASON_INVALID_TRACE_ID, 0) + 1
+                self._discard(REASON_INVALID_TRACE_ID, 1)
+                continue
+            if max_attr:
+                s = _truncate_attrs(s, max_attr)
+            out.append(s)
+        return out, errs
+
+    def _send_to_ingesters(self, tenant: str,
+                           groups: list[tuple[bytes, list[dict]]],
+                           tokens: np.ndarray, lim) -> dict[str, int]:
+        ring = self.ingester_ring
+        if lim.ingestion.tenant_shard_size:
+            ring = ring.shuffle_shard(tenant, lim.ingestion.tenant_shard_size)
+        # per-trace reason, deduped across replicas: a trace rejected by all
+        # RF replicas is one discarded trace, not RF of them
+        item_reason: dict[int, str] = {}
+
+        def send(inst: InstanceDesc, items: list[int]) -> None:
+            client = self.ingester_clients[inst.id]
+            res = client.push(tenant, [groups[i] for i in items])
+            for i, reason in zip(items, res or ()):
+                if reason:
+                    item_reason.setdefault(i, reason)
+
+        errs: dict[str, int] = {}
+        try:
+            do_batch(ring, tokens, list(range(len(groups))), send,
+                     rf=self.cfg.rf)
+            self.metrics["traces_pushed_total"] += len(groups)
+        except RuntimeError:
+            self.metrics["push_failures_total"] += 1
+            n = sum(len(g[1]) for g in groups)
+            self._discard(REASON_INTERNAL, n)
+            errs[REASON_INTERNAL] = errs.get(REASON_INTERNAL, 0) + n
+        for reason in item_reason.values():
+            errs[reason] = errs.get(reason, 0) + 1
+            self._discard(reason, 1)
+        return errs
+
+    def _send_to_generators(self, tenant: str,
+                            groups: list[tuple[bytes, list[dict]]],
+                            tokens: np.ndarray, lim) -> None:
+        """Tee traces to metrics-generators (RF1, best-effort — generator
+        loss degrades metrics, not trace durability; `distributor.go:563`)."""
+        if self.generator_ring is None or not self.generator_clients:
+            return
+        if not lim.generator.processors:
+            return
+
+        def send(inst: InstanceDesc, items: list[int]) -> None:
+            spans = [s for i in items for s in groups[i][1]]
+            self.generator_clients[inst.id].push_spans(tenant, spans)
+
+        try:
+            do_batch(self.generator_ring, tokens, list(range(len(groups))),
+                     send, rf=self.cfg.generator_rf)
+        except RuntimeError:
+            self.metrics["push_failures_total"] += 1
+
+    def _discard(self, reason: str, n: int) -> None:
+        self.discarded[reason] = self.discarded.get(reason, 0) + n
+
+
+# -- helpers ---------------------------------------------------------------
+
+def _group_by_trace(spans: Sequence[dict]
+                    ) -> tuple[list[tuple[bytes, list[dict]]], np.ndarray]:
+    """Regroup spans by trace id; returns groups + [n_groups,16] id matrix."""
+    by_id: dict[bytes, list[dict]] = {}
+    for s in spans:
+        by_id.setdefault(s["trace_id"], []).append(s)
+    groups = list(by_id.items())
+    mat = np.zeros((len(groups), 16), np.uint8)
+    for i, (tid, _) in enumerate(groups):
+        b = tid.ljust(16, b"\0")[:16]
+        mat[i] = np.frombuffer(b, np.uint8)
+    return groups, mat
+
+
+def _truncate_attrs(s: dict, max_bytes: int) -> dict:
+    def trunc(attrs: dict | None) -> dict | None:
+        if not attrs:
+            return attrs
+        out = {}
+        for k, v in attrs.items():
+            if len(k.encode()) > max_bytes:
+                continue
+            if isinstance(v, str) and len(v.encode()) > max_bytes:
+                v = v.encode()[:max_bytes].decode(errors="ignore")
+            out[k] = v
+        return out
+
+    s = dict(s)
+    s["attrs"] = trunc(s.get("attrs"))
+    s["res_attrs"] = trunc(s.get("res_attrs"))
+    return s
+
+
+def _approx_bytes(spans: Sequence[dict]) -> int:
+    # shares the ingester's size heuristic so the distributor's rate limit
+    # and the ingester's per-trace byte limit stay in the same units
+    return _approx_size(list(spans))
+
+
+__all__ = ["Distributor", "DistributorConfig", "RateLimited"]

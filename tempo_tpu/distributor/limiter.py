@@ -1,0 +1,53 @@
+"""Per-tenant ingestion rate limiting (token bucket).
+
+Analog of the dskit limiter the distributor consults per push
+(`checkForRateLimits` `distributor.go:368` + `ingestion_rate_strategy.go`):
+`local` gives each distributor the full per-tenant rate; `global` divides
+the rate by the (healthy) distributor count so the fleet-wide total holds.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+
+class _Bucket:
+    __slots__ = ("tokens", "last")
+
+    def __init__(self, burst: float, now: float) -> None:
+        self.tokens = burst
+        self.last = now
+
+
+class RateLimiter:
+    def __init__(self, now: Callable[[], float] = time.time) -> None:
+        self.now = now
+        self._buckets: dict[str, _Bucket] = {}
+        self._lock = threading.Lock()
+
+    def allow(self, tenant: str, n_bytes: int, rate: float, burst: float) -> bool:
+        """Take n_bytes from the tenant bucket; False = over limit (caller
+        returns ResourceExhausted / RetryInfo like the receiver shim)."""
+        if rate <= 0:
+            return True
+        t = self.now()
+        with self._lock:
+            b = self._buckets.get(tenant)
+            if b is None:
+                b = self._buckets[tenant] = _Bucket(burst, t)
+            b.tokens = min(burst, b.tokens + (t - b.last) * rate)
+            b.last = t
+            if n_bytes > b.tokens:
+                return False
+            b.tokens -= n_bytes
+            return True
+
+
+def effective_rate(strategy: str, rate: float, n_distributors: int) -> float:
+    """`local`: per-replica rate; `global`: fleet rate split evenly
+    (`ingestion_rate_strategy.go`)."""
+    if strategy == "global" and n_distributors > 0:
+        return rate / n_distributors
+    return rate

@@ -1,0 +1,215 @@
+"""The ingester service: tenant instances + flush machinery + replay.
+
+Analog of `modules/ingester/ingester.go` + `flush.go`: a push entry point
+(`PushBytesV2` `ingester.go:301`), a periodic cut loop (`cutToWalLoop`
+`flush.go:142`), two-phase flush ops (opKindComplete → opKindFlush
+`flush.go:70-73`) through deduping retry queues, shutdown flush-all, and
+WAL replay on construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Callable, Sequence
+
+from tempo_tpu.backend.raw import RawWriter, block_keypath
+from tempo_tpu.ingester.instance import InstanceConfig, TenantInstance
+from tempo_tpu.overrides import Overrides
+from tempo_tpu.utils.flushqueues import FlushQueues, backoff_at
+
+OP_COMPLETE = "complete"
+OP_FLUSH = "flush"
+
+
+@dataclasses.dataclass
+class IngesterConfig:
+    instance: InstanceConfig = dataclasses.field(default_factory=InstanceConfig)
+    concurrent_flushes: int = 4
+    flush_check_period_s: float = 10.0
+    complete_block_timeout_s: float = 900.0   # keep local 15m after flush
+    max_flush_attempts: int = 10
+    flush_backoff_base_s: float = 30.0
+
+
+@dataclasses.dataclass
+class _FlushOp:
+    kind: str
+    tenant: str
+    block_id: str
+    attempts: int = 0
+    wal_block: object = None
+
+
+class Ingester:
+    def __init__(self, data_dir: str,
+                 flush_writer: RawWriter | None = None,
+                 cfg: IngesterConfig | None = None,
+                 overrides: Overrides | None = None,
+                 now: Callable[[], float] = time.time,
+                 instance_id: str = "ingester-0") -> None:
+        self.cfg = cfg or IngesterConfig()
+        self.overrides = overrides or Overrides()
+        self.now = now
+        self.id = instance_id
+        self.wal_root = os.path.join(data_dir, "wal")
+        self.local_root = os.path.join(data_dir, "blocks")
+        self.flush_writer = flush_writer
+        self.instances: dict[str, TenantInstance] = {}
+        self.lock = threading.RLock()
+        self.queues = FlushQueues(self.cfg.concurrent_flushes, now=now)
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self.replay()
+
+    # -- instances ---------------------------------------------------------
+
+    def instance(self, tenant: str) -> TenantInstance:
+        with self.lock:
+            inst = self.instances.get(tenant)
+            if inst is None:
+                inst = self.instances[tenant] = TenantInstance(
+                    tenant,
+                    wal_dir=self.wal_root,
+                    local_dir=self.local_root,
+                    cfg=self.cfg.instance,
+                    limits=self.overrides.for_tenant(tenant),
+                    now=self.now)
+            return inst
+
+    # -- write -------------------------------------------------------------
+
+    def push(self, tenant: str,
+             traces: Sequence[tuple[bytes, list[dict]]]) -> list[str | None]:
+        """Push (trace_id, spans) groups; returns a per-trace error reason
+        (or None) aligned with the input — the PushResponse error slice of
+        `PushBytesV2`, letting the distributor dedupe reasons across
+        replicas instead of summing them RF times."""
+        inst = self.instance(tenant)
+        return [inst.push_trace(tid, spans) for tid, spans in traces]
+
+    # -- cut/flush machinery ----------------------------------------------
+
+    def sweep_instance(self, tenant: str, immediate: bool = False) -> None:
+        """One cut tick for a tenant (`sweepInstance` flush.go:142):
+        cut idle traces, maybe seal head, enqueue completion."""
+        inst = self.instance(tenant)
+        inst.cut_complete_traces(immediate=immediate)
+        sealed = inst.cut_block_if_ready(immediate=immediate)
+        if sealed is not None:
+            self.queues.enqueue(
+                f"{tenant}/{sealed.block_id}",
+                _FlushOp(OP_COMPLETE, tenant, sealed.block_id, wal_block=sealed))
+
+    def sweep_all(self, immediate: bool = False) -> None:
+        with self.lock:
+            tenants = list(self.instances)
+        for t in tenants:
+            self.sweep_instance(t, immediate=immediate)
+
+    def _handle_op(self, key: str, op: _FlushOp) -> bool:
+        inst = self.instance(op.tenant)
+        try:
+            if op.kind == OP_COMPLETE:
+                if op.wal_block is not None:
+                    inst.complete_block(op.wal_block)
+                # chain to flush (two-phase, `flush.go:264-364`)
+                self.queues.done(key)
+                self.queues.enqueue(f"{key}/flush",
+                                    _FlushOp(OP_FLUSH, op.tenant, op.block_id))
+                return True
+            # OP_FLUSH: copy the completed local block to object storage
+            if self.flush_writer is not None:
+                entry = inst.complete.get(op.block_id)
+                if entry is None:
+                    self.queues.done(key)
+                    return True
+                _copy_block_files(inst, op.block_id, self.flush_writer)
+            inst.mark_flushed(op.block_id)
+            self.queues.done(key)
+            return True
+        except Exception:
+            op.attempts += 1
+            if op.attempts >= self.cfg.max_flush_attempts:
+                self.queues.done(key)   # abandon (`flush.go` op abandonment)
+                return False
+            self.queues.requeue(key, op, backoff_at(
+                self.now(), op.attempts, self.cfg.flush_backoff_base_s))
+            return False
+
+    def flush_tick(self, queue_idx: int | None = None) -> int:
+        """Drain due ops (one queue when an index is given — the per-worker
+        loop — or all queues, for tests/manual ticks)."""
+        idxs = (range(self.cfg.concurrent_flushes)
+                if queue_idx is None else (queue_idx,))
+        n = 0
+        for qi in idxs:
+            while True:
+                got = self.queues.dequeue(qi)
+                if got is None:
+                    break
+                self._handle_op(*got)
+                n += 1
+        return n
+
+    def flush_all(self) -> None:
+        """/flush + shutdown behavior: cut everything, complete, flush."""
+        self.sweep_all(immediate=True)
+        self.queues.drain(self._handle_op)
+        # completion enqueues flush ops; drain those too
+        self.queues.drain(self._handle_op)
+
+    # -- replay ------------------------------------------------------------
+
+    def replay(self) -> None:
+        """Adopt WAL + local complete blocks left by a previous process and
+        queue them for (re)completion and flush."""
+        if not os.path.isdir(self.wal_root):
+            return
+        from tempo_tpu.block.wal import rescan_blocks
+        for wb in rescan_blocks(self.wal_root):
+            inst = self.instance(wb.tenant)
+            with inst.lock:
+                if wb.block_id not in [b.block_id for b in inst.completing]:
+                    inst.completing.append(wb)
+            self.queues.enqueue(
+                f"{wb.tenant}/{wb.block_id}",
+                _FlushOp(OP_COMPLETE, wb.tenant, wb.block_id, wal_block=wb))
+        if os.path.isdir(self.local_root):
+            for tenant in os.listdir(self.local_root):
+                inst = self.instance(tenant)
+                _, n = inst.replay()
+                for bid, e in inst.complete.items():
+                    if not e.flushed_ts:
+                        self.queues.enqueue(f"{tenant}/{bid}/flush",
+                                            _FlushOp(OP_FLUSH, tenant, bid))
+
+    # -- loops -------------------------------------------------------------
+
+    def start(self) -> None:
+        def cut_loop():
+            while not self._stop.wait(self.cfg.flush_check_period_s):
+                self.sweep_all()
+        def flush_loop(qi: int):
+            while not self._stop.wait(1.0):
+                self.flush_tick(qi)
+        self._threads = [threading.Thread(target=cut_loop, daemon=True)]
+        self._threads += [threading.Thread(target=flush_loop, args=(i,), daemon=True)
+                          for i in range(self.cfg.concurrent_flushes)]
+        for t in self._threads:
+            t.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self.flush_all()
+
+
+def _copy_block_files(inst: TenantInstance, block_id: str, dst: RawWriter) -> None:
+    kp = block_keypath(block_id, inst.tenant)
+    src = inst.local_backend
+    for name in src.find(kp):
+        dst.write(name, kp, src.read(name, kp))
