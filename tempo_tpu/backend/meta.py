@@ -64,6 +64,7 @@ class BlockMeta:
     total_objects: int = 0             # traces
     total_spans: int = 0
     size_bytes: int = 0
+    row_group_count: int = 0           # parquet row groups (job sharding)
     compaction_level: int = 0
     bloom_shard_count: int = 1
     footer_size: int = 0

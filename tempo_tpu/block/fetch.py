@@ -383,6 +383,36 @@ def condition_mask(view: ColumnView, req: FetchSpansRequest) -> np.ndarray:
     return mask
 
 
+def block_tag_names(block: BackendBlock, limit: int = 1000,
+                    byte_budget: int = 0) -> dict[str, set]:
+    """Distinct attr keys of a block, reading ONLY the key-list columns
+    (the metadata-endpoint fast path — no data pages decoded). Stops early
+    once `limit` names or `byte_budget` bytes of names are collected
+    (`max_bytes_per_tag_values_query` semantics)."""
+    key_cols = [f"{p}attr_{t}_keys" for p in ("s", "r")
+                for t in ("str", "int", "f64", "bool")]
+    pf = block.parquet_file()
+    avail = set(pf.schema_arrow.names)
+    use = [c for c in key_cols if c in avail]
+    out: dict[str, set] = {"span": set(), "resource": set()}
+    used_bytes = 0
+    for rg in range(pf.num_row_groups):
+        tbl = pf.read_row_group(rg, columns=use)
+        for c in use:
+            _, flat = _list_parts(tbl.column(c))
+            if not len(flat):
+                continue
+            scope = "span" if c.startswith("s") else "resource"
+            for name in np.unique(flat.astype(str)).tolist():
+                if name not in out[scope]:
+                    out[scope].add(name)
+                    used_bytes += len(name)
+        if (len(out["span"]) + len(out["resource"]) >= limit
+                or (byte_budget and used_bytes >= byte_budget)):
+            break
+    return out
+
+
 def scan_views(block: BackendBlock, req: Optional[FetchSpansRequest] = None,
                row_groups: Optional[Sequence[int]] = None
                ) -> Iterator[tuple[ColumnView, np.ndarray]]:

@@ -155,6 +155,7 @@ def write_block_from_table(
     meta.start_time = stats["start_time"]
     meta.end_time = stats["end_time"]
     meta.size_bytes = len(data)
+    meta.row_group_count = pf.num_row_groups
     meta.footer_size = int.from_bytes(data[-8:-4], "little") if len(data) >= 8 else 0
     write_block_meta(w, meta)
     return meta

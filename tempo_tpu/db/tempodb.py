@@ -121,6 +121,51 @@ class TempoDB:
         found = [spans for spans in results if spans]
         return combine_spans(*found) if found else None
 
+    def search(self, tenant: str, query: str, *, limit: int = 20,
+               start_s: float | None = None, end_s: float | None = None,
+               metas: Sequence[bm.BlockMeta] | None = None,
+               row_groups: Sequence[int] | None = None):
+        """TraceQL search over backend blocks (`tempodb.Search/Fetch`
+        `tempodb.go:368,481`): compile once, stream row-group views from
+        every candidate block through the engine."""
+        from tempo_tpu.block.fetch import scan_views
+        from tempo_tpu.traceql.engine import compile_query, execute_search
+
+        _, req = compile_query(query,
+                               int((start_s or 0) * 1e9), int((end_s or 0) * 1e9))
+        if metas is None:
+            metas = self.blocks(tenant, start_s, end_s)
+        views = (v for m in metas
+                 for v in scan_views(self.backend_block(m), req,
+                                     row_groups=row_groups))
+        return execute_search(query, views, limit=limit,
+                              start_ns=int((start_s or 0) * 1e9),
+                              end_ns=int((end_s or 0) * 1e9))
+
+    def query_range(self, tenant: str, req, *,
+                    metas: Sequence[bm.BlockMeta] | None = None,
+                    row_groups: Sequence[int] | None = None,
+                    clip_start_ns: int | None = None,
+                    clip_end_ns: int | None = None):
+        """TraceQL metrics over backend blocks: the raw MetricsEvaluator
+        path (`engine_metrics.go:802`); returns job-level TimeSeries for a
+        frontend combiner (or final series when used standalone). The clip
+        bounds restrict observation without changing the step grid."""
+        from tempo_tpu.block.fetch import scan_views
+        from tempo_tpu.traceql.engine import compile_query
+        from tempo_tpu.traceql.engine_metrics import MetricsEvaluator
+
+        _, freq = compile_query(req.query, req.start_ns, req.end_ns)
+        if metas is None:
+            metas = self.blocks(tenant, req.start_ns / 1e9, req.end_ns / 1e9)
+        ev = MetricsEvaluator(req, clip_start_ns, clip_end_ns)
+        for m in metas:
+            for view, cand in scan_views(self.backend_block(m), freq,
+                                         row_groups=row_groups):
+                if len(cand):
+                    ev.observe(view)
+        return ev.results()
+
     # -- polling -----------------------------------------------------------
 
     def poll_now(self) -> None:

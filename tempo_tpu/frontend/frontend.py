@@ -1,0 +1,253 @@
+"""The query-frontend service: per-endpoint pipelines over a job queue.
+
+Mirrors `modules/frontend/frontend.go:100-224`: each public endpoint
+(search, trace-by-id, query-range, tags) shards into jobs, dispatches via
+the tenant-fair queue to querier workers (pull model — in-process threads
+here, gRPC streams in the reference), and folds partial results through a
+combiner with early exit. With no workers started, jobs execute inline
+(the single-binary fast path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Sequence
+
+from tempo_tpu.db.tempodb import TempoDB
+from tempo_tpu.frontend.queue import RequestQueue
+from tempo_tpu.frontend.sharders import (
+    SearchJob,
+    backend_search_jobs,
+    prune_blocks_rf,
+    query_range_jobs,
+    time_windows,
+)
+from tempo_tpu.frontend.slos import SLOConfig, SLORecorder
+from tempo_tpu.model.combine import combine_spans, sort_spans
+from tempo_tpu.overrides import Overrides
+from tempo_tpu.querier.querier import Querier
+from tempo_tpu.traceql.engine import MetadataCombiner
+from tempo_tpu.traceql.engine_metrics import (
+    QueryRangeRequest,
+    SeriesCombiner,
+    TimeSeries,
+    metrics_kind,
+)
+
+
+@dataclasses.dataclass
+class FrontendConfig:
+    target_bytes_per_job: int = 100 * 1024 * 1024
+    metrics_target_bytes_per_job: int = 225 * 1024 * 1024
+    concurrent_jobs: int = 1000
+    max_outstanding_per_tenant: int = 2000
+    max_batch_size: int = 5
+    query_backend_after_s: float = 15 * 60
+    query_ingesters_until_s: float = 30 * 60
+    # RF of backend blocks eligible for metrics queries: 1 = generator
+    # localblocks / blockbuilder output (the reference's rule); None admits
+    # all blocks for single-writer deployments whose blocks are deduped
+    metrics_block_rf: int | None = 1
+    slo: dict[str, SLOConfig] = dataclasses.field(default_factory=dict)
+
+
+class _Job:
+    __slots__ = ("job", "fn", "result", "error", "event")
+
+    def __init__(self, job: SearchJob, fn: Callable[[SearchJob], Any]):
+        self.job = job
+        self.fn = fn
+        self.result: Any = None
+        self.error: Exception | None = None
+        self.event = threading.Event()
+
+    def run(self) -> None:
+        try:
+            self.result = self.fn(self.job)
+        except Exception as e:  # combiner decides whether partials suffice
+            self.error = e
+        self.event.set()
+
+
+class Frontend:
+    def __init__(self, db: TempoDB, querier: Querier,
+                 cfg: FrontendConfig | None = None,
+                 overrides: Overrides | None = None,
+                 generator_query_range: Callable[..., list[TimeSeries]] | None = None,
+                 now: Callable[[], float] = time.time) -> None:
+        self.db = db
+        self.querier = querier
+        self.cfg = cfg or FrontendConfig()
+        self.overrides = overrides or Overrides()
+        self.generator_query_range = generator_query_range
+        self.now = now
+        self.queue = RequestQueue(self.cfg.max_outstanding_per_tenant)
+        self.slos = SLORecorder(self.cfg.slo)
+        self._workers: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # -- worker pool (querier pull model) ----------------------------------
+
+    def start_workers(self, n: int = 2) -> None:
+        def loop():
+            while not self._stop.is_set():
+                batch = self.queue.dequeue_batch(self.cfg.max_batch_size,
+                                                 timeout_s=0.2)
+                for j in batch:
+                    j.run()
+        self._workers = [threading.Thread(target=loop, daemon=True)
+                         for _ in range(n)]
+        for t in self._workers:
+            t.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        for t in self._workers:
+            t.join(timeout=2)
+        self.queue.close()
+
+    def _run_jobs(self, tenant: str, jobs: Sequence[SearchJob],
+                  fn: Callable[[SearchJob], Any],
+                  on_result: Callable[[Any], bool]) -> int:
+        """Dispatch jobs; fold results via on_result (return False = early
+        exit, like streaming combiners cancelling remaining work). Raises
+        the first job error — a failed sub-query fails the whole query, as
+        partial silent results are worse than an error. Keeps at most
+        `concurrent_jobs` in flight so wide queries never trip the
+        per-tenant outstanding cap. Returns bytes processed (SLO)."""
+        wrapped = [_Job(j, fn) for j in jobs]
+        nbytes = 0
+        if not self._workers:
+            for wj in wrapped:          # inline single-binary path
+                wj.run()
+                if wj.error is not None:
+                    raise wj.error
+                nbytes += _job_bytes(wj.job)
+                if not on_result(wj.result):
+                    break
+            return nbytes
+        window = max(1, min(self.cfg.concurrent_jobs,
+                            self.cfg.max_outstanding_per_tenant - 1))
+        for wj in wrapped[:window]:
+            self.queue.enqueue(tenant, wj)
+        for i, wj in enumerate(wrapped):
+            while not wj.event.wait(timeout=0.5):
+                if self._stop.is_set():
+                    raise RuntimeError("frontend shutting down")
+            if i + window < len(wrapped):
+                self.queue.enqueue(tenant, wrapped[i + window])
+            if wj.error is not None:
+                raise wj.error
+            nbytes += _job_bytes(wj.job)
+            if not on_result(wj.result):
+                break
+        return nbytes
+
+    # -- endpoints ---------------------------------------------------------
+
+    def search(self, tenant: str, query: str, *, limit: int = 20,
+               start_s: float | None = None, end_s: float | None = None
+               ) -> list:
+        t0 = self.now()
+        end_s = end_s if end_s is not None else self.now()
+        start_s = start_s if start_s is not None else end_s - 3600.0
+        ing_win, be_win = time_windows(
+            self.now(), start_s, end_s,
+            self.cfg.query_backend_after_s, self.cfg.query_ingesters_until_s)
+        combiner = MetadataCombiner(limit)
+        nbytes = 0
+        if ing_win is not None:
+            for md in self.querier.search_recent(tenant, query, limit,
+                                                 *ing_win):
+                combiner.add(md)
+        if be_win is not None and not combiner.exhausted():
+            metas = self.db.blocks(tenant, be_win[0], be_win[1])
+            jobs = backend_search_jobs(tenant, metas, be_win[0], be_win[1],
+                                       self.cfg.target_bytes_per_job)
+
+            def fold(res) -> bool:
+                for md in res:
+                    combiner.add(md)
+                return not combiner.exhausted()
+
+            nbytes += self._run_jobs(
+                tenant, jobs,
+                lambda j: self.querier.search_block(
+                    tenant, query, j.meta, j.row_groups, limit,
+                    j.start_s, j.end_s),
+                fold)
+        self.slos.record("search", tenant, self.now() - t0, nbytes)
+        return combiner.results()
+
+    def find_trace(self, tenant: str, trace_id: bytes,
+                   start_s: float | None = None, end_s: float | None = None
+                   ) -> list[dict] | None:
+        t0 = self.now()
+        spans = self.querier.find_trace_by_id(tenant, trace_id, start_s, end_s)
+        self.slos.record("traces", tenant, self.now() - t0,
+                         len(spans or []) * 200)
+        return sort_spans(combine_spans(spans)) if spans else None
+
+    def query_range(self, tenant: str, query: str, *,
+                    start_s: float, end_s: float, step_s: float = 60.0
+                    ) -> list[TimeSeries]:
+        """TraceQL metrics: recent window from generators (RF1 local
+        blocks), older from backend jobs; job series merge via
+        SeriesCombiner then final quantile/rate pass
+        (`metrics_query_range_sharder.go` + `combiner/metrics_query_range.go`)."""
+        t0 = self.now()
+        req = QueryRangeRequest(query=query,
+                                start_ns=int(start_s * 1e9),
+                                end_ns=int(end_s * 1e9),
+                                step_ns=int(step_s * 1e9))
+        # single cutoff, not overlapping windows: generators own
+        # (cutoff, end], backend RF1 blocks own [start, cutoff] — sub-results
+        # keep the full step grid and clip observations to their side, so
+        # nothing is counted twice (TrimToBefore/After split,
+        # `metrics_query_range_sharder.go:125-190`)
+        cutoff_s = self.now() - self.cfg.query_backend_after_s
+        cutoff_ns = int(cutoff_s * 1e9)
+        comb = SeriesCombiner(metrics_kind(query), req.n_steps)
+        nbytes = 0
+        if end_s > cutoff_s and self.generator_query_range is not None:
+            comb.add_all(self.generator_query_range(
+                tenant, req, clip_start_ns=cutoff_ns))
+        if start_s < cutoff_s:
+            # metrics read ONLY RF1 blocks (generator localblocks /
+            # blockbuilder output) — ingester RF3 blocks hold every trace 3x
+            # (`blockMetasForSearch(..., rf=1)` sharder :190). Configurable
+            # for RF-deduped (compacted single-writer) setups.
+            metas = prune_blocks_rf(
+                self.db.blocks(tenant, start_s, min(end_s, cutoff_s)),
+                self.cfg.metrics_block_rf)
+            jobs = query_range_jobs(tenant, metas, start_s,
+                                    min(end_s, cutoff_s), step_s,
+                                    self.cfg.metrics_target_bytes_per_job)
+
+            def fold(res) -> bool:
+                comb.add_all(res)
+                return True
+
+            nbytes += self._run_jobs(
+                tenant, jobs,
+                lambda j: self.querier.query_range_block(
+                    tenant, req, j.meta, j.row_groups,
+                    clip_end_ns=cutoff_ns),
+                fold)
+        self.slos.record("metrics", tenant, self.now() - t0, nbytes)
+        return comb.final(req)
+
+    def tag_names(self, tenant: str) -> dict[str, list[str]]:
+        t0 = self.now()
+        out = self.querier.tag_names(tenant)
+        self.slos.record("metadata", tenant, self.now() - t0, 0)
+        return out
+
+
+def _job_bytes(job: SearchJob) -> int:
+    if job.meta is None:
+        return 0
+    n_rg = max(job.meta.row_group_count, 1)
+    return int(job.meta.size_bytes * (len(job.row_groups) or n_rg) / n_rg)

@@ -15,6 +15,8 @@ import threading
 import time
 from typing import Callable, Sequence
 
+import numpy as np
+
 from tempo_tpu.backend.raw import RawWriter, block_keypath
 from tempo_tpu.ingester.instance import InstanceConfig, TenantInstance
 from tempo_tpu.overrides import Overrides
@@ -141,17 +143,28 @@ class Ingester:
 
     def flush_tick(self, queue_idx: int | None = None) -> int:
         """Drain due ops (one queue when an index is given — the per-worker
-        loop — or all queues, for tests/manual ticks)."""
-        idxs = (range(self.cfg.concurrent_flushes)
-                if queue_idx is None else (queue_idx,))
+        loop — or all queues until quiescent, for tests/manual ticks: an
+        OP_COMPLETE chains an OP_FLUSH that may hash to any queue, so a
+        single pass is not enough)."""
         n = 0
-        for qi in idxs:
+        if queue_idx is not None:
             while True:
-                got = self.queues.dequeue(qi)
+                got = self.queues.dequeue(queue_idx)
                 if got is None:
-                    break
+                    return n
                 self._handle_op(*got)
                 n += 1
+        progressed = True
+        while progressed:
+            progressed = False
+            for qi in range(self.cfg.concurrent_flushes):
+                while True:
+                    got = self.queues.dequeue(qi)
+                    if got is None:
+                        break
+                    self._handle_op(*got)
+                    n += 1
+                    progressed = True
         return n
 
     def flush_all(self) -> None:
@@ -160,6 +173,53 @@ class Ingester:
         self.queues.drain(self._handle_op)
         # completion enqueues flush ops; drain those too
         self.queues.drain(self._handle_op)
+
+    # -- read path (recent data, `instance_search.go`) ---------------------
+
+    def find_trace_by_id(self, tenant: str, trace_id: bytes) -> list[dict] | None:
+        with self.lock:
+            if tenant not in self.instances:
+                return None
+        return self.instance(tenant).find_trace_by_id(trace_id)
+
+    def search(self, tenant: str, query: str, limit: int = 20,
+               start_s: float = 0, end_s: float = 0):
+        """TraceQL over live+WAL data (in-memory ColumnView) and local
+        complete blocks — the ingester side of querier fan-out."""
+        from tempo_tpu.block.fetch import scan_views
+        from tempo_tpu.traceql.engine import compile_query, execute_search
+        from tempo_tpu.traceql.memview import view_from_traces
+
+        with self.lock:
+            if tenant not in self.instances:
+                return []
+        inst = self.instance(tenant)
+        _, req = compile_query(query, int(start_s * 1e9), int(end_s * 1e9))
+
+        def views():
+            traces = inst.all_recent_traces()
+            if traces:
+                v = view_from_traces(traces)
+                yield v, np.arange(v.n)
+            for b in inst.complete_blocks():
+                yield from scan_views(b, req)
+
+        return execute_search(query, views(), limit=limit,
+                              start_ns=int(start_s * 1e9),
+                              end_ns=int(end_s * 1e9))
+
+    def tag_names(self, tenant: str) -> dict[str, list[str]]:
+        from tempo_tpu.traceql.engine import execute_tag_names
+        from tempo_tpu.traceql.memview import view_from_traces
+
+        with self.lock:
+            if tenant not in self.instances:
+                return {}
+        traces = self.instance(tenant).all_recent_traces()
+        if not traces:
+            return {}
+        v = view_from_traces(traces)
+        return execute_tag_names([(v, np.arange(v.n))])
 
     # -- replay ------------------------------------------------------------
 

@@ -1,0 +1,149 @@
+"""The querier service.
+
+Read-side counterpart of the distributor: resolves the trace's replication
+set on the ring, requires quorum successful responses
+(`forIngesterRings` `querier.go:318`), merges ingester recent data with
+backend blocks (tempodb), and executes frontend-sharded block jobs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Protocol, Sequence
+
+import numpy as np
+
+from tempo_tpu.backend.meta import BlockMeta
+from tempo_tpu.db.tempodb import TempoDB
+from tempo_tpu.model.combine import combine_spans, sort_spans
+from tempo_tpu.ops.hashing import token_for
+from tempo_tpu.overrides import Overrides
+from tempo_tpu.ring import Ring
+from tempo_tpu.traceql.engine import MetadataCombiner
+
+
+class IngesterQueryClient(Protocol):
+    def find_trace_by_id(self, tenant: str, trace_id: bytes) -> list[dict] | None: ...
+    def search(self, tenant: str, query: str, limit: int = 20,
+               start_s: float = 0, end_s: float = 0): ...
+    def tag_names(self, tenant: str) -> dict[str, list[str]]: ...
+
+
+@dataclasses.dataclass
+class QuerierConfig:
+    rf: int = 3
+    query_mode_all: bool = True     # ingesters + blocks (QueryModeAll)
+
+
+class Querier:
+    def __init__(self, db: TempoDB,
+                 ingester_ring: Ring | None = None,
+                 ingester_clients: dict[str, IngesterQueryClient] | None = None,
+                 overrides: Overrides | None = None,
+                 cfg: QuerierConfig | None = None,
+                 now: Callable[[], float] = time.time) -> None:
+        self.db = db
+        self.ring = ingester_ring
+        self.clients = ingester_clients or {}
+        self.overrides = overrides or Overrides()
+        self.cfg = cfg or QuerierConfig()
+        self.now = now
+
+    # -- trace by id -------------------------------------------------------
+
+    def find_trace_by_id(self, tenant: str, trace_id: bytes,
+                         start_s: float | None = None,
+                         end_s: float | None = None) -> list[dict] | None:
+        """Quorum read across the trace's replication set + backend blocks;
+        results combined/deduped (RF3 write → spans appear ≤3 times)."""
+        parts: list[list[dict]] = []
+        if self.ring is not None and self.clients:
+            mat = np.frombuffer(trace_id.ljust(16, b"\0")[:16], np.uint8)[None, :]
+            token = int(token_for(tenant, mat)[0])
+            rs = self.ring.get(token, self.cfg.rf)
+            failures = 0
+            for inst in rs.instances:
+                try:
+                    spans = self.clients[inst.id].find_trace_by_id(tenant, trace_id)
+                except Exception:
+                    failures += 1
+                    if failures > rs.max_errors:
+                        raise
+                    continue
+                if spans:
+                    parts.append(spans)
+        if self.cfg.query_mode_all:
+            spans = self.db.find_trace_by_id(tenant, trace_id, start_s, end_s)
+            if spans:
+                parts.append(spans)
+        if not parts:
+            return None
+        return sort_spans(combine_spans(*parts))
+
+    # -- search ------------------------------------------------------------
+
+    def search_recent(self, tenant: str, query: str, limit: int = 20,
+                      start_s: float = 0, end_s: float = 0):
+        """Fan search to every healthy ingester; merge top-N metadata.
+        (Search fans to all ingesters — any of them may hold any trace's
+        replicas; quorum applies per-ring-health not per-result.)"""
+        combiner = MetadataCombiner(limit)
+        if self.ring is None:
+            return []
+        for inst in self.ring.healthy_instances():
+            client = self.clients.get(inst.id)
+            if client is None:
+                continue
+            for md in client.search(tenant, query, limit, start_s, end_s):
+                combiner.add(md)
+        return combiner.results()
+
+    def search_block(self, tenant: str, query: str, meta: BlockMeta,
+                     row_groups: Sequence[int] | None = None,
+                     limit: int = 20,
+                     start_s: float | None = None, end_s: float | None = None):
+        """One frontend-sharded backend job (`SearchBlock` `querier.go:780`)."""
+        return self.db.search(tenant, query, limit=limit,
+                              start_s=start_s, end_s=end_s,
+                              metas=[meta], row_groups=row_groups)
+
+    def query_range_block(self, tenant: str, req, meta: BlockMeta,
+                          row_groups: Sequence[int] | None = None,
+                          clip_start_ns: int | None = None,
+                          clip_end_ns: int | None = None):
+        """One metrics job: raw evaluator over a block slice; job-level
+        series to be combined at the frontend (AggregateModeSum)."""
+        return self.db.query_range(tenant, req, metas=[meta],
+                                   row_groups=row_groups,
+                                   clip_start_ns=clip_start_ns,
+                                   clip_end_ns=clip_end_ns)
+
+    # -- tags --------------------------------------------------------------
+
+    def tag_names(self, tenant: str, scopes: Sequence[str] = ("span", "resource"),
+                  limit_bytes: int = 0) -> dict[str, list[str]]:
+        out: dict[str, set] = {}
+        if self.ring is not None:
+            for inst in self.ring.healthy_instances():
+                client = self.clients.get(inst.id)
+                if client is None:
+                    continue
+                for scope, names in client.tag_names(tenant).items():
+                    out.setdefault(scope, set()).update(names)
+        # backend blocks: key-list columns only, under a global byte budget
+        from tempo_tpu.block.fetch import block_tag_names
+        limit_bytes = limit_bytes or \
+            self.overrides.for_tenant(tenant).read.max_bytes_per_tag_values_query
+        used = sum(len(n) for names in out.values() for n in names)
+        for m in self.db.blocks(tenant):
+            if limit_bytes and used >= limit_bytes:
+                break
+            per_block = block_tag_names(
+                self.db.backend_block(m),
+                byte_budget=(limit_bytes - used) if limit_bytes else 0)
+            for scope, names in per_block.items():
+                fresh = names - out.setdefault(scope, set())
+                used += sum(len(n) for n in fresh)
+                out[scope] |= fresh
+        return {k: sorted(v) for k, v in out.items() if k in scopes or not scopes}

@@ -166,8 +166,16 @@ class MetricsEvaluator:
     per job; `observe(view)` per scan batch; `results()` → job-level series.
     """
 
-    def __init__(self, req: QueryRangeRequest):
+    def __init__(self, req: QueryRangeRequest,
+                 clip_start_ns: int | None = None,
+                 clip_end_ns: int | None = None):
         self.req = req
+        # observation clip: sub-requests (backend jobs vs generator window)
+        # keep the FULL step grid but only observe spans inside their slice,
+        # so combiner tensor-adds line up and the cutoff dedupes sources
+        # (the TrimToBefore/After split, metrics_query_range_sharder.go:178)
+        self.clip_start_ns = max(req.start_ns, clip_start_ns or req.start_ns)
+        self.clip_end_ns = min(req.end_ns, clip_end_ns or req.end_ns)
         self.q = parse(req.query)
         if self.q.metrics is None:
             raise ValueError("not a metrics query: " + req.query)
@@ -235,7 +243,7 @@ class MetricsEvaluator:
         ts = st.values[rows]
         step = ((ts - self.req.start_ns) / self.req.step_ns).astype(np.int64)
         inside = (step >= 0) & (step < self.n_steps) & \
-                 (ts >= self.req.start_ns) & (ts < self.req.end_ns)
+                 (ts >= self.clip_start_ns) & (ts < self.clip_end_ns)
         rows, step = rows[inside], step[inside]
         if len(rows) == 0:
             return
@@ -526,6 +534,14 @@ class SeriesCombiner:
                 labels = base + (("p", qv),)
                 out.append(TimeSeries(labels, samples, exemplars.get(base, [])))
         return out
+
+
+def metrics_kind(query: str) -> A.MetricsKind:
+    """Metrics stage kind of a query, without building an evaluator."""
+    q = parse(query)
+    if q.metrics is None:
+        raise ValueError("not a metrics query: " + query)
+    return q.metrics.kind
 
 
 def query_range(req: QueryRangeRequest,

@@ -1,0 +1,198 @@
+"""Read path: querier fan-out + frontend sharding/queueing/combining."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tempo_tpu.backend.mem import MemBackend
+from tempo_tpu.db.tempodb import TempoDB, TempoDBConfig
+from tempo_tpu.frontend import Frontend, FrontendConfig, RequestQueue
+from tempo_tpu.frontend.sharders import (
+    backend_search_jobs,
+    time_windows,
+    trace_id_shards,
+)
+from tempo_tpu.frontend.slos import SLOConfig, SLORecorder
+from tempo_tpu.ingester import Ingester, IngesterConfig
+from tempo_tpu.ingester.instance import InstanceConfig
+from tempo_tpu.querier import Querier
+from tempo_tpu.ring import ACTIVE, InstanceDesc, Ring
+from tempo_tpu.ring.ring import _instance_tokens
+
+T0 = 1_700_000_000.0
+
+
+def mkspan(tid, sid, name="op", svc="svc", t0_s=T0, dur_ms=50, **kw):
+    t0 = int(t0_s * 1e9)
+    return {"trace_id": tid, "span_id": sid, "name": name, "service": svc,
+            "start_unix_nano": t0, "end_unix_nano": t0 + int(dur_ms * 1e6), **kw}
+
+
+@pytest.fixture
+def stack(tmp_path):
+    """backend blocks + one ingester with recent data + frontend/querier."""
+    clock = [T0 + 3600.0]
+    now = lambda: clock[0]
+    be = MemBackend()
+    db = TempoDB(be, be)
+    # old data: 2 blocks in the backend (written 1h ago). RF1, like
+    # generator-localblocks output — the only blocks metrics may read.
+    for blk in range(2):
+        traces = []
+        for i in range(1, 6):
+            tid = bytes([blk * 16 + i]) * 16
+            traces.append((tid, [mkspan(tid, bytes([i]) * 8,
+                                        svc=f"svc-{blk}", t0_s=T0 + i)]))
+        db.write_block("t1", traces, replication_factor=1)
+    db.poll_now()
+    # recent data: one ingester with live traces (now)
+    ring = Ring(replication_factor=1, now=now)
+    ing = Ingester(str(tmp_path / "ing"), flush_writer=be,
+                   cfg=IngesterConfig(instance=InstanceConfig()),
+                   now=now, instance_id="ing-0")
+    ring.register(InstanceDesc(id="ing-0", state=ACTIVE,
+                               tokens=_instance_tokens("ing-0", 64),
+                               heartbeat_ts=now()))
+    rid = b"\xaa" * 16
+    ing.push("t1", [(rid, [mkspan(rid, b"\x01" * 8, svc="recent-svc",
+                                  t0_s=now() - 10)])])
+    q = Querier(db, ring, {"ing-0": ing},
+                cfg=__import__("tempo_tpu.querier.querier", fromlist=["QuerierConfig"]).QuerierConfig(rf=1))
+    fe = Frontend(db, q, cfg=FrontendConfig(
+        target_bytes_per_job=1,   # force many row-group jobs
+        slo={"search": SLOConfig(duration_slo_s=60.0)}), now=now)
+    return clock, now, be, db, ring, ing, q, fe, rid
+
+
+def test_time_windows_split():
+    now = 10_000.0
+    ing, be = time_windows(now, 0.0, now, backend_after_s=900,
+                           ingesters_until_s=1800)
+    assert ing == (now - 1800, now)
+    assert be == (0.0, now - 900)
+    # all-recent query: no backend window
+    ing2, be2 = time_windows(now, now - 60, now, 900, 1800)
+    assert be2 is None and ing2 == (now - 60, now)
+
+
+def test_backend_jobs_target_bytes(stack):
+    clock, now, be, db, *_ = stack
+    metas = db.blocklist.metas("t1")
+    jobs = backend_search_jobs("t1", metas, 0, now(), target_bytes_per_job=1)
+    # 1 byte/job target → one job per row group
+    assert len(jobs) == sum(m.row_group_count for m in metas)
+    jobs_big = backend_search_jobs("t1", metas, 0, now(),
+                                   target_bytes_per_job=10 ** 9)
+    assert len(jobs_big) == len(metas)
+
+
+def test_frontend_search_merges_recent_and_backend(stack):
+    clock, now, be, db, ring, ing, q, fe, rid = stack
+    res = fe.search("t1", "{ }", limit=50, start_s=0, end_s=now())
+    svcs = {r.root_service_name for r in res}
+    assert "recent-svc" in svcs          # via ingester window
+    assert "svc-0" in svcs and "svc-1" in svcs  # via backend jobs
+    assert len(res) == 11
+    # SLO recorded
+    assert fe.slos.within[("search", "t1")] == 1
+
+
+def test_frontend_search_filters(stack):
+    clock, now, be, db, ring, ing, q, fe, rid = stack
+    res = fe.search("t1", '{ resource.service.name = "svc-1" }',
+                    limit=50, start_s=0, end_s=now())
+    assert len(res) == 5
+    assert all(r.root_service_name == "svc-1" for r in res)
+
+
+def test_frontend_early_exit_limit(stack):
+    clock, now, be, db, ring, ing, q, fe, rid = stack
+    res = fe.search("t1", "{ }", limit=3, start_s=0, end_s=now())
+    assert len(res) == 3
+
+
+def test_find_trace_combines_ingester_and_backend(stack):
+    clock, now, be, db, ring, ing, q, fe, rid = stack
+    spans = fe.find_trace("t1", rid)
+    assert spans is not None and len(spans) == 1
+    old = fe.find_trace("t1", bytes([1]) * 16)
+    assert old is not None and old[0]["name"] == "op"
+    assert fe.find_trace("t1", b"\x77" * 16) is None
+
+
+def test_frontend_query_range_over_blocks(stack):
+    clock, now, be, db, ring, ing, q, fe, rid = stack
+    series = fe.query_range("t1", "{ } | rate()",
+                            start_s=T0 - 60, end_s=T0 + 600, step_s=60.0)
+    assert series
+    total = sum(float(np.nansum(s.samples)) for s in series)
+    assert total > 0
+
+
+def test_frontend_query_range_quantile(stack):
+    clock, now, be, db, ring, ing, q, fe, rid = stack
+    series = fe.query_range(
+        "t1", "{ } | quantile_over_time(duration, .5)",
+        start_s=T0 - 60, end_s=T0 + 600, step_s=660.0)
+    vals = [v for s in series for v in s.samples if np.isfinite(v) and v > 0]
+    assert vals
+    # durations are 50ms; log2 quantile estimate must land within 2x
+    assert 0.02 < vals[0] < 0.2
+
+
+def test_queue_tenant_fairness():
+    q = RequestQueue(max_outstanding_per_tenant=10)
+    for i in range(6):
+        q.enqueue("a", f"a{i}")
+    q.enqueue("b", "b0")
+    seen = []
+    while True:
+        batch = q.dequeue_batch(2)
+        if not batch:
+            break
+        seen.append(batch)
+    flat = [x for b in seen for x in b]
+    assert set(flat) == {"a0", "a1", "a2", "a3", "a4", "a5", "b0"}
+    # tenant b served before tenant a exhausts (round-robin)
+    b_pos = flat.index("b0")
+    assert b_pos < 6
+
+
+def test_queue_outstanding_cap():
+    from tempo_tpu.frontend.queue import QueueFull
+    q = RequestQueue(max_outstanding_per_tenant=2)
+    q.enqueue("a", 1)
+    q.enqueue("a", 2)
+    with pytest.raises(QueueFull):
+        q.enqueue("a", 3)
+
+
+def test_frontend_with_worker_pool(stack):
+    clock, now, be, db, ring, ing, q, fe, rid = stack
+    fe.start_workers(2)
+    try:
+        res = fe.search("t1", "{ }", limit=50, start_s=0, end_s=now())
+        assert len(res) == 11
+    finally:
+        fe.shutdown()
+
+
+def test_trace_id_shards_cover_space():
+    shards = trace_id_shards(4)
+    assert len(shards) == 4
+    assert shards[0][0] == b"\x00" * 16
+    assert shards[-1][1] == b"\xff" * 16
+    for (lo, hi), (lo2, _) in zip(shards, shards[1:]):
+        assert hi > lo
+        assert lo2 == hi  # shared boundaries: no gap, no overlap
+
+
+def test_slo_recorder_throughput_criterion():
+    r = SLORecorder({"search": SLOConfig(duration_slo_s=1.0,
+                                         throughput_bytes_slo=1000.0)})
+    assert r.record("search", "t", 0.5, 0) is True            # fast
+    assert r.record("search", "t", 5.0, 100_000) is True      # slow but hefty
+    assert r.record("search", "t", 5.0, 100) is False         # slow and small
+    assert r.total[("search", "t")] == 3
+    assert r.within[("search", "t")] == 2
