@@ -131,14 +131,14 @@ class TempoDB:
         from tempo_tpu.block.fetch import scan_views
         from tempo_tpu.traceql.engine import compile_query, execute_search
 
-        _, req = compile_query(query,
+        q, req = compile_query(query,
                                int((start_s or 0) * 1e9), int((end_s or 0) * 1e9))
         if metas is None:
             metas = self.blocks(tenant, start_s, end_s)
         views = (v for m in metas
                  for v in scan_views(self.backend_block(m), req,
                                      row_groups=row_groups))
-        return execute_search(query, views, limit=limit,
+        return execute_search(q, views, limit=limit,
                               start_ns=int((start_s or 0) * 1e9),
                               end_ns=int((end_s or 0) * 1e9))
 

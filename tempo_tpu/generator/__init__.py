@@ -12,5 +12,6 @@ from tempo_tpu.generator.remote_write import (
     RemoteWriteClient,
 )
 from tempo_tpu.generator.instance import GeneratorInstance, GeneratorConfig
+from tempo_tpu.generator.generator import Generator
 
 __all__ = [k for k in dir() if not k.startswith("_")]

@@ -1,0 +1,123 @@
+"""The metrics-generator service: tenants, ticks, and the push entry.
+
+Analog of `modules/generator/generator.go`: `push_spans` (the
+`MetricsGenerator.PushSpans` RPC, `generator.go:275`) creates/loads the
+tenant instance, stages the span dicts into a SpanBatch built on the
+tenant registry's interner, and hands it to the processors; a collection
+loop drives every instance's registry tick; `query_range`/`get_metrics`
+serve the frontend's recent-window metrics reads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Sequence
+
+from tempo_tpu.generator.instance import GeneratorConfig, GeneratorInstance
+from tempo_tpu.model.span_batch import SpanBatchBuilder
+from tempo_tpu.overrides import Overrides
+
+
+class Generator:
+    def __init__(self, cfg: GeneratorConfig | None = None,
+                 overrides: Overrides | None = None,
+                 instance_id: str = "generator-0",
+                 now: Callable[[], float] = time.time) -> None:
+        self.base_cfg = cfg or GeneratorConfig()
+        self.overrides = overrides or Overrides()
+        self.id = instance_id
+        self.now = now
+        self.instances: dict[str, GeneratorInstance] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def instance(self, tenant: str) -> GeneratorInstance:
+        with self._lock:
+            inst = self.instances.get(tenant)
+            if inst is None:
+                lim = self.overrides.for_tenant(tenant)
+                cfg = dataclasses.replace(self.base_cfg)
+                if lim.generator.processors:
+                    cfg.processors = tuple(lim.generator.processors)
+                cfg.registry = dataclasses.replace(
+                    cfg.registry,
+                    max_active_series=lim.generator.max_active_series,
+                    collection_interval_s=lim.generator.collection_interval_s,
+                    disable_collection=lim.generator.disable_collection)
+                cfg.ingestion_time_range_slack_s = \
+                    lim.generator.ingestion_time_range_slack_s
+                inst = self.instances[tenant] = GeneratorInstance(
+                    tenant, cfg, now=self.now)
+            return inst
+
+    # -- write (PushSpans RPC analog; the distributor's GeneratorClient) ---
+
+    def push_spans(self, tenant: str, spans: Sequence[dict]) -> None:
+        inst = self.instance(tenant)
+        b = SpanBatchBuilder(inst.registry.interner)
+        for s in spans:
+            b.append(
+                trace_id=s.get("trace_id", b""),
+                span_id=s.get("span_id", b""),
+                parent_span_id=s.get("parent_span_id", b""),
+                name=s.get("name", ""),
+                service=s.get("service", ""),
+                kind=int(s.get("kind", 0)),
+                status_code=int(s.get("status_code", 0)),
+                status_message=s.get("status_message", ""),
+                start_unix_nano=int(s.get("start_unix_nano", 0)),
+                end_unix_nano=int(s.get("end_unix_nano", 0)),
+                attrs=s.get("attrs"),
+                res_attrs=s.get("res_attrs"))
+        inst.push_batch(b.build())
+
+    # -- reads (frontend generator_query_range hook) -----------------------
+
+    def query_range(self, tenant: str, req, clip_start_ns: int | None = None):
+        with self._lock:
+            if tenant not in self.instances:
+                return []
+        return self.instance(tenant).query_range(req, clip_start_ns=clip_start_ns)
+
+    def get_metrics(self, tenant: str, query: str, group_by,
+                    max_series: int = 1000):
+        with self._lock:
+            if tenant not in self.instances:
+                from tempo_tpu.traceql.metrics_summary import MetricsResults
+                return MetricsResults(max_series)
+        return self.instance(tenant).get_metrics(query, group_by,
+                                                 max_series=max_series)
+
+    # -- loops -------------------------------------------------------------
+
+    def collect_all(self) -> int:
+        """One collection tick for every tenant (registry → remote write)."""
+        with self._lock:
+            insts = list(self.instances.values())
+        total = 0
+        for inst in insts:
+            if not inst.registry.overrides.disable_collection:
+                total += inst.collect_and_push()
+            inst.tick()
+        return total
+
+    def start(self) -> None:
+        def loop():
+            interval = self.base_cfg.registry.collection_interval_s
+            while not self._stop.wait(interval):
+                try:
+                    self.collect_all()
+                except Exception:
+                    pass
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        self.collect_all()

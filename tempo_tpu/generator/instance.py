@@ -37,6 +37,8 @@ class GeneratorConfig:
     spanmetrics: SpanMetricsConfig = dataclasses.field(default_factory=SpanMetricsConfig)
     servicegraphs: ServiceGraphsConfig = dataclasses.field(default_factory=ServiceGraphsConfig)
     remote_write: RemoteWriteConfig = dataclasses.field(default_factory=RemoteWriteConfig)
+    localblocks: "object" = None            # LocalBlocksConfig | None
+    localblocks_flush_writer: "object" = None  # RawWriter for flush_to_storage
     ingestion_time_range_slack_s: float = 30.0
 
 
@@ -72,14 +74,12 @@ class GeneratorInstance:
                     self.processors[name] = ServiceGraphsProcessor(
                         self.registry, self.cfg.servicegraphs)
                 elif name == "local-blocks":
-                    try:
-                        from tempo_tpu.generator.processors.localblocks import (
-                            LocalBlocksProcessor)
-                    except ImportError as e:
-                        raise NotImplementedError(
-                            "local-blocks processor requires the storage "
-                            "layer (tempo_tpu.storage); not yet built") from e
-                    self.processors[name] = LocalBlocksProcessor(self.registry)
+                    from tempo_tpu.generator.processors.localblocks import (
+                        LocalBlocksProcessor)
+                    self.processors[name] = LocalBlocksProcessor(
+                        self.tenant, self.cfg.localblocks,
+                        flush_writer=self.cfg.localblocks_flush_writer,
+                        now=self.now)
                 else:
                     raise ValueError(f"unknown processor {name}")
 
@@ -120,3 +120,29 @@ class GeneratorInstance:
                   if self.cfg.remote_write.send_native_histograms else [])
         self.remote_write.send(samples, native)
         return len(samples)
+
+    # -- maintenance -------------------------------------------------------
+
+    def tick(self, immediate: bool = False) -> None:
+        """Background maintenance: localblocks cut/complete/flush pass."""
+        lb = self.processors.get("local-blocks")
+        if lb is not None:
+            lb.cut_tick(immediate=immediate)
+
+    # -- reads (recent-data query entry points) ----------------------------
+
+    def query_range(self, req, clip_start_ns: int | None = None):
+        """TraceQL metrics over this tenant's local blocks (`QueryRange`
+        `instance.go:487-556`). Raises if local-blocks isn't enabled, like
+        the reference's errors when the processor is absent."""
+        lb = self.processors.get("local-blocks")
+        if lb is None:
+            raise RuntimeError("local-blocks processor not enabled")
+        return lb.query_range(req, clip_start_ns=clip_start_ns)
+
+    def get_metrics(self, query: str, group_by, max_series: int = 1000):
+        """Span-metrics summary (`GetMetrics` `instance.go:475`)."""
+        lb = self.processors.get("local-blocks")
+        if lb is None:
+            raise RuntimeError("local-blocks processor not enabled")
+        return lb.get_metrics(query, group_by, max_series=max_series)

@@ -194,7 +194,7 @@ class Ingester:
             if tenant not in self.instances:
                 return []
         inst = self.instance(tenant)
-        _, req = compile_query(query, int(start_s * 1e9), int(end_s * 1e9))
+        q, req = compile_query(query, int(start_s * 1e9), int(end_s * 1e9))
 
         def views():
             traces = inst.all_recent_traces()
@@ -204,22 +204,29 @@ class Ingester:
             for b in inst.complete_blocks():
                 yield from scan_views(b, req)
 
-        return execute_search(query, views(), limit=limit,
+        return execute_search(q, views(), limit=limit,
                               start_ns=int(start_s * 1e9),
                               end_ns=int(end_s * 1e9))
 
     def tag_names(self, tenant: str) -> dict[str, list[str]]:
+        from tempo_tpu.block.fetch import block_tag_names
         from tempo_tpu.traceql.engine import execute_tag_names
         from tempo_tpu.traceql.memview import view_from_traces
 
         with self.lock:
             if tenant not in self.instances:
                 return {}
-        traces = self.instance(tenant).all_recent_traces()
-        if not traces:
-            return {}
-        v = view_from_traces(traces)
-        return execute_tag_names([(v, np.arange(v.n))])
+        inst = self.instance(tenant)
+        traces = inst.all_recent_traces()
+        out: dict[str, set] = {"span": set(), "resource": set()}
+        if traces:
+            v = view_from_traces(traces)
+            for scope, names in execute_tag_names([(v, np.arange(v.n))]).items():
+                out.setdefault(scope, set()).update(names)
+        for b in inst.complete_blocks():
+            for scope, names in block_tag_names(b).items():
+                out.setdefault(scope, set()).update(names)
+        return {k: sorted(v) for k, v in out.items()}
 
     # -- replay ------------------------------------------------------------
 

@@ -44,6 +44,7 @@ class InstanceConfig:
     trace_live_s: float = 30.0             # max live time before forced cut
     dedicated_columns: tuple = ()
     row_group_rows: int = 50_000
+    replication_factor: int = 3            # 1 for generator localblocks
 
 
 @dataclasses.dataclass
@@ -141,7 +142,7 @@ class TenantInstance:
             block_id=wal_block.block_id,
             dedicated_columns=self.cfg.dedicated_columns,
             row_group_rows=self.cfg.row_group_rows,
-            replication_factor=3)
+            replication_factor=self.cfg.replication_factor)
         with self.lock:
             self.complete[meta.block_id] = LocalBlockEntry(
                 meta, BackendBlock(self.local_backend, meta))

@@ -142,6 +142,61 @@ class SpanBatch:
         out[has] = svals[np.arange(self.capacity), idx][has]
         return out
 
+    def to_span_dicts(self) -> list[dict]:
+        """Valid rows as flat span dicts (the WAL/storage span form).
+
+        The bridge from the device-friendly SoA back to durable storage —
+        used by the localblocks processor, whose job is persistence
+        (`modules/generator/processor/localblocks/processor.go:151`)."""
+        it = self.interner
+        out = []
+        k_has = self.span_attr_key.shape[1] > 0
+        r_has = self.res_attr_key.shape[1] > 0
+        for i in np.flatnonzero(self.valid[: self.n]):
+            s: dict = {
+                "trace_id": self.trace_id[i].tobytes(),
+                "span_id": self.span_id[i].tobytes(),
+                "parent_span_id": self.parent_span_id[i].tobytes(),
+                "name": it.lookup(int(self.name_id[i])),
+                "service": it.lookup(int(self.service_id[i])),
+                "kind": int(self.kind[i]),
+                "status_code": int(self.status_code[i]),
+                "start_unix_nano": int(self.start_unix_nano[i]),
+                "end_unix_nano": int(self.end_unix_nano[i]),
+            }
+            if int(self.status_message_id[i]) != INVALID_ID:
+                s["status_message"] = it.lookup(int(self.status_message_id[i]))
+            if k_has:
+                a = self._decode_attrs(self.span_attr_key[i], self.span_attr_sval[i],
+                                       self.span_attr_fval[i], self.span_attr_typ[i])
+                if a:
+                    s["attrs"] = a
+            if r_has:
+                a = self._decode_attrs(self.res_attr_key[i], self.res_attr_sval[i],
+                                       self.res_attr_fval[i], self.res_attr_typ[i])
+                if a:
+                    s["res_attrs"] = a
+            out.append(s)
+        return out
+
+    def _decode_attrs(self, keys, svals, fvals, typs) -> dict:
+        it = self.interner
+        out = {}
+        for j in range(len(keys)):
+            kid = int(keys[j])
+            if kid == INVALID_ID:
+                continue
+            t = int(typs[j])
+            if t == ATTR_STRING:
+                out[it.lookup(kid)] = it.lookup(int(svals[j]))
+            elif t == ATTR_BOOL:
+                out[it.lookup(kid)] = bool(fvals[j])
+            elif t == ATTR_INT:
+                out[it.lookup(kid)] = int(fvals[j])
+            elif t == ATTR_DOUBLE:
+                out[it.lookup(kid)] = float(fvals[j])
+        return out
+
     def tid_hash64(self) -> tuple[np.ndarray, np.ndarray]:
         """Two uint32 trace-id hash columns (device grouping / HLL keys)."""
         v = self.trace_id.view(np.uint32).reshape(self.capacity, 4)
