@@ -1,0 +1,255 @@
+"""HTTP API: the public surface of `pkg/api/http.go:68-84`.
+
+Paths (Tempo-compatible):
+  POST /v1/traces                      OTLP HTTP ingest (json or protobuf)
+  GET  /api/traces/{id}                trace by id (json spans)
+  GET  /api/search?q=&start=&end=&limit=
+  GET  /api/search/tags[?scope=]
+  GET  /api/search/tag/{name}/values
+  GET  /api/metrics/query_range?q=&start=&end=&step=
+  GET  /api/metrics/summary?q=&groupBy=    (span-metrics summary)
+  GET  /api/overrides            (+POST)   user-configurable overrides
+  GET  /ready /status /metrics /api/echo
+
+Multi-tenancy: `X-Scope-OrgID` header; without it the fake single tenant
+is used (dskit user injection behavior).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+FAKE_TENANT = "single-tenant"
+
+
+def _json_bytes(obj) -> bytes:
+    return json.dumps(obj).encode()
+
+
+class Handler(BaseHTTPRequestHandler):
+    app = None  # set by serve()
+
+    # quiet logs
+    def log_message(self, fmt, *args):  # noqa: A003
+        pass
+
+    # -- helpers -----------------------------------------------------------
+
+    def _tenant(self) -> str:
+        t = self.headers.get("X-Scope-OrgID", "")
+        if not t:
+            if self.app.cfg.multitenancy_enabled:
+                return ""
+            return FAKE_TENANT
+        return t
+
+    def _reply(self, code: int, body: bytes = b"",
+               ctype: str = "application/json") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _err(self, code: int, msg: str) -> None:
+        self._reply(code, _json_bytes({"error": msg}))
+
+    def _q(self) -> dict:
+        return {k: v[0] for k, v in
+                parse_qs(urlparse(self.path).query).items()}
+
+    # -- ingest ------------------------------------------------------------
+
+    def do_POST(self) -> None:  # noqa: N802
+        path = urlparse(self.path).path
+        tenant = self._tenant()
+        if not tenant:
+            return self._err(401, "no org id")
+        try:
+            if path == "/v1/traces":
+                return self._push(tenant)
+            if path == "/api/overrides":
+                return self._set_overrides(tenant)
+        except Exception as e:
+            return self._err(500, str(e))
+        self._err(404, f"unknown path {path}")
+
+    def _push(self, tenant: str) -> None:
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        ctype = self.headers.get("Content-Type", "")
+        from tempo_tpu.model.otlp import spans_from_otlp_json, spans_from_otlp_proto
+        if "json" in ctype:
+            spans = list(spans_from_otlp_json(json.loads(body)))
+        else:
+            spans = list(spans_from_otlp_proto(body))
+        from tempo_tpu.distributor.distributor import RateLimited
+        try:
+            errs = self.app.distributor.push_spans(tenant, spans)
+        except RateLimited as e:
+            self.send_response(429)
+            self.send_header("Retry-After", "1")
+            self.end_headers()
+            return
+        self._reply(200, _json_bytes({"errors": errs} if errs else {}))
+
+    def _set_overrides(self, tenant: str) -> None:
+        n = int(self.headers.get("Content-Length", 0))
+        patch = json.loads(self.rfile.read(n) or b"{}")
+        version = self.headers.get("If-Match")
+        ver = self.app.overrides.user_configurable.set(tenant, patch, version)
+        self._reply(200, _json_bytes({"version": ver}))
+
+    # -- reads -------------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802
+        path = urlparse(self.path).path
+        q = self._q()
+        try:
+            if path == "/ready":
+                return self._reply(200 if self.app.ready else 503,
+                                   b"ready" if self.app.ready else b"starting",
+                                   "text/plain")
+            if path == "/api/echo":
+                return self._reply(200, b"echo", "text/plain")
+            if path == "/status" or path.startswith("/status/"):
+                return self._status(path)
+            if path == "/metrics":
+                return self._self_metrics()
+            tenant = self._tenant()
+            if not tenant:
+                return self._err(401, "no org id")
+            if path.startswith("/api/traces/"):
+                return self._trace_by_id(tenant, path.split("/")[-1])
+            if path == "/api/search":
+                return self._search(tenant, q)
+            if path == "/api/search/tags":
+                return self._tags(tenant, q)
+            if path.startswith("/api/search/tag/") and path.endswith("/values"):
+                return self._tag_values(tenant, path.split("/")[-2], q)
+            if path == "/api/metrics/query_range":
+                return self._query_range(tenant, q)
+            if path == "/api/metrics/summary":
+                return self._metrics_summary(tenant, q)
+            if path == "/api/overrides":
+                cur = self.app.overrides.user_configurable.get(tenant) or {}
+                return self._reply(200, _json_bytes({"limits": cur}))
+        except Exception as e:
+            return self._err(500, str(e))
+        self._err(404, f"unknown path {path}")
+
+    def _trace_by_id(self, tenant: str, hexid: str) -> None:
+        tid = bytes.fromhex(hexid)
+        spans = self.app.frontend.find_trace(tenant, tid)
+        if spans is None:
+            return self._err(404, "trace not found")
+        out = [{**s,
+                "trace_id": s["trace_id"].hex(),
+                "span_id": s.get("span_id", b"").hex(),
+                "parent_span_id": s.get("parent_span_id", b"").hex()}
+               for s in spans]
+        self._reply(200, _json_bytes({"trace_id": hexid, "spans": out}))
+
+    def _search(self, tenant: str, q: dict) -> None:
+        res = self.app.frontend.search(
+            tenant, q.get("q", "{ }"),
+            limit=int(q.get("limit", 20)),
+            start_s=float(q["start"]) if "start" in q else None,
+            end_s=float(q["end"]) if "end" in q else None)
+        self._reply(200, _json_bytes({
+            "traces": [md.to_json() for md in res],
+            "metrics": {"inspectedTraces": len(res)}}))
+
+    def _tags(self, tenant: str, q: dict) -> None:
+        names = self.app.frontend.tag_names(tenant)
+        scope = q.get("scope", "")
+        if scope:
+            names = {scope: names.get(scope, [])}
+        self._reply(200, _json_bytes({
+            "scopes": [{"name": k, "tags": v} for k, v in names.items()]}))
+
+    def _tag_values(self, tenant: str, name: str, q: dict) -> None:
+        from tempo_tpu.block.fetch import scan_views
+        from tempo_tpu.traceql.engine import execute_tag_values, tag_values_request
+        req = tag_values_request(name)
+        views = (v for m in self.app.db.blocks(tenant)
+                 for v in scan_views(self.app.db.backend_block(m), req))
+        vals = execute_tag_values(name, views)
+        self._reply(200, _json_bytes({"tagValues": vals}))
+
+    def _query_range(self, tenant: str, q: dict) -> None:
+        series = self.app.frontend.query_range(
+            tenant, q.get("q") or q.get("query", ""),
+            start_s=float(q["start"]), end_s=float(q["end"]),
+            step_s=float(q.get("step", 60)))
+        from tempo_tpu.traceql.engine_metrics import QueryRangeRequest
+        req = QueryRangeRequest(
+            query=q.get("q") or q.get("query", ""),
+            start_ns=int(float(q["start"]) * 1e9),
+            end_ns=int(float(q["end"]) * 1e9),
+            step_ns=int(float(q.get("step", 60)) * 1e9))
+        ts_ms = req.step_timestamps_ms()
+        self._reply(200, _json_bytes({
+            "series": [s.to_json(ts_ms) for s in series]}))
+
+    def _metrics_summary(self, tenant: str, q: dict) -> None:
+        group_by = [g for g in q.get("groupBy", "").split(",") if g]
+        res = self.app.generator.get_metrics(tenant, q.get("q", "{ }"),
+                                             group_by)
+        self._reply(200, _json_bytes({
+            "summaries": [s.to_json() for s in res.results()],
+            "estimated": res.estimated}))
+
+    def _status(self, path: str) -> None:
+        cfg_warnings = self.app.cfg.check()
+        body = {
+            "target": self.app.cfg.target,
+            "ready": self.app.ready,
+            "warnings": cfg_warnings,
+            "modules": [m for m in ("distributor", "ingester", "generator",
+                                    "querier", "frontend", "db")
+                        if getattr(self.app, m) is not None],
+        }
+        self._reply(200, _json_bytes(body))
+
+    def _self_metrics(self) -> None:
+        """Prometheus text exposition of service self-metrics."""
+        lines = []
+        d = self.app.distributor
+        if d is not None:
+            for k, v in d.metrics.items():
+                lines.append(f"tempo_distributor_{k} {v}")
+            for r, v in d.discarded.items():
+                lines.append(
+                    f'tempo_discarded_spans_total{{reason="{r}"}} {v}')
+        fe = self.app.frontend
+        if fe is not None:
+            for (op, tenant), v in fe.slos.total.items():
+                lines.append(f'tempo_query_frontend_queries_total'
+                             f'{{op="{op}",tenant="{tenant}"}} {v}')
+            for (op, tenant), v in fe.slos.within.items():
+                lines.append(f'tempo_query_frontend_queries_within_slo_total'
+                             f'{{op="{op}",tenant="{tenant}"}} {v}')
+        self._reply(200, "\n".join(lines).encode() + b"\n",
+                    "text/plain; version=0.0.4")
+
+
+def serve(app, block: bool = True) -> ThreadingHTTPServer:
+    Handler.app = app
+    srv = ThreadingHTTPServer(
+        (app.cfg.server.http_listen_address, app.cfg.server.http_listen_port),
+        Handler)
+    if block:
+        try:
+            srv.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            srv.shutdown()
+        return srv
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv

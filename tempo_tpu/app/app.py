@@ -1,0 +1,207 @@
+"""App: module wiring + lifecycle for a selected target.
+
+Analog of `cmd/tempo/app/app.go:165-253` (`App.Run`) and the module DAG of
+`modules.go:679-757`. Modules are constructed lazily in dependency order;
+the single-binary target (`all`) wires every service in-process with
+direct client references where the reference uses gRPC — the process
+boundary collapses but every seam (ring, clients, queue) stays.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable
+
+from tempo_tpu.app.config import Config
+from tempo_tpu.backend.local import LocalBackend
+from tempo_tpu.backend.mem import MemBackend
+from tempo_tpu.db.tempodb import TempoDB, TempoDBConfig
+from tempo_tpu.distributor import Distributor
+from tempo_tpu.frontend import Frontend
+from tempo_tpu.generator import Generator
+from tempo_tpu.ingester import Ingester
+from tempo_tpu.overrides import Overrides, UserConfigurableOverrides
+from tempo_tpu.querier import Querier
+from tempo_tpu.ring import ACTIVE, InstanceDesc, KVStore, Lifecycler, Ring
+from tempo_tpu.ring.ring import _instance_tokens
+
+# module names (`modules.go:52-90`)
+STORE, OVERRIDES, DISTRIBUTOR, INGESTER, GENERATOR = (
+    "store", "overrides", "distributor", "ingester", "metrics-generator")
+QUERIER, FRONTEND, COMPACTOR = "querier", "query-frontend", "compactor"
+ALL = "all"
+
+TARGETS = {
+    ALL: [OVERRIDES, STORE, INGESTER, GENERATOR, DISTRIBUTOR, QUERIER,
+          FRONTEND, COMPACTOR],
+    DISTRIBUTOR: [OVERRIDES, DISTRIBUTOR],
+    INGESTER: [OVERRIDES, STORE, INGESTER],
+    GENERATOR: [OVERRIDES, GENERATOR],
+    QUERIER: [OVERRIDES, STORE, QUERIER],
+    FRONTEND: [OVERRIDES, STORE, FRONTEND],
+    COMPACTOR: [OVERRIDES, STORE, COMPACTOR],
+}
+
+
+class App:
+    def __init__(self, cfg: Config | None = None,
+                 now: Callable[[], float] = time.time) -> None:
+        self.cfg = cfg or Config()
+        if self.cfg.target not in TARGETS:
+            raise ValueError(f"unknown target {self.cfg.target!r}")
+        self.now = now
+        self.kv = KVStore()
+        self.ready = False
+        self._stop = threading.Event()
+        # modules (populated by _init_*)
+        self.backend = None
+        self.db: TempoDB | None = None
+        self.overrides: Overrides | None = None
+        self.distributor: Distributor | None = None
+        self.ingester: Ingester | None = None
+        self.generator: Generator | None = None
+        self.querier: Querier | None = None
+        self.frontend: Frontend | None = None
+        self._lifecyclers: list[Lifecycler] = []
+        self._build()
+
+    # -- wiring ------------------------------------------------------------
+
+    def _build(self) -> None:
+        mods = TARGETS[self.cfg.target]
+        self._init_backend()
+        if OVERRIDES in mods:
+            self._init_overrides()
+        if STORE in mods:
+            self._init_store()
+        if INGESTER in mods:
+            self._init_ingester()
+        if GENERATOR in mods:
+            self._init_generator()
+        if DISTRIBUTOR in mods:
+            self._init_distributor()
+        if QUERIER in mods:
+            self._init_querier()
+        if FRONTEND in mods:
+            self._init_frontend()
+
+    def _init_backend(self) -> None:
+        s = self.cfg.storage
+        if s.backend == "mem":
+            self.backend = MemBackend()
+        elif s.backend == "local":
+            os.makedirs(s.local_path, exist_ok=True)
+            self.backend = LocalBackend(s.local_path)
+        else:
+            from tempo_tpu.backend.cloud import open_backend
+            self.backend = open_backend(s.backend, **s.cloud)
+
+    def _init_overrides(self) -> None:
+        uc = UserConfigurableOverrides(self.backend, self.backend)
+        self.overrides = Overrides(
+            defaults=self.cfg.overrides_defaults,
+            runtime_config_path=self.cfg.per_tenant_override_config or None,
+            user_configurable=uc)
+
+    def _init_store(self) -> None:
+        self.db = TempoDB(self.backend, self.backend, TempoDBConfig(
+            compactor=self.cfg.compactor,
+            pool_workers=self.cfg.storage.pool_workers))
+
+    def _init_ingester(self) -> None:
+        data_dir = os.path.dirname(self.cfg.storage.wal_path) or "./tempo-data"
+        self.ingester = Ingester(
+            data_dir, flush_writer=self.backend, cfg=self.cfg.ingester,
+            overrides=self.overrides, now=self.now, instance_id="ingester-0")
+        self._join_ring("ingester", "ingester-0")
+
+    def _init_generator(self) -> None:
+        cfg = self.cfg.generator
+        cfg.localblocks_flush_writer = self.backend
+        self.generator = Generator(cfg, overrides=self.overrides,
+                                   instance_id="generator-0", now=self.now)
+        self._join_ring("generator", "generator-0")
+
+    def _init_distributor(self) -> None:
+        iring = Ring(kv=self.kv, key="ingester",
+                     replication_factor=self.cfg.distributor.rf, now=self.now)
+        gring = Ring(kv=self.kv, key="generator", replication_factor=1,
+                     now=self.now)
+        self.distributor = Distributor(
+            iring,
+            {"ingester-0": self.ingester} if self.ingester else {},
+            overrides=self.overrides,
+            generator_ring=gring if self.generator else None,
+            generator_clients={"generator-0": self.generator}
+            if self.generator else None,
+            cfg=self.cfg.distributor, now=self.now)
+        if self.cfg.target == ALL:
+            self.distributor.cfg.rf = 1   # one in-process ingester
+
+    def _init_querier(self) -> None:
+        iring = Ring(kv=self.kv, key="ingester", replication_factor=1,
+                     now=self.now)
+        self.querier = Querier(
+            self.db, iring,
+            {"ingester-0": self.ingester} if self.ingester else {},
+            overrides=self.overrides, cfg=self.cfg.querier, now=self.now)
+        if self.cfg.target == ALL:
+            self.querier.cfg.rf = 1
+
+    def _init_frontend(self) -> None:
+        self.frontend = Frontend(
+            self.db, self.querier, cfg=self.cfg.frontend,
+            overrides=self.overrides,
+            generator_query_range=(self.generator.query_range
+                                   if self.generator else None),
+            now=self.now)
+
+    def _join_ring(self, key: str, instance_id: str) -> None:
+        self._lifecyclers.append(
+            Lifecycler(self.kv, instance_id, key=key, now=self.now))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start_loops(self) -> None:
+        """Background loops for the enabled modules (`App.Run`)."""
+        if self.ingester:
+            self.ingester.start()
+        if self.generator:
+            self.generator.start()
+        if self.db:
+            self.db.enable_polling(self.cfg.storage.poll_interval_s)
+            if self.cfg.target in (ALL, COMPACTOR):
+                self.db.enable_compaction(self.cfg.compaction_interval_s)
+        def heartbeat():
+            while not self._stop.wait(15.0):
+                for lc in self._lifecyclers:
+                    lc.heartbeat()
+        threading.Thread(target=heartbeat, daemon=True).start()
+        self.ready = True
+
+    def shutdown(self) -> None:
+        self.ready = False
+        self._stop.set()
+        if self.ingester:
+            self.ingester.shutdown()
+        if self.generator:
+            self.generator.shutdown()
+        if self.frontend:
+            self.frontend.shutdown()
+        if self.db:
+            self.db.shutdown()
+        for lc in self._lifecyclers:
+            lc.leave()
+
+    # -- serving -----------------------------------------------------------
+
+    def run(self) -> None:
+        """Start loops + HTTP server; blocks until shutdown (`app.go:165`)."""
+        from tempo_tpu.app.api import serve
+        self.start_loops()
+        try:
+            serve(self)
+        finally:
+            self.shutdown()

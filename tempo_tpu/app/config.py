@@ -1,0 +1,117 @@
+"""Root configuration: one YAML document mirrored by dataclasses.
+
+Analog of `cmd/tempo/app/config.go:33-139` (the aggregate Config struct and
+its `RegisterFlagsAndApplyDefaults` / `CheckConfig` warning pass) and
+`cmd/tempo/main.go:146-225` (load + env expansion).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Any
+
+import yaml
+
+from tempo_tpu.db.compactor import CompactorConfig
+from tempo_tpu.db.poller import PollerConfig
+from tempo_tpu.distributor.distributor import DistributorConfig
+from tempo_tpu.frontend.frontend import FrontendConfig
+from tempo_tpu.generator.instance import GeneratorConfig
+from tempo_tpu.generator.processors.localblocks import LocalBlocksConfig
+from tempo_tpu.ingester.ingester import IngesterConfig
+from tempo_tpu.ingester.instance import InstanceConfig
+from tempo_tpu.overrides.limits import Limits
+from tempo_tpu.querier.querier import QuerierConfig
+
+
+@dataclasses.dataclass
+class ServerConfig:
+    http_listen_port: int = 3200
+    http_listen_address: str = "127.0.0.1"
+    graceful_shutdown_timeout_s: float = 5.0
+
+
+@dataclasses.dataclass
+class StorageConfig:
+    backend: str = "local"             # local | mem | s3 | gcs | azure
+    local_path: str = "./tempo-data/blocks"
+    wal_path: str = "./tempo-data/wal"
+    cloud: dict = dataclasses.field(default_factory=dict)
+    poll_interval_s: float = 30.0
+    pool_workers: int = 30
+
+
+@dataclasses.dataclass
+class Config:
+    target: str = "all"
+    multitenancy_enabled: bool = False
+    server: ServerConfig = dataclasses.field(default_factory=ServerConfig)
+    storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
+    distributor: DistributorConfig = dataclasses.field(default_factory=DistributorConfig)
+    ingester: IngesterConfig = dataclasses.field(default_factory=IngesterConfig)
+    generator: GeneratorConfig = dataclasses.field(default_factory=GeneratorConfig)
+    frontend: FrontendConfig = dataclasses.field(default_factory=FrontendConfig)
+    querier: QuerierConfig = dataclasses.field(default_factory=QuerierConfig)
+    compactor: CompactorConfig = dataclasses.field(default_factory=CompactorConfig)
+    overrides_defaults: Limits = dataclasses.field(default_factory=Limits)
+    per_tenant_override_config: str = ""   # runtime-config file path
+    compaction_interval_s: float = 30.0
+
+    def check(self) -> list[str]:
+        """Config sanity warnings (`config.go:145-236` CheckConfig)."""
+        warnings = []
+        if self.ingester.instance.max_block_duration_s < 60:
+            warnings.append("ingester.max_block_duration_s < 1m: tiny blocks "
+                            "inflate blocklist and query fan-out")
+        if self.frontend.target_bytes_per_job < (1 << 20):
+            warnings.append("frontend.target_bytes_per_job < 1MiB: job "
+                            "dispatch overhead will dominate")
+        if self.storage.backend not in ("local", "mem", "s3", "gcs", "azure"):
+            warnings.append(f"unknown storage backend {self.storage.backend!r}")
+        if self.compactor.retention_s and self.compactor.retention_s < 3600:
+            warnings.append("compactor.retention_s < 1h deletes data quickly")
+        return warnings
+
+
+_ENV_RE = re.compile(r"\$\{(\w+)(?::-([^}]*))?\}")
+
+
+def _expand_env(text: str) -> str:
+    """${VAR} / ${VAR:-default} expansion (`main.go` env expansion)."""
+    return _ENV_RE.sub(
+        lambda m: os.environ.get(m.group(1), m.group(2) or ""), text)
+
+
+def _apply(obj: Any, data: dict) -> None:
+    for k, v in (data or {}).items():
+        if not hasattr(obj, k):
+            raise ValueError(f"unknown config key: {k} on {type(obj).__name__}")
+        cur = getattr(obj, k)
+        if dataclasses.is_dataclass(cur) and isinstance(v, dict):
+            _apply(cur, v)
+        elif isinstance(v, list) and isinstance(cur, tuple):
+            setattr(obj, k, tuple(v))
+        else:
+            setattr(obj, k, v)
+
+
+def load_config(path: str | None = None, text: str | None = None,
+                overrides: dict | None = None) -> Config:
+    cfg = Config()
+    doc: dict = {}
+    if path:
+        with open(path) as f:
+            text = f.read()
+    if text:
+        doc = yaml.safe_load(_expand_env(text)) or {}
+    _apply(cfg, doc)
+    if overrides:
+        _apply(cfg, overrides)
+    return cfg
+
+
+# convenience for nested dataclass defaults referenced from YAML docs
+__all__ = ["Config", "ServerConfig", "StorageConfig", "load_config",
+           "InstanceConfig", "LocalBlocksConfig", "PollerConfig"]

@@ -30,6 +30,12 @@ from tempo_tpu.model.span_batch import SpanBatch
 from tempo_tpu.registry import ManagedRegistry, RegistryOverrides
 
 
+def _lb_config():
+    # deferred: processors.localblocks re-enters this package's init
+    from tempo_tpu.generator.processors.localblocks import LocalBlocksConfig
+    return LocalBlocksConfig()
+
+
 @dataclasses.dataclass
 class GeneratorConfig:
     processors: tuple[str, ...] = ("span-metrics", "service-graphs")
@@ -37,7 +43,8 @@ class GeneratorConfig:
     spanmetrics: SpanMetricsConfig = dataclasses.field(default_factory=SpanMetricsConfig)
     servicegraphs: ServiceGraphsConfig = dataclasses.field(default_factory=ServiceGraphsConfig)
     remote_write: RemoteWriteConfig = dataclasses.field(default_factory=RemoteWriteConfig)
-    localblocks: "object" = None            # LocalBlocksConfig | None
+    localblocks: "LocalBlocksConfig" = dataclasses.field(
+        default_factory=_lb_config)
     localblocks_flush_writer: "object" = None  # RawWriter for flush_to_storage
     ingestion_time_range_slack_s: float = 30.0
 

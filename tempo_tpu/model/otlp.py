@@ -127,6 +127,15 @@ def _pb_attrs(kvs: Iterable) -> dict[str, Any]:
 def otlp_proto_to_batch(data: bytes, builder: SpanBatchBuilder | None = None) -> SpanBatch:
     """Decode an OTLP protobuf ExportTraceServiceRequest into a SpanBatch."""
     b = builder or SpanBatchBuilder()
+    for span in spans_from_otlp_proto(data):
+        b.append(**span)
+    return b.build()
+
+
+def spans_from_otlp_proto(data: bytes):
+    """Decode OTLP protobuf into flat span dicts (the distributor's wire
+    entry: the regroup/validate path consumes dicts, batch staging happens
+    at the generator/ingester seams)."""
     for fnum, _, rs in pw.iter_fields(data):
         if fnum != 1:  # ResourceSpans
             continue
@@ -176,5 +185,4 @@ def otlp_proto_to_batch(data: bytes, builder: SpanBatchBuilder | None = None) ->
                                 span["status_code"] = v5
                 if kvs:
                     span["attrs"] = _pb_attrs(kvs)
-                b.append(**span)
-    return b.build()
+                yield span

@@ -1,0 +1,161 @@
+"""App runtime: config load, module wiring, HTTP API end-to-end."""
+
+from __future__ import annotations
+
+import json
+import socket
+import urllib.request
+
+import pytest
+
+from tempo_tpu.app import App, load_config
+from tempo_tpu.app.config import Config
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_config_yaml_and_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("BUCKET", "my-bucket")
+    p = tmp_path / "tempo.yaml"
+    p.write_text("""
+target: all
+server:
+  http_listen_port: 9999
+storage:
+  backend: mem
+  cloud: {bucket: "${BUCKET}", region: "${REGION:-us-east1}"}
+ingester:
+  instance: {max_block_duration_s: 120.0}
+frontend:
+  target_bytes_per_job: 52428800
+""")
+    cfg = load_config(str(p))
+    assert cfg.server.http_listen_port == 9999
+    assert cfg.storage.cloud == {"bucket": "my-bucket", "region": "us-east1"}
+    assert cfg.ingester.instance.max_block_duration_s == 120.0
+    assert cfg.frontend.target_bytes_per_job == 50 * 1024 * 1024
+    assert cfg.check() == []
+
+
+def test_config_unknown_key_rejected():
+    with pytest.raises(ValueError, match="unknown config key"):
+        load_config(text="storage: {bukkit: x}")
+
+
+def test_config_warnings():
+    cfg = load_config(text="ingester: {instance: {max_block_duration_s: 5}}")
+    assert any("max_block_duration" in w for w in cfg.check())
+
+
+def test_target_wiring(tmp_path):
+    cfg = Config()
+    cfg.storage.backend = "mem"
+    cfg.storage.wal_path = str(tmp_path / "wal")
+    cfg.target = "querier"
+    app = App(cfg)
+    assert app.querier is not None and app.db is not None
+    assert app.distributor is None and app.ingester is None
+    with pytest.raises(ValueError):
+        App(Config(target="bogus"))
+
+
+@pytest.fixture
+def server(tmp_path):
+    cfg = Config()
+    cfg.storage.backend = "mem"
+    cfg.storage.wal_path = str(tmp_path / "d" / "wal")
+    cfg.generator.localblocks.data_dir = str(tmp_path / "lb")
+    cfg.server.http_listen_port = free_port()
+    cfg.ingester.instance.trace_idle_s = 0.1
+    app = App(cfg)
+    app.overrides.set_tenant_patch("single-tenant", {
+        "generator": {"processors": ["span-metrics", "local-blocks"]}})
+    from tempo_tpu.app.api import serve
+    app.start_loops()
+    srv = serve(app, block=False)
+    base = f"http://127.0.0.1:{cfg.server.http_listen_port}"
+    yield app, base
+    srv.shutdown()
+    app.shutdown()
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, json.loads(r.read() or b"{}")
+
+
+def _post(url: str, body: bytes, ctype="application/json"):
+    req = urllib.request.Request(url, data=body,
+                                 headers={"Content-Type": ctype})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, json.loads(r.read() or b"{}")
+
+
+OTLP = {"resourceSpans": [{
+    "resource": {"attributes": [
+        {"key": "service.name", "value": {"stringValue": "shop"}}]},
+    "scopeSpans": [{"spans": [{
+        "traceId": "0102030405060708090a0b0c0d0e0f10",
+        "spanId": "0102030405060708",
+        "name": "checkout", "kind": 3,
+        "startTimeUnixNano": "{t0}",
+        "endTimeUnixNano": "{t1}",
+        "attributes": [{"key": "http.status_code",
+                        "value": {"intValue": "200"}}],
+        "status": {"code": 0}}]}]}]}
+
+
+def test_http_e2e(server):
+    import time
+    app, base = server
+    t0 = int((time.time() - 5) * 1e9)
+    body = json.dumps(OTLP).replace('"{t0}"', str(t0)) \
+                           .replace('"{t1}"', str(t0 + 50_000_000))
+    code, _ = _post(f"{base}/v1/traces", body.encode())
+    assert code == 200
+    # ready/echo/status
+    with urllib.request.urlopen(f"{base}/ready", timeout=10) as r:
+        assert r.status == 200
+    code, st = _get(f"{base}/status")
+    assert st["target"] == "all" and "distributor" in st["modules"]
+    # trace by id
+    code, tr = _get(f"{base}/api/traces/0102030405060708090a0b0c0d0e0f10")
+    assert code == 200 and len(tr["spans"]) == 1
+    assert tr["spans"][0]["name"] == "checkout"
+    # search (recent window → ingester)
+    code, res = _get(f"{base}/api/search?q=" + urllib.parse.quote(
+        '{ resource.service.name = "shop" }'))
+    assert code == 200 and len(res["traces"]) == 1
+    # tags
+    code, tags = _get(f"{base}/api/search/tags")
+    span_tags = next(s["tags"] for s in tags["scopes"] if s["name"] == "span")
+    assert "http.status_code" in span_tags
+    # metrics query range (generator local-blocks path)
+    now = time.time()
+    code, qr = _get(f"{base}/api/metrics/query_range?q=" +
+                    urllib.parse.quote("{ } | rate()") +
+                    f"&start={now - 300}&end={now}&step=300")
+    assert code == 200
+    total = sum(d["value"] for s in qr["series"]
+                for d in (s.get("samples") or []) if d["value"] == d["value"])
+    assert total > 0
+    # span-metrics summary
+    code, sm = _get(f"{base}/api/metrics/summary?q=" +
+                    urllib.parse.quote("{ }") + "&groupBy=name")
+    assert code == 200 and sm["summaries"][0]["spanCount"] == 1
+    # overrides API
+    code, _ = _post(f"{base}/api/overrides", json.dumps(
+        {"generator": {"collection_interval_s": 30.0}}).encode())
+    assert code == 200
+    code, ov = _get(f"{base}/api/overrides")
+    assert ov["limits"]["generator"]["collection_interval_s"] == 30.0
+    # prometheus self-metrics
+    with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+        text = r.read().decode()
+    assert "tempo_distributor_spans_received_total 1" in text
