@@ -1,0 +1,13 @@
+"""Blockbuilder: partition consumer that builds backend blocks directly.
+
+Analog of `modules/blockbuilder`: replaces the ingester on the
+ingest-storage path — consumes its partitions from the bus, accumulates
+per-tenant live traces, writes RF1 blocks straight to object storage, and
+commits consumed offsets only AFTER the flush succeeds so a crash replays
+rather than loses (`consumePartition` `blockbuilder.go:266`, commit-after-
+flush `blockbuilder.go:209-265`).
+"""
+
+from tempo_tpu.blockbuilder.blockbuilder import BlockBuilder, BlockBuilderConfig
+
+__all__ = ["BlockBuilder", "BlockBuilderConfig"]

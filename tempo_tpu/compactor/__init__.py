@@ -1,0 +1,12 @@
+"""Compactor service: ring-sharded ownership over tempodb compaction.
+
+Analog of `modules/compactor`: the service joins a compactor ring and only
+runs compaction jobs whose hash it owns (`Owns` `compactor.go:190`), so N
+compactors split tenants' job space with no coordination beyond the ring.
+Trace dedupe during merge (`Combine` `compactor.go:220`) lives in
+`tempo_tpu.model.combine` and the block compactor.
+"""
+
+from tempo_tpu.compactor.compactor import Compactor
+
+__all__ = ["Compactor"]

@@ -1,0 +1,54 @@
+"""The compactor service."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from tempo_tpu.db.tempodb import TempoDB
+from tempo_tpu.ring import KVStore, Lifecycler, Ring
+
+COMPACTOR_RING = "compactor"
+
+
+class Compactor:
+    def __init__(self, db: TempoDB, kv: KVStore | None = None,
+                 instance_id: str = "compactor-0",
+                 now: Callable[[], float] = time.time) -> None:
+        self.db = db
+        self.id = instance_id
+        self.now = now
+        self.kv = kv
+        self.ring: Ring | None = None
+        self.lifecycler: Lifecycler | None = None
+        if kv is not None:
+            self.ring = Ring(kv=kv, key=COMPACTOR_RING, replication_factor=1,
+                             now=now)
+            self.lifecycler = Lifecycler(kv, instance_id, key=COMPACTOR_RING,
+                                         now=now)
+
+    def owns(self, key: str) -> bool:
+        """Hash the job key onto the compactor ring (`Owns`
+        `compactor.go:190`); single-instance mode owns everything."""
+        if self.ring is None or len(self.ring) <= 1:
+            return True
+        return self.ring.owns(self.id, key)
+
+    def run_once(self) -> int:
+        """One sweep over all tenants; returns jobs executed."""
+        done = 0
+        for tenant in self.db.blocklist.tenants():
+            done += self.db.compact_tenant_once(tenant, owns=self.owns)
+            self.db.retention_once(tenant)
+        return done
+
+    def enable(self, interval_s: float = 30.0) -> None:
+        self.db.enable_compaction(interval_s, owns=self.owns)
+
+    def heartbeat(self) -> None:
+        if self.lifecycler:
+            self.lifecycler.heartbeat()
+
+    def shutdown(self) -> None:
+        if self.lifecycler:
+            self.lifecycler.leave()

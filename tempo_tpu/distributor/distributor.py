@@ -62,7 +62,9 @@ class Distributor:
                  generator_clients: dict[str, GeneratorClient] | None = None,
                  cfg: DistributorConfig | None = None,
                  n_distributors: Callable[[], int] = lambda: 1,
+                 bus: "object | None" = None,
                  now: Callable[[], float] = time.time) -> None:
+        self.bus = bus
         self.cfg = cfg or DistributorConfig()
         self.overrides = overrides or Overrides()
         self.ingester_ring = ingester_ring
@@ -107,7 +109,15 @@ class Distributor:
         errs2 = self._send_to_ingesters(tenant, groups, tokens, lim)
         for k, v in errs2.items():
             errs[k] = errs.get(k, 0) + v
-        self._send_to_generators(tenant, groups, tokens, lim)
+        if self.bus is not None:
+            # ingest-storage path: partition-keyed records onto the bus
+            # (`sendToKafka` distributor.go:612), consumed by blockbuilder
+            # and generators. REPLACES the direct generator tee — both at
+            # once would deliver every span to generators twice.
+            from tempo_tpu.ingest.encoding import produce_traces
+            produce_traces(self.bus, tenant, groups, tokens)
+        else:
+            self._send_to_generators(tenant, groups, tokens, lim)
         return errs
 
     # -- stages ------------------------------------------------------------

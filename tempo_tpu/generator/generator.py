@@ -91,6 +91,43 @@ class Generator:
         return self.instance(tenant).get_metrics(query, group_by,
                                                  max_series=max_series)
 
+    # -- bus consumption (generator_kafka.go:25-110 analog) ----------------
+
+    def consume_bus(self, bus, partitions, group: str = "metrics-generator",
+                    max_records: int = 1000) -> int:
+        """Drain owned partitions from the last committed offset into the
+        tenant instances; commit AFTER processing (replayable). Spans batch
+        per tenant across the fetched records, and tenants with metrics
+        generation disabled are skipped — the same gate the direct RPC tee
+        applies (`distributor.go:563` + overrides), since the bus carries
+        every trace for the blockbuilder's sake."""
+        from tempo_tpu.ingest.encoding import decode_push
+
+        total = 0
+        skip: set[str] = set()
+        for p in partitions:
+            start = bus.committed(group, p)
+            recs = bus.fetch(p, start, max_records)
+            if not recs:
+                continue
+            by_tenant: dict[str, list[dict]] = {}
+            for rec in recs:
+                if rec.tenant in skip:
+                    continue
+                if rec.tenant not in by_tenant:
+                    lim = self.overrides.for_tenant(rec.tenant)
+                    if not lim.generator.processors and \
+                            rec.tenant not in self.instances:
+                        skip.add(rec.tenant)
+                        continue
+                for _tid, spans in decode_push(rec.value):
+                    by_tenant.setdefault(rec.tenant, []).extend(spans)
+            for tenant, spans in by_tenant.items():
+                self.push_spans(tenant, spans)
+            bus.commit(group, p, recs[-1].offset + 1)
+            total += len(recs)
+        return total
+
     # -- loops -------------------------------------------------------------
 
     def collect_all(self) -> int:

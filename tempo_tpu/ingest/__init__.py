@@ -1,0 +1,18 @@
+"""Partitioned ingest bus: the Kafka ingest-storage path, in-process.
+
+Analog of `pkg/ingest` (franz-go layer) + `pkg/ingest/testkafka`: an
+append-only partitioned record log with consumer-group offset commits.
+The distributor produces trace records onto partitions chosen by trace
+token (`sendToKafka` `distributor.go:612`); the blockbuilder and the
+metrics-generator consume partitions and commit offsets only after their
+output is durable (exactly-once-ish replay, `blockbuilder.go:209-265`).
+
+The in-memory `Bus` is both the test double (kfake analog) and the
+single-process implementation; a networked bus would implement the same
+produce/fetch/commit surface.
+"""
+
+from tempo_tpu.ingest.bus import Bus, Record
+from tempo_tpu.ingest.encoding import decode_push, encode_push
+
+__all__ = ["Bus", "Record", "encode_push", "decode_push"]

@@ -1,0 +1,184 @@
+"""Ingest bus, blockbuilder, compactor ring ownership."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tempo_tpu.backend.mem import MemBackend
+from tempo_tpu.blockbuilder import BlockBuilder, BlockBuilderConfig
+from tempo_tpu.blockbuilder.blockbuilder import CONSUMER_GROUP, produce_traces
+from tempo_tpu.compactor import Compactor
+from tempo_tpu.db.tempodb import TempoDB
+from tempo_tpu.ingest import Bus, decode_push, encode_push
+from tempo_tpu.ops.hashing import token_for
+from tempo_tpu.ring import KVStore
+
+T0 = 1_700_000_000.0
+
+
+def mktrace(i: int, n_spans: int = 2):
+    tid = bytes([i, i]) * 8
+    t0 = int((T0 + i) * 1e9)
+    return tid, [{"trace_id": tid, "span_id": bytes([j + 1]) * 8,
+                  "name": f"op-{j}", "service": "svc",
+                  "start_unix_nano": t0, "end_unix_nano": t0 + 10 ** 6,
+                  "attrs": {"k": j}} for j in range(n_spans)]
+
+
+def test_encode_decode_round_trip():
+    traces = [mktrace(i) for i in range(1, 6)]
+    recs = encode_push(traces)
+    back = [t for r in recs for t in decode_push(r)]
+    assert len(back) == 5
+    assert back[0][0] == traces[0][0]
+    assert back[0][1][0]["name"] == "op-0"
+    assert back[0][1][0]["attrs"] == {"k": 0}
+
+
+def test_encode_splits_large_pushes():
+    big = [mktrace(i, n_spans=40) for i in range(1, 30)]
+    recs = encode_push(big, max_record_bytes=8192)
+    assert len(recs) > 1
+    assert all(len(r) <= 8192 * 2 for r in recs)
+    back = [t for r in recs for t in decode_push(r)]
+    assert len(back) == 29
+
+
+def test_bus_offsets_and_lag():
+    bus = Bus(n_partitions=2)
+    for i in range(5):
+        bus.produce(0, "t", b"x%d" % i)
+    assert bus.high_watermark(0) == 5
+    assert bus.lag("g", 0) == 5
+    recs = bus.fetch(0, 0, 3)
+    assert [r.offset for r in recs] == [0, 1, 2]
+    bus.commit("g", 0, 3)
+    assert bus.lag("g", 0) == 2
+    assert bus.committed("g", 0) == 3
+
+
+def test_blockbuilder_commit_after_flush():
+    bus = Bus(n_partitions=2)
+    be = MemBackend()
+    traces = [mktrace(i) for i in range(1, 21)]
+    mat = np.stack([np.frombuffer(t[0], np.uint8) for t in traces])
+    tokens = token_for("acme", mat)
+    produce_traces(bus, "acme", traces, tokens)
+    total = bus.high_watermark(0) + bus.high_watermark(1)
+    assert total >= 2  # spread over both partitions
+
+    bb = BlockBuilder(bus, be, BlockBuilderConfig(partitions=(0, 1)))
+    n = bb.consume_cycle()
+    assert n == total
+    assert bus.lag(CONSUMER_GROUP, 0) == 0
+    assert bus.lag(CONSUMER_GROUP, 1) == 0
+    db = TempoDB(be, be)
+    db.poll_now()
+    metas = db.blocklist.metas("acme")
+    assert sum(m.total_objects for m in metas) == 20
+    assert all(m.replication_factor == 1 for m in metas)
+    # crash-replay: un-commit partition 0 and reconsume — blocks duplicate
+    # (at-least-once), compaction dedupes
+    bus.commit(CONSUMER_GROUP, 0, 0)
+    bb.consume_cycle()
+    db.poll_now()
+    db.compact_tenant_once("acme")
+    metas = db.blocklist.metas("acme")
+    assert sum(m.total_objects for m in metas) == 20  # deduped again
+
+
+def test_generator_consumes_bus():
+    from tempo_tpu.generator import Generator, GeneratorConfig
+    from tempo_tpu.overrides import Overrides
+
+    bus = Bus(n_partitions=1)
+    traces = [mktrace(i, 1) for i in range(1, 11)]
+    mat = np.stack([np.frombuffer(t[0], np.uint8) for t in traces])
+    produce_traces(bus, "acme", traces, token_for("acme", mat))
+    ov = Overrides()
+    ov.set_tenant_patch("acme", {"generator": {"processors": ["span-metrics"]}})
+    g = Generator(GeneratorConfig(ingestion_time_range_slack_s=0),
+                  overrides=ov, now=lambda: T0 + 30)
+    n = g.consume_bus(bus, [0])
+    assert n >= 1
+    assert g.instance("acme").spans_received == 10
+    assert bus.lag("metrics-generator", 0) == 0
+    # nothing new: no-op
+    assert g.consume_bus(bus, [0]) == 0
+
+
+def test_generator_bus_skips_disabled_tenants():
+    """Bus carries every trace (blockbuilder needs them) but generators
+    must not spawn instances for tenants with generation disabled."""
+    from tempo_tpu.generator import Generator, GeneratorConfig
+    from tempo_tpu.overrides import Limits, Overrides
+    import dataclasses as dc
+
+    bus = Bus(n_partitions=1)
+    traces = [mktrace(i, 1) for i in range(1, 4)]
+    mat = np.stack([np.frombuffer(t[0], np.uint8) for t in traces])
+    produce_traces(bus, "quiet-tenant", traces, token_for("q", mat))
+    defaults = Limits()
+    defaults.generator = dc.replace(defaults.generator, processors=())
+    g = Generator(GeneratorConfig(), overrides=Overrides(defaults=defaults),
+                  now=lambda: T0 + 30)
+    g.consume_bus(bus, [0])
+    assert "quiet-tenant" not in g.instances
+    assert bus.lag("metrics-generator", 0) == 0  # still committed past
+
+
+def test_distributor_bus_replaces_generator_tee():
+    """With the bus configured, the direct generator tee is off."""
+    from tempo_tpu.distributor import Distributor
+    from tempo_tpu.overrides import Overrides
+    from tempo_tpu.ring import ACTIVE, InstanceDesc, Ring
+    from tempo_tpu.ring.ring import _instance_tokens
+
+    class CapturingGen:
+        def __init__(self):
+            self.spans = []
+        def push_spans(self, tenant, spans):
+            self.spans.extend(spans)
+
+    class NullIng:
+        def push(self, tenant, traces):
+            return [None] * len(traces)
+
+    now = lambda: 0.0
+    iring = Ring(replication_factor=1, now=now)
+    iring.register(InstanceDesc(id="i0", state=ACTIVE,
+                                tokens=_instance_tokens("i0", 16),
+                                heartbeat_ts=0))
+    gring = Ring(replication_factor=1, now=now)
+    gring.register(InstanceDesc(id="g0", state=ACTIVE,
+                                tokens=_instance_tokens("g0", 16),
+                                heartbeat_ts=0))
+    gen = CapturingGen()
+    ov = Overrides()
+    ov.set_tenant_patch("t", {"generator": {"processors": ["span-metrics"]}})
+    bus = Bus(1)
+    d = Distributor(iring, {"i0": NullIng()}, overrides=ov,
+                    generator_ring=gring, generator_clients={"g0": gen},
+                    bus=bus, now=now)
+    tid, spans = mktrace(1)
+    d.push_spans("t", spans)
+    assert gen.spans == []                       # tee suppressed
+    assert bus.high_watermark(0) == 1            # bus got the record
+
+
+def test_compactor_ring_splits_ownership():
+    kv = KVStore()
+    be = MemBackend()
+    db = TempoDB(be, be)
+    c1 = Compactor(db, kv, "compactor-1", now=lambda: 0)
+    c2 = Compactor(db, kv, "compactor-2", now=lambda: 0)
+    keys = [f"tenant-{i}/job" for i in range(40)]
+    owned1 = {k for k in keys if c1.owns(k)}
+    owned2 = {k for k in keys if c2.owns(k)}
+    assert owned1 | owned2 == set(keys)
+    assert not (owned1 & owned2)
+    assert owned1 and owned2
+    # single instance owns everything
+    solo = Compactor(db, None, "solo")
+    assert all(solo.owns(k) for k in keys)
