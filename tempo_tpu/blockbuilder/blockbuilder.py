@@ -54,17 +54,18 @@ class BlockBuilder:
             store = stores.setdefault(rec.tenant, LiveTraceStore(now=self.now))
             for tid, spans in decode_push(rec.value):
                 store.push(tid, spans)
-        # one RF1 block per tenant per cycle, flushed BEFORE commit
+        # RF1 block(s) per tenant per cycle, flushed BEFORE commit; large
+        # cycles split at max_block_objects traces per block
         for tenant, store in stores.items():
             traces = [(lt.trace_id, sort_spans(combine_spans(lt.spans)))
                       for lt in store.cut(immediate=True)]
             traces.sort(key=lambda t: t[0])
-            if not traces:
-                continue
-            write_block(self.writer, tenant, traces,
-                        dedicated_columns=self.cfg.dedicated_columns,
-                        replication_factor=1)
-            self.blocks_flushed += 1
+            cap = max(self.cfg.max_block_objects, 1)
+            for lo in range(0, len(traces), cap):
+                write_block(self.writer, tenant, traces[lo: lo + cap],
+                            dedicated_columns=self.cfg.dedicated_columns,
+                            replication_factor=1)
+                self.blocks_flushed += 1
         next_offset = recs[-1].offset + 1
         self.bus.commit(CONSUMER_GROUP, partition, next_offset)
         n = len(recs)

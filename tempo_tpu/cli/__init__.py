@@ -1,0 +1,1 @@
+"""Operator CLI (`cmd/tempo-cli` analog): `python -m tempo_tpu.cli`."""

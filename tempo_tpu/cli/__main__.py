@@ -1,0 +1,276 @@
+"""tempo-cli analog: block inspection, direct block queries, maintenance.
+
+Commands (subset of the reference's 27, the operationally load-bearing ones):
+
+  list blocks <tenant>            blocklist table (`cmd-list-blocks.go`)
+  list block <tenant> <block>     one block's meta + row groups
+  list compaction-summary <tenant> per-level rollup (`cmd-list-compactionsummary.go`)
+  analyse block <tenant> <block>  attr cardinality/bytes → dedicated-column
+                                  candidates (`cmd-analyse-block.go`)
+  query trace <tenant> <hex-id>   direct backend trace lookup (`cmd-query-blocks.go`)
+  query search <tenant> <traceql> direct backend TraceQL search
+  query api ...                   against a live server via the HTTP client
+  gen bloom|index <tenant> <block>  regenerate derived files (`cmd-gen-*.go`)
+  rewrite drop <tenant> <block> <hex-id>  rebuild a block without a trace
+                                  (`cmd-rewrite-blocks.go` drop-trace)
+  migrate tenant <src-tenant> <dst-tenant>  copy blocks (`cmd-migrate-tenant.go`)
+
+Backend selection: --backend local --path DIR (or mem for tests).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _open_backend(args):
+    if args.backend == "local":
+        from tempo_tpu.backend.local import LocalBackend
+        be = LocalBackend(args.path)
+        return be, be
+    raise SystemExit(f"unsupported backend {args.backend!r} (use --backend local)")
+
+
+def _db(args):
+    from tempo_tpu.db.tempodb import TempoDB
+    r, w = _open_backend(args)
+    db = TempoDB(r, w)
+    db.poll_now()
+    return db
+
+
+def cmd_list_blocks(args) -> int:
+    db = _db(args)
+    metas = db.blocklist.metas(args.tenant)
+    print(f"{'ID':38} {'LVL':>3} {'OBJECTS':>9} {'SPANS':>9} {'SIZE':>10} "
+          f"{'RF':>2} {'START':>12} {'END':>12}")
+    for m in sorted(metas, key=lambda m: m.start_time):
+        print(f"{m.block_id:38} {m.compaction_level:>3} {m.total_objects:>9} "
+              f"{m.total_spans:>9} {m.size_bytes:>10} {m.replication_factor:>2} "
+              f"{m.start_time:>12.0f} {m.end_time:>12.0f}")
+    print(f"total: {len(metas)} blocks, "
+          f"{sum(m.total_objects for m in metas)} traces, "
+          f"{sum(m.size_bytes for m in metas)} bytes")
+    return 0
+
+
+def cmd_list_block(args) -> int:
+    db = _db(args)
+    from tempo_tpu.backend.meta import read_block_meta
+    m = read_block_meta(db.r, args.block, args.tenant)
+    print(json.dumps(m.to_json(), indent=2))
+    b = db.backend_block(m)
+    for i, rg in enumerate(b.row_group_index()):
+        print(f"row group {i}: rows={rg['rows']} offset={rg['row_offset']} "
+              f"ids=[{rg['min_trace_id'][:8]}..{rg['max_trace_id'][:8]}]")
+    return 0
+
+
+def cmd_compaction_summary(args) -> int:
+    db = _db(args)
+    levels: dict[int, list] = {}
+    for m in db.blocklist.metas(args.tenant):
+        levels.setdefault(m.compaction_level, []).append(m)
+    print(f"{'LVL':>3} {'BLOCKS':>7} {'OBJECTS':>10} {'SIZE':>12}")
+    for lvl in sorted(levels):
+        ms = levels[lvl]
+        print(f"{lvl:>3} {len(ms):>7} {sum(m.total_objects for m in ms):>10} "
+              f"{sum(m.size_bytes for m in ms):>12}")
+    return 0
+
+
+def cmd_analyse_block(args) -> int:
+    """Attribute stats → dedicated-column candidates (`cmd-analyse-block.go`)."""
+    db = _db(args)
+    from tempo_tpu.backend.meta import read_block_meta
+    m = read_block_meta(db.r, args.block, args.tenant)
+    b = db.backend_block(m)
+    pf = b.parquet_file()
+    stats: dict[tuple, int] = {}
+    for rg in range(pf.num_row_groups):
+        tbl = pf.read_row_group(rg, columns=[
+            c for c in pf.schema_arrow.names if "attr" in c])
+        for col in tbl.schema.names:
+            scope = "span" if col.startswith("s") else "resource"
+            if not col.endswith("_keys"):
+                continue
+            vals_col = col.replace("_keys", "_vals")
+            if vals_col not in tbl.schema.names:
+                continue
+            keys = tbl.column(col).combine_chunks()
+            vals = tbl.column(vals_col).combine_chunks()
+            kf = keys.values.to_pylist()
+            vf = vals.values.to_pylist()
+            for k, v in zip(kf, vf):
+                stats[(scope, k)] = stats.get((scope, k), 0) + len(str(v))
+    top = sorted(stats.items(), key=lambda kv: -kv[1])[: args.top]
+    print(f"{'SCOPE':>9} {'ATTRIBUTE':40} {'BYTES':>12}")
+    for (scope, k), sz in top:
+        print(f"{scope:>9} {k:40} {sz:>12}")
+    print("\ndedicated-column candidates (YAML):")
+    for (scope, k), _ in top[:10]:
+        print(f"  - {{scope: {scope}, name: {k}, type: string}}")
+    return 0
+
+
+def cmd_query_trace(args) -> int:
+    db = _db(args)
+    spans = db.find_trace_by_id(args.tenant, bytes.fromhex(args.trace_id))
+    if not spans:
+        print("trace not found", file=sys.stderr)
+        return 1
+    for s in spans:
+        print(json.dumps({**s, "trace_id": s["trace_id"].hex(),
+                          "span_id": s.get("span_id", b"").hex(),
+                          "parent_span_id": s.get("parent_span_id", b"").hex()}))
+    return 0
+
+
+def cmd_query_search(args) -> int:
+    db = _db(args)
+    res = db.search(args.tenant, args.query, limit=args.limit)
+    for md in res:
+        print(json.dumps(md.to_json()))
+    return 0
+
+
+def cmd_query_api(args) -> int:
+    from tempo_tpu.client import Client
+    c = Client(args.url, tenant=args.tenant)
+    if args.what == "trace":
+        print(json.dumps(c.trace_by_id(args.arg), indent=2))
+    elif args.what == "search":
+        print(json.dumps(c.search(args.arg, limit=args.limit), indent=2))
+    elif args.what == "tags":
+        print(json.dumps(c.search_tags(), indent=2))
+    return 0
+
+
+def cmd_gen(args) -> int:
+    """Regenerate bloom/index for a block from its data file."""
+    db = _db(args)
+    from tempo_tpu.backend.meta import read_block_meta
+    from tempo_tpu.backend.raw import block_keypath
+    from tempo_tpu.block.bloom import ShardedBloom, shard_name
+    m = read_block_meta(db.r, args.block, args.tenant)
+    b = db.backend_block(m)
+    pf = b.parquet_file()
+    kp = block_keypath(args.block, args.tenant)
+    tids = []
+    rgs = []
+    row = 0
+    for rg in range(pf.num_row_groups):
+        tbl = pf.read_row_group(rg, columns=["trace_id"])
+        col = tbl.column("trace_id").to_pylist()
+        tids.extend(col)
+        rgs.append({"row_offset": row, "rows": len(col),
+                    "min_trace_id": bytes(col[0]).hex() if col else "",
+                    "max_trace_id": bytes(col[-1]).hex() if col else ""})
+        row += len(col)
+    uniq = sorted({bytes(t) for t in tids})
+    if args.what == "bloom":
+        bloom = ShardedBloom(m.bloom_shard_count, max(len(uniq), 1), 0.01)
+        for t in uniq:
+            bloom.add(t.ljust(16, b"\0")[:16])
+        for i in range(bloom.shard_count):
+            db.w.write(shard_name(i), kp, bloom.shard_bytes(i))
+        print(f"bloom regenerated: {len(uniq)} ids, {m.bloom_shard_count} shard(s)")
+    else:
+        db.w.write("index.json", kp, json.dumps({"row_groups": rgs}).encode())
+        print(f"index regenerated: {len(rgs)} row groups")
+    return 0
+
+
+def cmd_rewrite_drop(args) -> int:
+    """Rebuild a block excluding a trace id (`tempo-cli rewrite-blocks`)."""
+    db = _db(args)
+    from tempo_tpu.backend.meta import mark_block_compacted, read_block_meta
+    from tempo_tpu.block.writer import write_block
+    from tempo_tpu.db.compactor import iter_trace_groups
+    drop = bytes.fromhex(args.trace_id)
+    m = read_block_meta(db.r, args.block, args.tenant)
+    b = db.backend_block(m)
+    kept = [(tid, spans) for tid, spans in iter_trace_groups(b)
+            if tid.rstrip(b"\0") != drop.rstrip(b"\0")]
+    new = write_block(db.w, args.tenant, kept,
+                      dedicated_columns=m.dedicated_columns,
+                      replication_factor=m.replication_factor,
+                      compaction_level=m.compaction_level)
+    mark_block_compacted(db.r, db.w, m.block_id, args.tenant)
+    print(f"rewrote {m.block_id} -> {new.block_id}: "
+          f"{m.total_objects} -> {new.total_objects} traces")
+    return 0
+
+
+def cmd_migrate_tenant(args) -> int:
+    db = _db(args)
+    from tempo_tpu.backend.raw import block_keypath, blocks as list_blocks
+    n = 0
+    for bid in list_blocks(db.r, args.src):
+        src_kp = block_keypath(bid, args.src)
+        dst_kp = block_keypath(bid, args.dst)
+        for name in db.r.find(src_kp):
+            data = db.r.read(name, src_kp)
+            if name == "meta.json":
+                d = json.loads(data)
+                d["tenant_id"] = args.dst
+                data = json.dumps(d).encode()
+            db.w.write(name, dst_kp, data)
+        n += 1
+    print(f"migrated {n} blocks {args.src} -> {args.dst}")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser("tempo_tpu.cli")
+    ap.add_argument("--backend", default="local")
+    ap.add_argument("--path", default="./tempo-data/blocks")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("list")
+    ls = p.add_subparsers(dest="what", required=True)
+    q = ls.add_parser("blocks"); q.add_argument("tenant"); q.set_defaults(fn=cmd_list_blocks)
+    q = ls.add_parser("block"); q.add_argument("tenant"); q.add_argument("block"); q.set_defaults(fn=cmd_list_block)
+    q = ls.add_parser("compaction-summary"); q.add_argument("tenant"); q.set_defaults(fn=cmd_compaction_summary)
+
+    p = sub.add_parser("analyse")
+    an = p.add_subparsers(dest="what", required=True)
+    q = an.add_parser("block"); q.add_argument("tenant"); q.add_argument("block")
+    q.add_argument("--top", type=int, default=20); q.set_defaults(fn=cmd_analyse_block)
+
+    p = sub.add_parser("query")
+    qs = p.add_subparsers(dest="what", required=True)
+    q = qs.add_parser("trace"); q.add_argument("tenant"); q.add_argument("trace_id"); q.set_defaults(fn=cmd_query_trace)
+    q = qs.add_parser("search"); q.add_argument("tenant"); q.add_argument("query")
+    q.add_argument("--limit", type=int, default=20); q.set_defaults(fn=cmd_query_search)
+    for what in ("trace", "search", "tags"):
+        q = qs.add_parser(f"api-{what}")
+        q.add_argument("url"); q.add_argument("tenant")
+        q.add_argument("arg", nargs="?" if what == "tags" else None, default="")
+        q.add_argument("--limit", type=int, default=20)
+        q.set_defaults(fn=cmd_query_api, what=what)
+
+    p = sub.add_parser("gen")
+    g = p.add_subparsers(dest="what", required=True)
+    for what in ("bloom", "index"):
+        q = g.add_parser(what); q.add_argument("tenant"); q.add_argument("block")
+        q.set_defaults(fn=cmd_gen, what=what)
+
+    p = sub.add_parser("rewrite")
+    rw = p.add_subparsers(dest="what", required=True)
+    q = rw.add_parser("drop"); q.add_argument("tenant"); q.add_argument("block")
+    q.add_argument("trace_id"); q.set_defaults(fn=cmd_rewrite_drop)
+
+    p = sub.add_parser("migrate")
+    mg = p.add_subparsers(dest="what", required=True)
+    q = mg.add_parser("tenant"); q.add_argument("src"); q.add_argument("dst")
+    q.set_defaults(fn=cmd_migrate_tenant)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

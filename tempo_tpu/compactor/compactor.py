@@ -35,11 +35,19 @@ class Compactor:
         return self.ring.owns(self.id, key)
 
     def run_once(self) -> int:
-        """One sweep over all tenants; returns jobs executed."""
+        """One sweep over all tenants; returns jobs executed. Retention is
+        ring-gated per tenant too — N compactors must not race the same
+        delete/mark writes — and the sweep keeps our heartbeat fresh so a
+        caller-driven loop can't age itself out of the ring."""
+        self.heartbeat()
         done = 0
         for tenant in self.db.blocklist.tenants():
-            done += self.db.compact_tenant_once(tenant, owns=self.owns)
-            self.db.retention_once(tenant)
+            try:
+                done += self.db.compact_tenant_once(tenant, owns=self.owns)
+                if self.owns(f"retention/{tenant}"):
+                    self.db.retention_once(tenant)
+            except Exception:
+                continue  # a failed tenant must not stall the sweep
         return done
 
     def enable(self, interval_s: float = 30.0) -> None:

@@ -106,18 +106,21 @@ class Distributor:
 
         groups, tid_matrix = _group_by_trace(spans)
         tokens = token_for(tenant, tid_matrix)
+        if self.bus is not None:
+            # ingest-storage path: partition-keyed records onto the bus
+            # (`sendToKafka` distributor.go:612). REPLACES both the
+            # ingester replication (the blockbuilder is the persister on
+            # this path) and the direct generator tee (generators consume
+            # the bus) — running either in parallel would persist or count
+            # every span twice.
+            from tempo_tpu.ingest.encoding import produce_traces
+            produce_traces(self.bus, tenant, groups, tokens)
+            self.metrics["traces_pushed_total"] += len(groups)
+            return errs
         errs2 = self._send_to_ingesters(tenant, groups, tokens, lim)
         for k, v in errs2.items():
             errs[k] = errs.get(k, 0) + v
-        if self.bus is not None:
-            # ingest-storage path: partition-keyed records onto the bus
-            # (`sendToKafka` distributor.go:612), consumed by blockbuilder
-            # and generators. REPLACES the direct generator tee — both at
-            # once would deliver every span to generators twice.
-            from tempo_tpu.ingest.encoding import produce_traces
-            produce_traces(self.bus, tenant, groups, tokens)
-        else:
-            self._send_to_generators(tenant, groups, tokens, lim)
+        self._send_to_generators(tenant, groups, tokens, lim)
         return errs
 
     # -- stages ------------------------------------------------------------

@@ -89,7 +89,14 @@ def partition_for(tokens, n_partitions: int):
     """Token → partition (the partition ring's stable assignment,
     `distributor.go:612-679` ActivePartitionBatchRing). Tokens are remixed
     first: raw fnv tokens have parity artifacts (all-equal-byte trace ids
-    always hash odd), so `token % n` would starve even partitions."""
-    from tempo_tpu.ops.hashing import splitmix32
+    always hash odd), so `token % n` would starve even partitions. Pure
+    numpy — the producer hot path never dispatches to a device."""
+    import numpy as np
 
-    return splitmix32(tokens) % n_partitions
+    with np.errstate(over="ignore"):
+        h = np.asarray(tokens, np.uint32)
+        h = h + np.uint32(0x9E3779B9)
+        h = (h ^ (h >> np.uint32(16))) * np.uint32(0x21F0AAAD)
+        h = (h ^ (h >> np.uint32(15))) * np.uint32(0x735A2D97)
+        h = h ^ (h >> np.uint32(15))
+    return h % n_partitions

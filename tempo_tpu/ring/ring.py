@@ -185,10 +185,16 @@ class Ring:
 
     def owns(self, member_id: str, key: str | int) -> bool:
         """Ring-job ownership: does member_id own hash(key)?  The compactor
-        pattern (`modules/compactor/compactor.go:190`): single owner = RF 1."""
+        pattern (`modules/compactor/compactor.go:190`): single owner = RF 1.
+
+        Ownership walks past UNHEALTHY instances: a crashed peer's job
+        share fails over to the next live instance instead of black-holing
+        until the stale descriptor is removed."""
         token = key if isinstance(key, int) else _hash_str(str(key))
-        rs = self._walk(token, 1)
-        return bool(rs) and rs[0].id == member_id and self.healthy(rs[0])
+        for inst in self._walk(token, len(self._instances) or 1):
+            if self.healthy(inst):
+                return inst.id == member_id
+        return False
 
     # -- shuffle sharding --------------------------------------------------
 

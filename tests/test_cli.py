@@ -1,0 +1,117 @@
+"""CLI toolkit, HTTP client, and vulture prober."""
+
+from __future__ import annotations
+
+import socket
+
+import pytest
+
+from tempo_tpu.app import App
+from tempo_tpu.app.config import Config
+from tempo_tpu.backend.local import LocalBackend
+from tempo_tpu.cli.__main__ import main as cli_main
+from tempo_tpu.db.tempodb import TempoDB
+
+T0 = 1_700_000_000.0
+
+
+@pytest.fixture
+def block_dir(tmp_path):
+    be = LocalBackend(str(tmp_path))
+    db = TempoDB(be, be)
+    traces = []
+    for i in range(1, 11):
+        tid = bytes([i]) * 16
+        t0 = int((T0 + i) * 1e9)
+        traces.append((tid, [{
+            "trace_id": tid, "span_id": bytes([i]) * 8, "name": f"op-{i % 2}",
+            "service": "svc", "start_unix_nano": t0,
+            "end_unix_nano": t0 + 10 ** 6,
+            "attrs": {"http.path": f"/page/{i}"}}]))
+    meta = db.write_block("t1", traces)
+    return str(tmp_path), meta
+
+
+def test_cli_list_blocks(block_dir, capsys):
+    path, meta = block_dir
+    assert cli_main(["--path", path, "list", "blocks", "t1"]) == 0
+    out = capsys.readouterr().out
+    assert meta.block_id in out and "total: 1 blocks, 10 traces" in out
+    assert cli_main(["--path", path, "list", "block", "t1", meta.block_id]) == 0
+    out = capsys.readouterr().out
+    assert '"total_objects": 10' in out and "row group 0" in out
+    assert cli_main(["--path", path, "list", "compaction-summary", "t1"]) == 0
+
+
+def test_cli_query(block_dir, capsys):
+    path, meta = block_dir
+    tid = (bytes([3]) * 16).hex()
+    assert cli_main(["--path", path, "query", "trace", "t1", tid]) == 0
+    assert '"op-1"' in capsys.readouterr().out
+    assert cli_main(["--path", path, "query", "search", "t1",
+                     '{ .http.path = "/page/4" }']) == 0
+    out = capsys.readouterr().out
+    assert (bytes([4]) * 16).hex() in out
+    # missing trace returns nonzero
+    assert cli_main(["--path", path, "query", "trace", "t1", "ff" * 16]) == 1
+
+
+def test_cli_analyse(block_dir, capsys):
+    path, meta = block_dir
+    assert cli_main(["--path", path, "analyse", "block", "t1",
+                     meta.block_id]) == 0
+    out = capsys.readouterr().out
+    assert "http.path" in out and "dedicated-column candidates" in out
+
+
+def test_cli_gen_and_rewrite(block_dir, capsys):
+    path, meta = block_dir
+    assert cli_main(["--path", path, "gen", "bloom", "t1", meta.block_id]) == 0
+    assert cli_main(["--path", path, "gen", "index", "t1", meta.block_id]) == 0
+    capsys.readouterr()
+    # drop trace 5 and verify the rewritten block lost exactly it
+    tid = (bytes([5]) * 16).hex()
+    assert cli_main(["--path", path, "rewrite", "drop", "t1",
+                     meta.block_id, tid]) == 0
+    assert "10 -> 9 traces" in capsys.readouterr().out
+    be = LocalBackend(path)
+    db = TempoDB(be, be)
+    db.poll_now()
+    live = [m for m in db.blocklist.metas("t1")]
+    assert len(live) == 1 and live[0].total_objects == 9
+    assert db.find_trace_by_id("t1", bytes([5]) * 16) is None
+    assert db.find_trace_by_id("t1", bytes([6]) * 16) is not None
+
+
+def test_cli_migrate_tenant(block_dir, capsys):
+    path, meta = block_dir
+    assert cli_main(["--path", path, "migrate", "tenant", "t1", "t2"]) == 0
+    be = LocalBackend(path)
+    db = TempoDB(be, be)
+    db.poll_now()
+    assert len(db.blocklist.metas("t2")) == 1
+    assert db.find_trace_by_id("t2", bytes([1]) * 16) is not None
+
+
+def test_vulture_against_live_server(tmp_path):
+    from tempo_tpu.app.api import serve
+    from tempo_tpu.vulture.__main__ import main as vulture_main
+
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]; s.close()
+    cfg = Config()
+    cfg.storage.backend = "mem"
+    cfg.storage.wal_path = str(tmp_path / "wal")
+    cfg.generator.localblocks.data_dir = str(tmp_path / "lb")
+    cfg.server.http_listen_port = port
+    app = App(cfg)
+    app.start_loops()
+    srv = serve(app, block=False)
+    try:
+        rc = vulture_main(["--url", f"http://127.0.0.1:{port}",
+                           "--cycles", "2", "--interval", "0",
+                           "--read-delay", "0", "--seed", "42"])
+        assert rc == 0
+    finally:
+        srv.shutdown()
+        app.shutdown()

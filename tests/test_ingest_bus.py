@@ -128,8 +128,28 @@ def test_generator_bus_skips_disabled_tenants():
     assert bus.lag("metrics-generator", 0) == 0  # still committed past
 
 
+def test_compactor_ownership_fails_over_from_dead_instance():
+    """A crashed compactor's job share moves to live instances instead of
+    black-holing behind its stale ring descriptor."""
+    clock = [1000.0]
+    kv = KVStore()
+    be = MemBackend()
+    db = TempoDB(be, be)
+    c1 = Compactor(db, kv, "compactor-1", now=lambda: clock[0])
+    c2 = Compactor(db, kv, "compactor-2", now=lambda: clock[0])
+    keys = [f"tenant-{i}/job" for i in range(40)]
+    owned2 = {k for k in keys if c2.owns(k)}
+    assert owned2
+    # c2 crashes (no leave): its heartbeat goes stale
+    clock[0] += 30.0
+    c1.heartbeat()
+    clock[0] += 50.0  # c2's heartbeat now 80s old > 60s timeout
+    for k in keys:
+        assert c1.owns(k)  # everything failed over to the live instance
+
+
 def test_distributor_bus_replaces_generator_tee():
-    """With the bus configured, the direct generator tee is off."""
+    """With the bus configured, direct ingester+generator sends are off."""
     from tempo_tpu.distributor import Distributor
     from tempo_tpu.overrides import Overrides
     from tempo_tpu.ring import ACTIVE, InstanceDesc, Ring
@@ -142,7 +162,10 @@ def test_distributor_bus_replaces_generator_tee():
             self.spans.extend(spans)
 
     class NullIng:
+        def __init__(self):
+            self.pushes = 0
         def push(self, tenant, traces):
+            self.pushes += 1
             return [None] * len(traces)
 
     now = lambda: 0.0
@@ -155,15 +178,17 @@ def test_distributor_bus_replaces_generator_tee():
                                 tokens=_instance_tokens("g0", 16),
                                 heartbeat_ts=0))
     gen = CapturingGen()
+    ing = NullIng()
     ov = Overrides()
     ov.set_tenant_patch("t", {"generator": {"processors": ["span-metrics"]}})
     bus = Bus(1)
-    d = Distributor(iring, {"i0": NullIng()}, overrides=ov,
+    d = Distributor(iring, {"i0": ing}, overrides=ov,
                     generator_ring=gring, generator_clients={"g0": gen},
                     bus=bus, now=now)
     tid, spans = mktrace(1)
     d.push_spans("t", spans)
-    assert gen.spans == []                       # tee suppressed
+    assert gen.spans == []                       # generator tee suppressed
+    assert ing.pushes == 0                       # ingester path suppressed
     assert bus.high_watermark(0) == 1            # bus got the record
 
 
