@@ -85,7 +85,10 @@ class Handler(BaseHTTPRequestHandler):
         if "json" in ctype:
             spans = list(spans_from_otlp_json(json.loads(body)))
         else:
-            spans = list(spans_from_otlp_proto(body))
+            from tempo_tpu import native
+            spans = native.spans_from_otlp_proto_native(body)
+            if spans is None:  # native layer unavailable
+                spans = list(spans_from_otlp_proto(body))
         from tempo_tpu.distributor.distributor import RateLimited
         try:
             errs = self.app.distributor.push_spans(tenant, spans)

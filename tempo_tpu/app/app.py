@@ -65,6 +65,10 @@ class App:
         self.querier: Querier | None = None
         self.frontend: Frontend | None = None
         self._lifecyclers: list[Lifecycler] = []
+        # warm the native layer at startup so the first proto push never
+        # pays the g++ compile inside a request handler
+        from tempo_tpu import native
+        native.available()
         self._build()
 
     # -- wiring ------------------------------------------------------------

@@ -15,7 +15,7 @@ from typing import Callable, Protocol, Sequence
 import numpy as np
 
 from tempo_tpu.distributor.limiter import RateLimiter, effective_rate
-from tempo_tpu.ops.hashing import token_for
+from tempo_tpu.native import token_for  # native fnv batch; numpy fallback
 from tempo_tpu.overrides import Overrides
 from tempo_tpu.ring import InstanceDesc, Ring, do_batch
 from tempo_tpu.utils.livetraces import _approx_size

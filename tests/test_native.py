@@ -1,0 +1,118 @@
+"""Native C++ layer: token hashing and OTLP wire scan vs python refs."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tempo_tpu import native
+from tempo_tpu.model.otlp import spans_from_otlp_proto
+from tempo_tpu.ops import hashing
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native build unavailable")
+
+
+def test_fnv_tokens_match_numpy():
+    rng = np.random.default_rng(0)
+    tids = rng.integers(0, 256, (100, 16), dtype=np.uint8)
+    a = native.token_for("tenant-x", tids)
+    b = hashing.token_for("tenant-x", tids)
+    np.testing.assert_array_equal(a, b)
+
+
+def _sample_proto() -> bytes:
+    from tempo_tpu.model import proto_wire as pw
+
+    def anyval_str(s):
+        return pw.enc_field_str(1, s)
+
+    def kv(k, v_msg):
+        return pw.enc_field_str(1, k) + pw.enc_field_msg(2, v_msg)
+
+    def span(tid, sid, name, start, end, kind=2, code=2, msg="boom",
+             attrs=()):
+        b = pw.enc_field_bytes(1, tid) + pw.enc_field_bytes(2, sid)
+        b += pw.enc_field_str(5, name)
+        b += pw.enc_field_varint(6, kind)
+        b += pw.enc_field_varint(7, start) + pw.enc_field_varint(8, end)
+        for k, v in attrs:
+            b += pw.enc_field_msg(9, kv(k, anyval_str(v)))
+        b += pw.enc_field_msg(15, pw.enc_field_str(2, msg)
+                              + pw.enc_field_varint(3, code))
+        return b
+
+    # ResourceSpans.resource → Resource{attributes: [KeyValue]}
+    resource = pw.enc_field_msg(
+        1, pw.enc_field_msg(1, kv("service.name", anyval_str("svc-a"))))
+    spans = b"".join(
+        pw.enc_field_msg(2, span(bytes([i]) * 16, bytes([i]) * 8, f"op-{i}",
+                                 10 ** 18 + i, 10 ** 18 + i + 1000,
+                                 attrs=(("http.path", f"/p{i}"),)))
+        for i in range(1, 6))
+    scope_spans = pw.enc_field_msg(2, spans)
+    return pw.enc_field_msg(1, resource + scope_spans)
+
+
+def test_otlp_scan_matches_python_decoder():
+    data = _sample_proto()
+    nat = native.spans_from_otlp_proto_native(data)
+    ref = list(spans_from_otlp_proto(data))
+    assert nat is not None and len(nat) == len(ref) == 5
+    for a, b in zip(nat, ref):
+        for k in ("trace_id", "span_id", "name", "service", "kind",
+                  "status_code", "status_message", "start_unix_nano",
+                  "end_unix_nano", "attrs", "res_attrs"):
+            assert a[k] == b[k], (k, a[k], b[k])
+
+
+def test_otlp_scan_malformed_raises():
+    with pytest.raises(ValueError):
+        native.otlp_scan(b"\x0a\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff")
+
+
+def test_otlp_scan_grows_capacity():
+    data = _sample_proto()
+    recs = native.otlp_scan(data, cap_hint=1)  # force re-scan with growth
+    assert len(recs) == 5
+
+
+def test_missing_trace_id_matches_python_contract():
+    """A span without a trace id must decode to b'' so the distributor's
+    invalid-id validation fires identically on both paths."""
+    from tempo_tpu.model import proto_wire as pw
+    span = pw.enc_field_bytes(2, b"\x01" * 8) + pw.enc_field_str(5, "x")
+    data = pw.enc_field_msg(1, pw.enc_field_msg(2, pw.enc_field_msg(2, span)))
+    nat = native.spans_from_otlp_proto_native(data)
+    ref = list(spans_from_otlp_proto(data))
+    assert nat[0]["trace_id"] == ref[0]["trace_id"] == b""
+
+
+def test_resource_after_spans_field_order():
+    """Resource serialized after ScopeSpans is legal wire order; both
+    decoders must attribute the service correctly."""
+    from tempo_tpu.model import proto_wire as pw
+
+    def kv(k, v):
+        return pw.enc_field_str(1, k) + pw.enc_field_msg(2, pw.enc_field_str(1, v))
+
+    span = (pw.enc_field_bytes(1, b"\x05" * 16) + pw.enc_field_bytes(2, b"\x01" * 8)
+            + pw.enc_field_str(5, "x"))
+    scope_spans = pw.enc_field_msg(2, pw.enc_field_msg(2, span))
+    resource = pw.enc_field_msg(1, pw.enc_field_msg(1, kv("service.name", "late")))
+    data = pw.enc_field_msg(1, scope_spans + resource)  # spans FIRST
+    nat = native.spans_from_otlp_proto_native(data)
+    ref = list(spans_from_otlp_proto(data))
+    assert nat[0]["service"] == ref[0]["service"] == "late"
+
+
+def test_large_int_attr_exact():
+    from tempo_tpu.model import proto_wire as pw
+    big = (1 << 53) + 1
+    attr = (pw.enc_field_str(1, "n")
+            + pw.enc_field_msg(2, pw.enc_field_varint(3, big)))
+    span = (pw.enc_field_bytes(1, b"\x06" * 16) + pw.enc_field_bytes(2, b"\x01" * 8)
+            + pw.enc_field_msg(9, attr))
+    data = pw.enc_field_msg(1, pw.enc_field_msg(2, pw.enc_field_msg(2, span)))
+    nat = native.spans_from_otlp_proto_native(data)
+    assert nat[0]["attrs"]["n"] == big  # exact, no double round-trip
