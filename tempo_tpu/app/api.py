@@ -122,6 +122,11 @@ class Handler(BaseHTTPRequestHandler):
                 return self._status(path)
             if path == "/metrics":
                 return self._self_metrics()
+            if path == "/usage_metrics":
+                d = self.app.distributor
+                text = d.usage.prometheus_text() if d is not None else ""
+                return self._reply(200, text.encode(),
+                                   "text/plain; version=0.0.4")
             tenant = self._tenant()
             if not tenant:
                 return self._err(401, "no org id")
@@ -220,6 +225,7 @@ class Handler(BaseHTTPRequestHandler):
 
     def _self_metrics(self) -> None:
         """Prometheus text exposition of service self-metrics."""
+        from tempo_tpu.utils.usage import escape_label as esc
         lines = []
         d = self.app.distributor
         if d is not None:
@@ -227,15 +233,37 @@ class Handler(BaseHTTPRequestHandler):
                 lines.append(f"tempo_distributor_{k} {v}")
             for r, v in d.discarded.items():
                 lines.append(
-                    f'tempo_discarded_spans_total{{reason="{r}"}} {v}')
+                    f'tempo_discarded_spans_total{{reason="{esc(r)}"}} {v}')
         fe = self.app.frontend
         if fe is not None:
             for (op, tenant), v in fe.slos.total.items():
                 lines.append(f'tempo_query_frontend_queries_total'
-                             f'{{op="{op}",tenant="{tenant}"}} {v}')
+                             f'{{op="{op}",tenant="{esc(tenant)}"}} {v}')
             for (op, tenant), v in fe.slos.within.items():
                 lines.append(f'tempo_query_frontend_queries_within_slo_total'
-                             f'{{op="{op}",tenant="{tenant}"}} {v}')
+                             f'{{op="{op}",tenant="{esc(tenant)}"}} {v}')
+        ing = self.app.ingester
+        if ing is not None:
+            with ing.lock:
+                insts = dict(ing.instances)
+            for tenant, inst in insts.items():
+                lines.append(f'tempo_ingester_live_traces{{tenant="{esc(tenant)}"}} '
+                             f'{len(inst.live)}')
+                for reason, v in inst.discarded.items():
+                    lines.append(
+                        f'tempo_ingester_discarded_traces_total'
+                        f'{{tenant="{esc(tenant)}",reason="{esc(reason)}"}} {v}')
+        gen = self.app.generator
+        if gen is not None:
+            with gen._lock:
+                ginsts = dict(gen.instances)
+            for tenant, gi in ginsts.items():
+                lines.append(
+                    f'tempo_metrics_generator_spans_received_total'
+                    f'{{tenant="{esc(tenant)}"}} {gi.spans_received}')
+                lines.append(
+                    f'tempo_metrics_generator_registry_active_series'
+                    f'{{tenant="{esc(tenant)}"}} {gi.registry.budget.used}')
         self._reply(200, "\n".join(lines).encode() + b"\n",
                     "text/plain; version=0.0.4")
 

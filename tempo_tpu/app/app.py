@@ -110,7 +110,17 @@ class App:
             user_configurable=uc)
 
     def _init_store(self) -> None:
-        self.db = TempoDB(self.backend, self.backend, TempoDBConfig(
+        reader = self.backend
+        if self.cfg.storage.hedge_delay_s > 0:
+            from tempo_tpu.utils.hedging import HedgedReader
+            reader = HedgedReader(reader, self.cfg.storage.hedge_delay_s,
+                                  self.cfg.storage.hedge_max)
+        if self.cfg.storage.cache_enabled:
+            from tempo_tpu.backend.cache import CacheProvider, CachingReader
+            self.cache_provider = CacheProvider(
+                default_bytes=self.cfg.storage.cache_bytes_per_role)
+            reader = CachingReader(self.backend, self.cache_provider)
+        self.db = TempoDB(reader, self.backend, TempoDBConfig(
             compactor=self.cfg.compactor,
             pool_workers=self.cfg.storage.pool_workers))
 

@@ -41,6 +41,10 @@ class StorageConfig:
     cloud: dict = dataclasses.field(default_factory=dict)
     poll_interval_s: float = 30.0
     pool_workers: int = 30
+    cache_enabled: bool = True          # bloom/footer/page role caches
+    cache_bytes_per_role: int = 64 << 20
+    hedge_delay_s: float = 0.0          # >0: hedge slow object reads
+    hedge_max: int = 1
 
 
 @dataclasses.dataclass

@@ -73,6 +73,8 @@ class Distributor:
         self.generator_clients = generator_clients or {}
         self.limiter = RateLimiter(now=now)
         self.n_distributors = n_distributors
+        from tempo_tpu.utils.usage import UsageTracker
+        self.usage = UsageTracker()
         # self-metrics (tempo_distributor_* naming)
         self.metrics: dict[str, float] = {
             "spans_received_total": 0, "bytes_received_total": 0,
@@ -99,6 +101,7 @@ class Distributor:
 
         self.metrics["spans_received_total"] += len(spans)
         self.metrics["bytes_received_total"] += sz
+        self.usage.observe(tenant, spans, sz)
 
         spans, errs = self._validate(spans, lim)
         if not spans:

@@ -9,7 +9,9 @@ registers) replace the frontend's combiner tree over gRPC.
 
 from tempo_tpu.parallel.mesh import (
     make_mesh,
+    make_multihost_mesh,
     merge_sketch_states,
+    sharded_query_range_step,
     sharded_spanmetrics_step,
     shard_batch_arrays,
 )

@@ -41,6 +41,26 @@ def make_mesh(n_devices: int | None = None, series_shards: int = 1) -> Mesh:
     return Mesh(devs.reshape(n // series_shards, series_shards), ("data", "series"))
 
 
+def make_multihost_mesh(series_shards: int = 1) -> Mesh:
+    """Multi-host mesh: 'data' spans hosts (DCN), 'series' stays within a
+    host's slice (ICI) — collectives on 'series' ride ICI, the data-psum
+    crosses DCN once per step, mirroring how the reference keeps ingester
+    traffic local and only ships merged series to the frontend.
+
+    Falls back to the flat single-host mesh when only one process exists.
+    """
+    if jax.process_count() == 1:
+        return make_mesh(series_shards=series_shards)
+    from jax.experimental import mesh_utils
+
+    per_host = jax.local_device_count()
+    assert per_host % series_shards == 0, (per_host, series_shards)
+    devs = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(per_host // series_shards, series_shards),
+        dcn_mesh_shape=(jax.process_count(), 1))
+    return Mesh(devs, ("data", "series"))
+
+
 def shard_batch_arrays(mesh: Mesh, arrays: dict) -> dict:
     """Place host batch columns with leading dim sharded over 'data'."""
     sh = NamedSharding(mesh, P("data"))
@@ -105,4 +125,41 @@ def sharded_spanmetrics_step(mesh: Mesh, edges: tuple, gamma: float,
     fn = _shard_map(step, mesh=mesh,
                     in_specs=state_specs + batch_specs,
                     out_specs=state_specs)
+    return jax.jit(fn)
+
+
+def sharded_query_range_step(mesh: Mesh, n_buckets: int = 0):
+    """Multi-device TraceQL-metrics observation: the sequence-parallel scan.
+
+    The reference shards a query's *time/span space* into jobs combined at
+    the frontend (`metrics_query_range_sharder.go` + `combiner/`); here the
+    span batch is the sharded sequence dimension and the combine is one
+    psum. Layout: spans (slots/steps/values) sharded over 'data'; the
+    [series, steps] (or [series, steps, buckets] when n_buckets>0 — the
+    quantile histogram plane) grid sharded over 'series' on dim 0. Each
+    device scatter-adds its span shard into the slots it owns; psum over
+    'data' is the cross-shard combine.
+
+    Returns jit(fn(grid, slots, steps, values) -> grid).
+    """
+
+    def step(grid, slots, steps, values):
+        shard_cap = grid.shape[0]
+        my_shard = jax.lax.axis_index("series")
+        owner = jnp.where(slots >= 0, slots // shard_cap, -1)
+        local = jnp.where(owner == my_shard, slots - my_shard * shard_cap,
+                          shard_cap)  # OOB row + mode=drop = masked
+        delta = jnp.zeros_like(grid)
+        if n_buckets:
+            b = jnp.clip(jnp.ceil(jnp.log2(jnp.maximum(values, 1.0))),
+                         0, n_buckets - 1).astype(jnp.int32)
+            delta = delta.at[local, steps, b].add(1.0, mode="drop")
+        else:
+            delta = delta.at[local, steps].add(values, mode="drop")
+        return grid + jax.lax.psum(delta, "data")
+
+    grid_spec = P("series", None, None) if n_buckets else P("series", None)
+    fn = _shard_map(step, mesh=mesh,
+                    in_specs=(grid_spec, P("data"), P("data"), P("data")),
+                    out_specs=grid_spec)
     return jax.jit(fn)

@@ -1,0 +1,85 @@
+"""Cost-attribution usage tracker.
+
+Analog of `modules/distributor/usage` (`usage.NewTracker`, handler
+`/usage_metrics` `modules.go:272-274`): per-tenant byte counters broken
+down by configurable span/resource dimensions, with a max-cardinality
+guard that buckets overflow series into an `__overflow__` label.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Sequence
+
+OVERFLOW = "__overflow__"
+MISSING = "__missing__"
+
+
+def escape_label(v: str) -> str:
+    """Prometheus exposition label escaping: backslash, quote, newline.
+    Attacker-controlled values (tenant header, span attrs) must never be
+    able to forge or corrupt exposition lines."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+@dataclasses.dataclass
+class UsageTrackerConfig:
+    dimensions: tuple[str, ...] = ("service",)   # span-dict keys or attrs
+    max_cardinality: int = 10_000
+
+
+class UsageTracker:
+    def __init__(self, cfg: UsageTrackerConfig | None = None) -> None:
+        self.cfg = cfg or UsageTrackerConfig()
+        self._lock = threading.Lock()
+        # (tenant, (dim values...)) -> [bytes, spans]
+        self._series: dict[tuple, list] = {}
+
+    def observe(self, tenant: str, spans: Sequence[dict],
+                size_bytes: int | None = None) -> None:
+        dims = self.cfg.dimensions
+        per_span = ((size_bytes / max(len(spans), 1))
+                    if size_bytes is not None else None)
+        with self._lock:
+            for s in spans:
+                vals = []
+                for d in dims:
+                    v = s.get(d)
+                    if v is None:
+                        v = (s.get("attrs") or {}).get(d)
+                    if v is None:
+                        v = (s.get("res_attrs") or {}).get(d)
+                    vals.append(str(v) if v is not None else MISSING)
+                key = (tenant, tuple(vals))
+                ent = self._series.get(key)
+                if ent is None:
+                    if len(self._series) >= self.cfg.max_cardinality:
+                        key = (tenant, (OVERFLOW,) * len(dims))
+                        ent = self._series.setdefault(key, [0, 0])
+                    else:
+                        ent = self._series[key] = [0, 0]
+                sz = per_span if per_span is not None else _span_size(s)
+                ent[0] += sz
+                ent[1] += 1
+
+    def prometheus_text(self) -> str:
+        """`/usage_metrics` exposition."""
+        dims = self.cfg.dimensions
+        lines = []
+        with self._lock:
+            for (tenant, vals), (nbytes, nspans) in sorted(self._series.items()):
+                labels = ",".join(
+                    [f'tenant="{escape_label(tenant)}"'] +
+                    [f'{d}="{escape_label(v)}"' for d, v in zip(dims, vals)])
+                lines.append(
+                    f"tempo_usage_tracker_bytes_received_total{{{labels}}} "
+                    f"{int(nbytes)}")
+                lines.append(
+                    f"tempo_usage_tracker_spans_received_total{{{labels}}} "
+                    f"{nspans}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _span_size(s: dict) -> int:
+    return 200 + 32 * (len(s.get("attrs") or {}) + len(s.get("res_attrs") or {}))

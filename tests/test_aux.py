@@ -1,0 +1,104 @@
+"""Aux subsystems: usage tracker, hedged requests, cache roles."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from tempo_tpu.backend.cache import CacheProvider, CachingReader, ROLE_BLOOM
+from tempo_tpu.backend.mem import MemBackend
+from tempo_tpu.backend.raw import KeyPath
+from tempo_tpu.utils.hedging import HedgedMetrics, hedged_call
+from tempo_tpu.utils.usage import OVERFLOW, UsageTracker, UsageTrackerConfig
+
+
+def test_usage_tracker_dimensions_and_overflow():
+    t = UsageTracker(UsageTrackerConfig(dimensions=("service",),
+                                        max_cardinality=3))
+    for i in range(5):
+        t.observe("acme", [{"service": f"svc-{i}", "attrs": {}}])
+    text = t.prometheus_text()
+    assert 'service="svc-0"' in text
+    assert OVERFLOW in text  # 4th/5th distinct services bucket to overflow
+    assert 'tenant="acme"' in text
+    # attr-sourced dimension
+    t2 = UsageTracker(UsageTrackerConfig(dimensions=("team",)))
+    t2.observe("acme", [{"attrs": {"team": "payments"}}], size_bytes=1000)
+    assert 'team="payments"' in t2.prometheus_text()
+    assert "1000" in t2.prometheus_text()
+
+
+def test_hedged_call_fast_path_no_hedge():
+    m = HedgedMetrics()
+    assert hedged_call(lambda: 42, delay_s=0.5, metrics=m) == 42
+    assert m.requests_total == 1 and m.hedged_total == 0
+
+
+def test_hedged_call_hedges_slow_first_attempt():
+    m = HedgedMetrics()
+    calls = []
+    lock = threading.Lock()
+
+    def fn():
+        with lock:
+            calls.append(None)
+            n = len(calls)
+        if n == 1:
+            time.sleep(1.0)  # slow first attempt
+            return "slow"
+        return "fast"
+
+    t0 = time.perf_counter()
+    out = hedged_call(fn, delay_s=0.05, metrics=m)
+    assert out == "fast"
+    assert time.perf_counter() - t0 < 0.8
+    assert m.hedged_total == 1
+
+
+def test_hedged_call_propagates_error_after_all_fail():
+    def boom():
+        raise RuntimeError("nope")
+    with pytest.raises(RuntimeError, match="nope"):
+        hedged_call(boom, delay_s=0.01)
+
+
+def test_usage_label_escaping():
+    t = UsageTracker(UsageTrackerConfig(dimensions=("service",)))
+    evil = 'a"} 999\ninjected_metric{x="y'
+    t.observe("ten\"ant", [{"service": evil}])
+    text = t.prometheus_text()
+    # no forged exposition line: every physical line is one of ours, raw
+    # newlines/quotes in values are escaped
+    for line in text.strip().splitlines():
+        assert line.startswith("tempo_usage_tracker_")
+    assert '\\n' in text and '\\"' in text
+
+
+def test_hedged_reader_wraps_reads():
+    from tempo_tpu.utils.hedging import HedgedReader
+
+    be = MemBackend()
+    kp = KeyPath(("t", "b"))
+    be.write("data", kp, b"hello")
+    r = HedgedReader(be, delay_s=0.5)
+    assert r.read("data", kp) == b"hello"
+    assert r.read_range("data", kp, 1, 3) == b"ell"
+    assert r.metrics.requests_total == 2 and r.metrics.hedged_total == 0
+
+
+def test_caching_reader_roles():
+    be = MemBackend()
+    kp = KeyPath(("t1", "blk"))
+    be.write("bloom-0", kp, b"BLOOMDATA")
+    be.write("data.parquet", kp, b"0123456789")
+    prov = CacheProvider()
+    r = CachingReader(be, prov)
+    assert r.read("bloom-0", kp) == b"BLOOMDATA"
+    assert r.read("bloom-0", kp) == b"BLOOMDATA"
+    c = prov.cache_for(ROLE_BLOOM)
+    assert c.hits == 1 and c.misses == 1
+    # page ranges cached under page role
+    assert r.read_range("data.parquet", kp, 2, 3) == b"234"
+    assert r.read_range("data.parquet", kp, 2, 3) == b"234"

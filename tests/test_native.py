@@ -72,9 +72,22 @@ def test_otlp_scan_malformed_raises():
 
 
 def test_otlp_scan_grows_capacity():
-    data = _sample_proto()
-    recs = native.otlp_scan(data, cap_hint=1)  # force re-scan with growth
-    assert len(recs) == 5
+    """>16 spans with cap_hint=1 (clamped to 16) forces the re-scan/grow
+    branch for both the span and attr buffers."""
+    from tempo_tpu.model import proto_wire as pw
+    spans = b"".join(
+        pw.enc_field_msg(2,
+            pw.enc_field_bytes(1, bytes([i]) * 16)
+            + pw.enc_field_bytes(2, bytes([i]) * 8)
+            + pw.enc_field_str(5, f"s{i}")
+            + pw.enc_field_msg(9, pw.enc_field_str(1, "k")
+                               + pw.enc_field_msg(2, pw.enc_field_str(1, "v"))))
+        for i in range(1, 41))
+    data = pw.enc_field_msg(1, pw.enc_field_msg(2, spans))
+    recs = native.otlp_scan(data, cap_hint=1)
+    assert len(recs) == 40
+    recs2, attrs = native.otlp_scan2(data, cap_hint=1)
+    assert len(recs2) == 40 and len(attrs) == 40
 
 
 def test_missing_trace_id_matches_python_contract():
