@@ -71,6 +71,8 @@ class Handler(BaseHTTPRequestHandler):
         try:
             if path == "/v1/traces":
                 return self._push(tenant)
+            if path == "/api/v2/spans":       # zipkin v2 receiver
+                return self._push_zipkin(tenant)
             if path == "/api/overrides":
                 return self._set_overrides(tenant)
         except Exception as e:
@@ -98,6 +100,22 @@ class Handler(BaseHTTPRequestHandler):
             self.end_headers()
             return
         self._reply(200, _json_bytes({"errors": errs} if errs else {}))
+
+    def _push_zipkin(self, tenant: str) -> None:
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        from tempo_tpu.model.zipkin import spans_from_zipkin_json
+        spans = list(spans_from_zipkin_json(json.loads(body)))
+        from tempo_tpu.distributor.distributor import RateLimited
+        try:
+            errs = self.app.distributor.push_spans(tenant, spans)
+        except RateLimited:
+            self.send_response(429)
+            self.send_header("Retry-After", "1")
+            self.end_headers()
+            return
+        # zipkin collectors reply 202
+        self._reply(202, _json_bytes({"errors": errs} if errs else {}))
 
     def _set_overrides(self, tenant: str) -> None:
         n = int(self.headers.get("Content-Length", 0))

@@ -119,7 +119,7 @@ class App:
             from tempo_tpu.backend.cache import CacheProvider, CachingReader
             self.cache_provider = CacheProvider(
                 default_bytes=self.cfg.storage.cache_bytes_per_role)
-            reader = CachingReader(self.backend, self.cache_provider)
+            reader = CachingReader(reader, self.cache_provider)
         self.db = TempoDB(reader, self.backend, TempoDBConfig(
             compactor=self.cfg.compactor,
             pool_workers=self.cfg.storage.pool_workers))

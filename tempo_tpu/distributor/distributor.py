@@ -42,6 +42,9 @@ class GeneratorClient(Protocol):
 class DistributorConfig:
     rf: int = 3
     generator_rf: int = 1            # generator forwarding is RF1
+    # per-tenant forwarder configs: {tenant: [{name, endpoint, filter}, ...]}
+    # (`modules/distributor/forwarder` per-tenant tee)
+    forwarders: dict = dataclasses.field(default_factory=dict)
 
 
 class RateLimited(RuntimeError):
@@ -73,8 +76,19 @@ class Distributor:
         self.generator_clients = generator_clients or {}
         self.limiter = RateLimiter(now=now)
         self.n_distributors = n_distributors
+        from tempo_tpu.distributor.forwarder import (
+            Forwarder,
+            ForwarderConfig,
+            ForwarderManager,
+        )
         from tempo_tpu.utils.usage import UsageTracker
         self.usage = UsageTracker()
+        self.forwarders = ForwarderManager()
+        for tenant, fwd_cfgs in (self.cfg.forwarders or {}).items():
+            for fc in fwd_cfgs:
+                cfg_obj = fc if isinstance(fc, ForwarderConfig) \
+                    else ForwarderConfig(**fc)
+                self.forwarders.register(tenant, Forwarder(cfg_obj))
         # self-metrics (tempo_distributor_* naming)
         self.metrics: dict[str, float] = {
             "spans_received_total": 0, "bytes_received_total": 0,
@@ -106,6 +120,7 @@ class Distributor:
         spans, errs = self._validate(spans, lim)
         if not spans:
             return errs
+        self.forwarders.offer(tenant, spans)  # async tee, never blocks
 
         groups, tid_matrix = _group_by_trace(spans)
         tokens = token_for(tenant, tid_matrix)

@@ -1,0 +1,176 @@
+"""Generic per-tenant trace forwarder (tee to external endpoints).
+
+Analog of `modules/distributor/forwarder` (`forwarder/manager.go:291`):
+each tenant may configure named forwarders; matching spans are teed
+asynchronously to the forwarder's sink. Sinks are pluggable — an
+OTLP-JSON HTTP sink is provided; tests inject callables. Filtering uses
+the span-filter policy engine (the OTTL-filter analog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import queue
+import threading
+import urllib.request
+from typing import Callable, Sequence
+
+@dataclasses.dataclass
+class ForwarderConfig:
+    name: str
+    endpoint: str = ""                    # http(s) OTLP-JSON target
+    # filter: {"include": {key: value, ...}} and/or {"exclude": {...}} —
+    # strict matches on name/service/kind/status_code or span attrs (the
+    # OTTL-filter analog, dict-level since the tee runs pre-batching)
+    filter: dict = dataclasses.field(default_factory=dict)
+    queue_size: int = 1000
+
+
+def _span_matches(span: dict, wants: dict) -> bool:
+    for k, v in wants.items():
+        have = span.get(k)
+        if have is None:
+            have = (span.get("attrs") or {}).get(k)
+        if have is None:
+            have = (span.get("res_attrs") or {}).get(k)
+        if str(have) != str(v):
+            return False
+    return True
+
+
+def keep_span(span: dict, flt: dict) -> bool:
+    inc = flt.get("include")
+    if inc and not _span_matches(span, inc):
+        return False
+    exc = flt.get("exclude")
+    if exc and _span_matches(span, exc):
+        return False
+    return True
+
+
+def otlp_json_payload(spans: Sequence[dict]) -> dict:
+    """Flat span dicts → OTLP-JSON ExportTraceServiceRequest."""
+    by_service: dict[str, list[dict]] = {}
+    for s in spans:
+        by_service.setdefault(s.get("service", ""), []).append(s)
+    rss = []
+    for svc, group in by_service.items():
+        rss.append({
+            "resource": {"attributes": [
+                {"key": "service.name", "value": {"stringValue": svc}}]},
+            "scopeSpans": [{"spans": [{
+                "traceId": s.get("trace_id", b"").hex(),
+                "spanId": s.get("span_id", b"").hex(),
+                "parentSpanId": s.get("parent_span_id", b"").hex(),
+                "name": s.get("name", ""),
+                "kind": s.get("kind", 0),
+                "startTimeUnixNano": str(s.get("start_unix_nano", 0)),
+                "endTimeUnixNano": str(s.get("end_unix_nano", 0)),
+                "attributes": [
+                    {"key": k, "value": _anyvalue(v)}
+                    for k, v in (s.get("attrs") or {}).items()],
+                "status": {"code": s.get("status_code", 0)},
+            } for s in group]}],
+        })
+    return {"resourceSpans": rss}
+
+
+def _anyvalue(v) -> dict:
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+def http_sink(endpoint: str, timeout_s: float = 10.0
+              ) -> Callable[[Sequence[dict]], None]:
+    def send(spans: Sequence[dict]) -> None:
+        body = json.dumps(otlp_json_payload(spans)).encode()
+        req = urllib.request.Request(
+            endpoint, data=body, headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=timeout_s).read()
+    return send
+
+
+class Forwarder:
+    """One tenant's forwarder: bounded queue + worker thread, drop-on-full
+    (forwarding is best-effort; it must never block ingest)."""
+
+    def __init__(self, cfg: ForwarderConfig,
+                 sink: Callable[[Sequence[dict]], None] | None = None) -> None:
+        self.cfg = cfg
+        self.sink = sink or http_sink(cfg.endpoint)
+        self._q: queue.Queue = queue.Queue(maxsize=cfg.queue_size)
+        self.dropped = 0
+        self.forwarded = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def offer(self, spans: Sequence[dict]) -> None:
+        if self.cfg.filter:
+            spans = [s for s in spans if keep_span(s, self.cfg.filter)]
+        if not spans:
+            return
+        try:
+            self._q.put_nowait(list(spans))
+        except queue.Full:
+            self.dropped += len(spans)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                spans = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self.sink(spans)
+                self.forwarded += len(spans)
+            except Exception:
+                self.dropped += len(spans)
+
+    def flush(self, timeout_s: float = 2.0) -> None:
+        import time
+        deadline = time.time() + timeout_s
+        while not self._q.empty() and time.time() < deadline:
+            time.sleep(0.01)
+
+    def shutdown(self) -> None:
+        self.flush()
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+
+class ForwarderManager:
+    """Per-tenant forwarder registry driven by overrides/config
+    (`forwarder/manager.go`)."""
+
+    def __init__(self) -> None:
+        self._by_tenant: dict[str, list[Forwarder]] = {}
+        self._lock = threading.Lock()
+        self.empty = True   # lock-free hot-path gate (flips once)
+
+    def register(self, tenant: str, fwd: Forwarder) -> None:
+        with self._lock:
+            self._by_tenant.setdefault(tenant, []).append(fwd)
+            self.empty = False
+
+    def for_tenant(self, tenant: str) -> list[Forwarder]:
+        with self._lock:
+            return list(self._by_tenant.get(tenant, ()))
+
+    def offer(self, tenant: str, spans: Sequence[dict]) -> None:
+        if self.empty:
+            return
+        for fwd in self.for_tenant(tenant):
+            fwd.offer(spans)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            all_fwds = [f for fs in self._by_tenant.values() for f in fs]
+        for f in all_fwds:
+            f.shutdown()

@@ -1,0 +1,124 @@
+"""Pallas TPU kernels for the sketch-update plane — and why XLA wins here.
+
+The hot op of this framework is a masked segment scatter-add: N spans fold
+into S series of {count, duration-sum, size, log2/DD histogram buckets}.
+Two device formulations exist:
+
+1. **XLA scatter** (`ops/sketches.py` / `registry/metrics.py`,
+   `.at[slots, ...].add`): XLA:TPU lowers batched scatters to a sort +
+   segmented reduction. Measured on a real v5e chip this sustains
+   ~3.7e9 spans/s through the FULL fused spanmetrics step (bench.py) —
+   370x the north-star target.
+2. **MXU one-hot matmul** (this module): each span block builds a one-hot
+   slot matrix and a feature matrix (count|dur|size|hist-onehot), and the
+   partial state is `onehotᵀ @ features` — a dense [S, F] accumulation on
+   the systolic array across a sequential grid over span blocks. This is
+   the canonical "scatter as matmul" TPU trick; it pays S*F*N FLOPs for a
+   job that is information-theoretically O(N*F), so it only wins when S is
+   tiny. `benchmarks/bench_kernels.py` measures both on the real chip.
+
+Measured on a real v5e-1 (262144 spans, 4096 series, 16 features,
+`benchmarks/bench_kernels.py`): XLA scatter 81.4M spans/s, this Pallas
+MXU kernel 81.6M spans/s — parity on the fresh-delta shape, while the
+production in-place multi-plane update (bench.py, donated buffers) runs
+at 3.7G spans/s through XLA. The kernel is kept (a) as the measured
+justification for the XLA default, (b) as the template for future dense
+kernels (a complete grid/BlockSpec/accumulator Pallas program per
+/opt/skills/guides/pallas_guide.md), and (c) because it fuses the whole
+feature plane into one MXU pass, which wins when the feature dim grows.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_SLOT_DROPS = True  # slots < 0 contribute nothing (padding mask)
+
+
+def _fused_kernel(slots_ref, dur_ref, size_ref, w_ref, out_ref, *,
+                  n_series: int, n_buckets: int, edges):
+    """One grid step: fold a span block into the [S, F] state block.
+
+    Feature layout F = 3 + n_buckets:
+      0: weighted count   1: weighted duration sum   2: weighted size sum
+      3..: bucketed duration histogram (log2-spaced `edges` closed-over)
+    """
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    slots = slots_ref[:]                      # [N]
+    dur = dur_ref[:]
+    size = size_ref[:]
+    w = jnp.where(slots >= 0, w_ref[:], 0.0)  # mask padding / dropped rows
+
+    n = slots.shape[0]
+    # one-hot slot matrix [N, S] — TPU needs 2D iota
+    series_ids = jax.lax.broadcasted_iota(jnp.int32, (n, n_series), 1)
+    onehot = jnp.where(series_ids == slots[:, None], w[:, None], 0.0)
+
+    # per-span feature matrix [N, F]; edges unroll statically (python
+    # floats — pallas kernels cannot capture traced array constants)
+    bucket = jnp.zeros((n,), jnp.int32)
+    for e in edges:
+        bucket = bucket + (dur > e).astype(jnp.int32)
+    bucket_ids = jax.lax.broadcasted_iota(jnp.int32, (n, n_buckets), 1)
+    hist = jnp.where(bucket_ids == bucket[:, None], 1.0, 0.0)
+    feats = jnp.concatenate(
+        [jnp.ones((n, 1), jnp.float32), dur[:, None], size[:, None], hist],
+        axis=1)
+
+    out_ref[:] += jax.lax.dot_general(
+        onehot, feats, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def fused_spanmetrics_matmul(slots, dur_s, sizes, weights, *,
+                             n_series: int, edges: tuple,
+                             block: int = 512, interpret: bool = False):
+    """MXU formulation of the fused spanmetrics update.
+
+    Returns [n_series, 3 + len(edges)+1] f32: count | dur_sum | size_sum |
+    histogram buckets. Pure function of the batch (caller adds to state).
+    """
+    n = slots.shape[0]
+    assert n % block == 0, (n, block)
+    n_buckets = len(edges) + 1
+    f = 3 + n_buckets
+    kernel = functools.partial(
+        _fused_kernel, n_series=n_series, n_buckets=n_buckets,
+        edges=tuple(float(e) for e in edges))
+    return pl.pallas_call(
+        kernel,
+        grid=(n // block,),
+        in_specs=[pl.BlockSpec((block,), lambda i: (i,))
+                  for _ in range(4)],
+        out_specs=pl.BlockSpec((n_series, f), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_series, f), jnp.float32),
+        interpret=interpret,
+    )(slots, dur_s, sizes, weights)
+
+
+def fused_spanmetrics_scatter(slots, dur_s, sizes, weights, *,
+                              n_series: int, edges: tuple):
+    """The XLA-scatter formulation producing the same [S, F] output, for
+    apples-to-apples benchmarking against the Pallas matmul kernel."""
+    n_buckets = len(edges) + 1
+    f = 3 + n_buckets
+    keep = slots >= 0
+    s = jnp.where(keep, slots, n_series)     # OOB + drop = masked
+    w = jnp.where(keep, weights, 0.0)
+    out = jnp.zeros((n_series, f), jnp.float32)
+    out = out.at[s, 0].add(w, mode="drop")
+    out = out.at[s, 1].add(dur_s * w, mode="drop")
+    out = out.at[s, 2].add(sizes * w, mode="drop")
+    bucket = jnp.searchsorted(jnp.asarray(edges, jnp.float32), dur_s,
+                              side="left")
+    out = out.at[s, 3 + bucket].add(w, mode="drop")
+    return out

@@ -93,6 +93,9 @@ class HedgedReader(RawReader):
     def find(self, keypath: KeyPath, suffix: str = "") -> list[str]:
         return self.inner.find(keypath, suffix)
 
+    def size(self, name: str, keypath: KeyPath) -> int:
+        return self.inner.size(name, keypath)
+
     def read(self, name: str, keypath: KeyPath) -> bytes:
         return hedged_call(lambda: self.inner.read(name, keypath),
                            self.delay_s, self.max_hedges, self.metrics)

@@ -26,15 +26,16 @@ def escape_label(v: str) -> str:
 @dataclasses.dataclass
 class UsageTrackerConfig:
     dimensions: tuple[str, ...] = ("service",)   # span-dict keys or attrs
-    max_cardinality: int = 10_000
+    max_cardinality: int = 10_000                # per tenant
 
 
 class UsageTracker:
     def __init__(self, cfg: UsageTrackerConfig | None = None) -> None:
         self.cfg = cfg or UsageTrackerConfig()
         self._lock = threading.Lock()
-        # (tenant, (dim values...)) -> [bytes, spans]
-        self._series: dict[tuple, list] = {}
+        # tenant -> {(dim values...) -> [bytes, spans]}; the cardinality cap
+        # is per tenant, so one noisy tenant can't overflow its neighbours
+        self._series: dict[str, dict[tuple, list]] = {}
 
     def observe(self, tenant: str, spans: Sequence[dict],
                 size_bytes: int | None = None) -> None:
@@ -42,6 +43,7 @@ class UsageTracker:
         per_span = ((size_bytes / max(len(spans), 1))
                     if size_bytes is not None else None)
         with self._lock:
+            tseries = self._series.setdefault(tenant, {})
             for s in spans:
                 vals = []
                 for d in dims:
@@ -51,14 +53,14 @@ class UsageTracker:
                     if v is None:
                         v = (s.get("res_attrs") or {}).get(d)
                     vals.append(str(v) if v is not None else MISSING)
-                key = (tenant, tuple(vals))
-                ent = self._series.get(key)
+                key = tuple(vals)
+                ent = tseries.get(key)
                 if ent is None:
-                    if len(self._series) >= self.cfg.max_cardinality:
-                        key = (tenant, (OVERFLOW,) * len(dims))
-                        ent = self._series.setdefault(key, [0, 0])
+                    if len(tseries) >= self.cfg.max_cardinality:
+                        key = (OVERFLOW,) * len(dims)
+                        ent = tseries.setdefault(key, [0, 0])
                     else:
-                        ent = self._series[key] = [0, 0]
+                        ent = tseries[key] = [0, 0]
                 sz = per_span if per_span is not None else _span_size(s)
                 ent[0] += sz
                 ent[1] += 1
@@ -68,16 +70,17 @@ class UsageTracker:
         dims = self.cfg.dimensions
         lines = []
         with self._lock:
-            for (tenant, vals), (nbytes, nspans) in sorted(self._series.items()):
-                labels = ",".join(
-                    [f'tenant="{escape_label(tenant)}"'] +
-                    [f'{d}="{escape_label(v)}"' for d, v in zip(dims, vals)])
-                lines.append(
-                    f"tempo_usage_tracker_bytes_received_total{{{labels}}} "
-                    f"{int(nbytes)}")
-                lines.append(
-                    f"tempo_usage_tracker_spans_received_total{{{labels}}} "
-                    f"{nspans}")
+            for tenant in sorted(self._series):
+                for vals, (nbytes, nspans) in sorted(self._series[tenant].items()):
+                    labels = ",".join(
+                        [f'tenant="{escape_label(tenant)}"'] +
+                        [f'{d}="{escape_label(v)}"' for d, v in zip(dims, vals)])
+                    lines.append(
+                        f"tempo_usage_tracker_bytes_received_total{{{labels}}} "
+                        f"{int(nbytes)}")
+                    lines.append(
+                        f"tempo_usage_tracker_spans_received_total{{{labels}}} "
+                        f"{nspans}")
         return "\n".join(lines) + ("\n" if lines else "")
 
 

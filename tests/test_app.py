@@ -111,6 +111,25 @@ OTLP = {"resourceSpans": [{
         "status": {"code": 0}}]}]}]}
 
 
+def test_zipkin_receiver(server):
+    import time
+    app, base = server
+    ts = int((time.time() - 3) * 1e6)
+    spans = [{"traceId": "cc" * 16, "id": "dd" * 8, "name": "zip-op",
+              "kind": "SERVER", "timestamp": ts, "duration": 50_000,
+              "localEndpoint": {"serviceName": "zipkin-svc"},
+              "tags": {"http.method": "GET"}}]
+    req = urllib.request.Request(f"{base}/api/v2/spans",
+                                 data=json.dumps(spans).encode(),
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 202
+    code, tr = _get(f"{base}/api/traces/{'cc' * 16}")
+    assert code == 200 and tr["spans"][0]["name"] == "zip-op"
+    assert tr["spans"][0]["service"] == "zipkin-svc"
+    assert tr["spans"][0]["attrs"]["http.method"] == "GET"
+
+
 def test_http_e2e(server):
     import time
     app, base = server

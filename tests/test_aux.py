@@ -88,6 +88,42 @@ def test_hedged_reader_wraps_reads():
     assert r.metrics.requests_total == 2 and r.metrics.hedged_total == 0
 
 
+def test_forwarder_tee_filter_and_payload():
+    from tempo_tpu.distributor.forwarder import (
+        Forwarder,
+        ForwarderConfig,
+        ForwarderManager,
+        otlp_json_payload,
+    )
+
+    got = []
+    fwd = Forwarder(ForwarderConfig(
+        name="tee", filter={"include": {"service": "svc-a"},
+                            "exclude": {"name": "noisy"}}),
+        sink=got.extend)
+    mgr = ForwarderManager()
+    mgr.register("t1", fwd)
+    spans = [
+        {"trace_id": b"\x01" * 16, "span_id": b"\x01" * 8, "name": "ok",
+         "service": "svc-a", "start_unix_nano": 1, "end_unix_nano": 2,
+         "attrs": {"k": 1}},
+        {"trace_id": b"\x02" * 16, "span_id": b"\x02" * 8, "name": "noisy",
+         "service": "svc-a", "start_unix_nano": 1, "end_unix_nano": 2},
+        {"trace_id": b"\x03" * 16, "span_id": b"\x03" * 8, "name": "ok",
+         "service": "svc-b", "start_unix_nano": 1, "end_unix_nano": 2},
+    ]
+    mgr.offer("t1", spans)
+    mgr.offer("other-tenant", spans)  # not registered: no-op
+    fwd.flush()
+    mgr.shutdown()
+    assert len(got) == 1 and got[0]["name"] == "ok"
+    assert fwd.forwarded == 1
+    payload = otlp_json_payload(got)
+    sp = payload["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+    assert sp["traceId"] == "01" * 16
+    assert sp["attributes"] == [{"key": "k", "value": {"intValue": "1"}}]
+
+
 def test_caching_reader_roles():
     be = MemBackend()
     kp = KeyPath(("t1", "blk"))
