@@ -75,9 +75,38 @@ class Handler(BaseHTTPRequestHandler):
                 return self._push_zipkin(tenant)
             if path == "/api/overrides":
                 return self._set_overrides(tenant)
+            if path.startswith("/internal/"):
+                return self._internal_post(tenant, path)
         except Exception as e:
             return self._err(500, str(e))
         self._err(404, f"unknown path {path}")
+
+    def _internal_post(self, tenant: str, path: str) -> None:
+        """Inter-service RPC surface (the gRPC-plane analog; tempo_tpu.rpc
+        clients are the callers)."""
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        from tempo_tpu.rpc import decode_push_body
+        if path == "/internal/ingester/push":
+            traces = decode_push_body(body)
+            errs = self.app.ingester.push(tenant, traces)
+            return self._reply(200, _json_bytes({"errors": errs}))
+        if path == "/internal/generator/push":
+            traces = decode_push_body(body)
+            spans = [s for _tid, group in traces for s in group]
+            self.app.generator.push_spans(tenant, spans)
+            return self._reply(200, b"{}")
+        if path == "/internal/generator/query_range":
+            from tempo_tpu.traceql.engine_metrics import QueryRangeRequest
+            d = json.loads(body)
+            req = QueryRangeRequest(query=d["query"], start_ns=d["start_ns"],
+                                    end_ns=d["end_ns"], step_ns=d["step_ns"])
+            series = self.app.generator.query_range(
+                tenant, req, clip_start_ns=d.get("clip_start_ns"))
+            return self._reply(200, _json_bytes({"series": [
+                {"labels": list(s.labels), "samples": list(map(float, s.samples))}
+                for s in series]}))
+        self._err(404, f"unknown internal path {path}")
 
     def _push(self, tenant: str) -> None:
         n = int(self.headers.get("Content-Length", 0))
@@ -163,9 +192,29 @@ class Handler(BaseHTTPRequestHandler):
             if path == "/api/overrides":
                 cur = self.app.overrides.user_configurable.get(tenant) or {}
                 return self._reply(200, _json_bytes({"limits": cur}))
+            if path.startswith("/internal/"):
+                return self._internal_get(tenant, path, q)
         except Exception as e:
             return self._err(500, str(e))
         self._err(404, f"unknown path {path}")
+
+    def _internal_get(self, tenant: str, path: str, q: dict) -> None:
+        from tempo_tpu.rpc import spans_to_json
+        if path == "/internal/ingester/trace":
+            spans = self.app.ingester.find_trace_by_id(
+                tenant, bytes.fromhex(q["tid"]))
+            return self._reply(200, _json_bytes(
+                {"spans": spans_to_json(spans) if spans else None}))
+        if path == "/internal/ingester/search":
+            res = self.app.ingester.search(
+                tenant, q.get("q", "{ }"), int(q.get("limit", 20)),
+                float(q.get("start", 0)), float(q.get("end", 0)))
+            return self._reply(200, _json_bytes(
+                {"traces": [md.to_json() for md in res]}))
+        if path == "/internal/ingester/tags":
+            return self._reply(200, _json_bytes(
+                {"scopes": self.app.ingester.tag_names(tenant)}))
+        self._err(404, f"unknown internal path {path}")
 
     def _trace_by_id(self, tenant: str, hexid: str) -> None:
         tid = bytes.fromhex(hexid)
@@ -287,10 +336,12 @@ class Handler(BaseHTTPRequestHandler):
 
 
 def serve(app, block: bool = True) -> ThreadingHTTPServer:
-    Handler.app = app
+    # per-server Handler subclass: multiple Apps can serve from one process
+    # (tests, scalable-single-binary) without sharing the class attribute
+    handler_cls = type("BoundHandler", (Handler,), {"app": app})
     srv = ThreadingHTTPServer(
         (app.cfg.server.http_listen_address, app.cfg.server.http_listen_port),
-        Handler)
+        handler_cls)
     if block:
         try:
             srv.serve_forever()

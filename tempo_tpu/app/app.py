@@ -40,7 +40,9 @@ TARGETS = {
     INGESTER: [OVERRIDES, STORE, INGESTER],
     GENERATOR: [OVERRIDES, GENERATOR],
     QUERIER: [OVERRIDES, STORE, QUERIER],
-    FRONTEND: [OVERRIDES, STORE, FRONTEND],
+    # the query tier: frontend embeds its querier (job dispatch is
+    # in-process; scale-out adds more query-tier processes)
+    FRONTEND: [OVERRIDES, STORE, QUERIER, FRONTEND],
     COMPACTOR: [OVERRIDES, STORE, COMPACTOR],
 }
 
@@ -138,23 +140,52 @@ class App:
                                    instance_id="generator-0", now=self.now)
         self._join_ring("generator", "generator-0")
 
+    def _peer_clients(self, kind: str):
+        """Remote peers from static config → (clients, populated ring)."""
+        from tempo_tpu.ring.ring import _instance_tokens
+        from tempo_tpu.rpc import RemoteGeneratorClient, RemoteIngesterClient
+
+        addrs = getattr(self.cfg.peers, kind)
+        cls = RemoteIngesterClient if kind == "ingesters" \
+            else RemoteGeneratorClient
+        clients = {iid: cls(url) for iid, url in addrs.items()}
+        ring = Ring(replication_factor=1 if kind == "generators"
+                    else self.cfg.distributor.rf,
+                    heartbeat_timeout_s=0, now=self.now)
+        for iid, url in addrs.items():
+            ring.register(InstanceDesc(id=iid, addr=url, state=ACTIVE,
+                                       tokens=_instance_tokens(iid, 128)))
+        return clients, ring
+
     def _init_distributor(self) -> None:
-        iring = Ring(kv=self.kv, key="ingester",
-                     replication_factor=self.cfg.distributor.rf, now=self.now)
-        gring = Ring(kv=self.kv, key="generator", replication_factor=1,
-                     now=self.now)
+        if self.cfg.peers.ingesters:
+            ing_clients, iring = self._peer_clients("ingesters")
+        else:
+            iring = Ring(kv=self.kv, key="ingester",
+                         replication_factor=self.cfg.distributor.rf,
+                         now=self.now)
+            ing_clients = {"ingester-0": self.ingester} if self.ingester else {}
+        if self.cfg.peers.generators:
+            gen_clients, gring = self._peer_clients("generators")
+        else:
+            gring = Ring(kv=self.kv, key="generator", replication_factor=1,
+                         now=self.now) if self.generator else None
+            gen_clients = ({"generator-0": self.generator}
+                           if self.generator else None)
         self.distributor = Distributor(
-            iring,
-            {"ingester-0": self.ingester} if self.ingester else {},
-            overrides=self.overrides,
-            generator_ring=gring if self.generator else None,
-            generator_clients={"generator-0": self.generator}
-            if self.generator else None,
+            iring, ing_clients, overrides=self.overrides,
+            generator_ring=gring, generator_clients=gen_clients,
             cfg=self.cfg.distributor, now=self.now)
-        if self.cfg.target == ALL:
+        if self.cfg.target == ALL and not self.cfg.peers.ingesters:
             self.distributor.cfg.rf = 1   # one in-process ingester
 
     def _init_querier(self) -> None:
+        if self.cfg.peers.ingesters:
+            clients, iring = self._peer_clients("ingesters")
+            self.querier = Querier(self.db, iring, clients,
+                                   overrides=self.overrides,
+                                   cfg=self.cfg.querier, now=self.now)
+            return
         iring = Ring(kv=self.kv, key="ingester", replication_factor=1,
                      now=self.now)
         self.querier = Querier(
@@ -165,11 +196,21 @@ class App:
             self.querier.cfg.rf = 1
 
     def _init_frontend(self) -> None:
+        gen_qr = self.generator.query_range if self.generator else None
+        if self.cfg.peers.generators:
+            clients, gring = self._peer_clients("generators")
+
+            def gen_qr(tenant, req, clip_start_ns=None,
+                       _clients=clients, _ring=gring):
+                out = []
+                for inst in _ring.healthy_instances():
+                    out.extend(_clients[inst.id].query_range(
+                        tenant, req, clip_start_ns=clip_start_ns))
+                return out
         self.frontend = Frontend(
             self.db, self.querier, cfg=self.cfg.frontend,
             overrides=self.overrides,
-            generator_query_range=(self.generator.query_range
-                                   if self.generator else None),
+            generator_query_range=gen_qr,
             now=self.now)
 
     def _join_ring(self, key: str, instance_id: str) -> None:
@@ -198,6 +239,8 @@ class App:
     def shutdown(self) -> None:
         self.ready = False
         self._stop.set()
+        if self.distributor:
+            self.distributor.forwarders.shutdown()  # drain queued tees
         if self.ingester:
             self.ingester.shutdown()
         if self.generator:

@@ -48,9 +48,20 @@ class StorageConfig:
 
 
 @dataclasses.dataclass
+class PeersConfig:
+    """Static peer addresses for microservice deployments: {id: base_url}.
+    The static-address stand-in for ring gossip discovery; in-process
+    objects are used when empty (single-binary)."""
+
+    ingesters: dict = dataclasses.field(default_factory=dict)
+    generators: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
 class Config:
     target: str = "all"
     multitenancy_enabled: bool = False
+    peers: PeersConfig = dataclasses.field(default_factory=PeersConfig)
     server: ServerConfig = dataclasses.field(default_factory=ServerConfig)
     storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
     distributor: DistributorConfig = dataclasses.field(default_factory=DistributorConfig)

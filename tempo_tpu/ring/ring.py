@@ -168,8 +168,15 @@ class Ring:
         """
         rf = rf or self.rf
         full = self._walk(token, rf)
+        if not full:
+            # an empty ring can never satisfy quorum — failing loudly beats
+            # a ReplicationSet of nobody that "succeeds" while dropping data
+            raise RuntimeError("ring is empty: no instances registered")
         healthy = [i for i in full if self.healthy(i)]
-        max_errors = rf - (rf // 2 + 1) - (len(full) - len(healthy))
+        # quorum over the ACTUAL replica count: a 1-instance ring under RF3
+        # must require that one write to succeed, not tolerate its failure
+        eff = min(rf, len(full))
+        max_errors = eff - (eff // 2 + 1) - (len(full) - len(healthy))
         if max_errors < 0:
             raise RuntimeError(
                 f"too many unhealthy instances ({len(full) - len(healthy)}/{len(full)})")

@@ -1,0 +1,140 @@
+"""Inter-service RPC: remote clients for the in-process seams.
+
+Analog of the reference's gRPC plane (`pkg/tempopb/tempo.proto` services
+Pusher / MetricsGenerator / Querier, carried by dskit server): every
+service seam in this framework is a small protocol (IngesterClient,
+GeneratorClient, IngesterQueryClient), satisfied in-process by the service
+objects and here by HTTP clients, so `-target` processes compose into a
+microservices deployment with a config change. Trace payloads ride the
+ingest-bus record encoding (`ingest/encoding.py` — varint-framed groups),
+not JSON, on the hot push path.
+
+Server side: `/internal/*` routes in `app/api.py` dispatch to the local
+service objects.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+from typing import Sequence
+
+from tempo_tpu.ingest.encoding import decode_push, encode_push
+
+
+def _check_single_record(records: list[bytes]) -> bytes:
+    # encode_push splits at max_record_bytes; for RPC we ship one body
+    return b"".join(records)
+
+
+class _BaseClient:
+    def __init__(self, base_url: str, timeout_s: float = 30.0) -> None:
+        self.base = base_url.rstrip("/")
+        self.timeout = timeout_s
+
+    def _post(self, path: str, body: bytes, tenant: str,
+              ctype: str = "application/x-tempo-push") -> dict:
+        req = urllib.request.Request(
+            self.base + path, data=body,
+            headers={"Content-Type": ctype, "X-Scope-OrgID": tenant})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read() or b"{}")
+
+    def _get(self, path: str, tenant: str, params: dict | None = None) -> dict:
+        url = self.base + path
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        req = urllib.request.Request(url, headers={"X-Scope-OrgID": tenant})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read() or b"{}")
+
+
+class RemoteIngesterClient(_BaseClient):
+    """IngesterClient + IngesterQueryClient over HTTP (`Pusher.PushBytesV2`
+    + `Querier` RPCs)."""
+
+    def push(self, tenant: str,
+             traces: Sequence[tuple[bytes, list[dict]]]) -> list[str | None]:
+        body = _check_single_record(encode_push(traces, max_record_bytes=1 << 62))
+        res = self._post("/internal/ingester/push", body, tenant)
+        return res.get("errors", [None] * len(traces))
+
+    def find_trace_by_id(self, tenant: str, trace_id: bytes) -> list[dict] | None:
+        res = self._get("/internal/ingester/trace", tenant,
+                        {"tid": trace_id.hex()})
+        spans = res.get("spans")
+        return _json_to_spans(spans) if spans else None
+
+    def search(self, tenant: str, query: str, limit: int = 20,
+               start_s: float = 0, end_s: float = 0):
+        from tempo_tpu.traceql.engine import TraceSearchMetadata
+
+        res = self._get("/internal/ingester/search", tenant,
+                        {"q": query, "limit": limit,
+                         "start": start_s, "end": end_s})
+        return [TraceSearchMetadata(
+            trace_id=t["traceID"],
+            root_service_name=t.get("rootServiceName", ""),
+            root_trace_name=t.get("rootTraceName", ""),
+            start_time_unix_nano=int(t.get("startTimeUnixNano", "0")),
+            duration_ms=t.get("durationMs", 0),
+            span_sets=t.get("spanSets", []))
+            for t in res.get("traces", [])]
+
+    def tag_names(self, tenant: str) -> dict[str, list[str]]:
+        return self._get("/internal/ingester/tags", tenant).get("scopes", {})
+
+
+class RemoteGeneratorClient(_BaseClient):
+    """GeneratorClient over HTTP (`MetricsGenerator.PushSpans`)."""
+
+    def push_spans(self, tenant: str, spans: Sequence[dict]) -> None:
+        groups: dict[bytes, list[dict]] = {}
+        for s in spans:
+            groups.setdefault(s.get("trace_id", b""), []).append(s)
+        body = _check_single_record(
+            encode_push(list(groups.items()), max_record_bytes=1 << 62))
+        self._post("/internal/generator/push", body, tenant)
+
+    def query_range(self, tenant: str, req, clip_start_ns: int | None = None):
+        from tempo_tpu.traceql.engine_metrics import TimeSeries
+        import numpy as np
+
+        res = self._post(
+            "/internal/generator/query_range",
+            json.dumps({"query": req.query, "start_ns": req.start_ns,
+                        "end_ns": req.end_ns, "step_ns": req.step_ns,
+                        "clip_start_ns": clip_start_ns}).encode(),
+            tenant, ctype="application/json")
+        return [TimeSeries(labels=tuple((k, v) for k, v in s["labels"]),
+                           samples=np.asarray(s["samples"], np.float64))
+                for s in res.get("series", [])]
+
+
+# -- payload helpers (server side uses these too) ---------------------------
+
+def spans_to_json(spans: list[dict]) -> list[dict]:
+    out = []
+    for s in spans:
+        d = dict(s)
+        for k in ("trace_id", "span_id", "parent_span_id"):
+            if isinstance(d.get(k), bytes):
+                d[k] = d[k].hex()
+        out.append(d)
+    return out
+
+
+def _json_to_spans(spans: list[dict]) -> list[dict]:
+    out = []
+    for s in spans:
+        d = dict(s)
+        for k in ("trace_id", "span_id", "parent_span_id"):
+            if isinstance(d.get(k), str):
+                d[k] = bytes.fromhex(d[k])
+        out.append(d)
+    return out
+
+
+def decode_push_body(body: bytes) -> list[tuple[bytes, list[dict]]]:
+    return list(decode_push(body))

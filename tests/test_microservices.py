@@ -1,0 +1,144 @@
+"""Microservices deployment: four processes' worth of Apps over HTTP RPC.
+
+The e2e shape of the reference's `integration/e2e/deployments/
+microservices_test.go`: distributor, ingester, metrics-generator, and
+query tier run as separate Apps (in-process servers here) wired by static
+peer addresses, sharing only the object-store backend.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+import urllib.request
+
+import pytest
+
+from tempo_tpu.app import App
+from tempo_tpu.app.api import serve
+from tempo_tpu.app.config import Config
+
+
+def _port() -> int:
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]; s.close()
+    return p
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    store = str(tmp_path / "store")
+    ports = {k: _port() for k in ("ing", "gen", "query", "dist")}
+    url = {k: f"http://127.0.0.1:{p}" for k, p in ports.items()}
+    apps, servers = {}, {}
+
+    def boot(name, cfg):
+        cfg.server.http_listen_port = ports[name]
+        app = App(cfg)
+        # per-tenant processor enablement: in a real deployment this is the
+        # shared runtime-config overrides file every process reads
+        app.overrides.set_tenant_patch("single-tenant", {
+            "generator": {"processors": ["span-metrics", "local-blocks"]}})
+        app.start_loops()
+        apps[name] = app
+        servers[name] = serve(app, block=False)
+
+    ing_cfg = Config(target="ingester")
+    ing_cfg.storage.backend = "local"
+    ing_cfg.storage.local_path = store
+    ing_cfg.storage.wal_path = str(tmp_path / "ing" / "wal")
+    ing_cfg.ingester.instance.trace_idle_s = 0.1
+    boot("ing", ing_cfg)
+
+    gen_cfg = Config(target="metrics-generator")
+    gen_cfg.storage.backend = "local"
+    gen_cfg.storage.local_path = store
+    gen_cfg.generator.localblocks.data_dir = str(tmp_path / "gen-lb")
+    boot("gen", gen_cfg)
+
+    q_cfg = Config(target="query-frontend")
+    q_cfg.storage.backend = "local"
+    q_cfg.storage.local_path = store
+    q_cfg.peers.ingesters = {"ing-1": url["ing"]}
+    q_cfg.peers.generators = {"gen-1": url["gen"]}
+    boot("query", q_cfg)
+
+    d_cfg = Config(target="distributor")
+    d_cfg.peers.ingesters = {"ing-1": url["ing"]}
+    d_cfg.peers.generators = {"gen-1": url["gen"]}
+    boot("dist", d_cfg)
+
+    yield apps, url
+    for s in servers.values():
+        s.shutdown()
+    for a in apps.values():
+        a.shutdown()
+
+
+def _post(url, body, ctype="application/json"):
+    req = urllib.request.Request(url, data=body,
+                                 headers={"Content-Type": ctype})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, json.loads(r.read() or b"{}")
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, json.loads(r.read() or b"{}")
+
+
+def test_microservices_write_read(cluster):
+    apps, url = cluster
+    t0 = int((time.time() - 5) * 1e9)
+    otlp = {"resourceSpans": [{
+        "resource": {"attributes": [
+            {"key": "service.name", "value": {"stringValue": "micro"}}]},
+        "scopeSpans": [{"spans": [{
+            "traceId": "ee" * 16, "spanId": "bb" * 8, "name": "ms-op",
+            "kind": 2, "startTimeUnixNano": str(t0),
+            "endTimeUnixNano": str(t0 + 40_000_000),
+            "status": {"code": 0}}]}]}]}
+    # write through the DISTRIBUTOR process
+    code, _ = _post(url["dist"] + "/v1/traces", json.dumps(otlp).encode())
+    assert code == 200
+    # the INGESTER process holds the live trace
+    assert apps["ing"].ingester.instance("single-tenant").live
+    # the GENERATOR process aggregated it
+    assert apps["gen"].generator.instance("single-tenant").spans_received == 1
+    # trace-by-id through the QUERY tier (remote ingester RPC)
+    code, tr = _get(url["query"] + f"/api/traces/{'ee' * 16}")
+    assert code == 200 and tr["spans"][0]["name"] == "ms-op"
+    # search through the QUERY tier
+    code, res = _get(url["query"] + "/api/search?q=" + urllib.parse.quote(
+        '{ resource.service.name = "micro" }'))
+    assert code == 200 and len(res["traces"]) == 1
+    # TraceQL metrics through the QUERY tier (remote generator RPC)
+    now = time.time()
+    code, qr = _get(url["query"] + "/api/metrics/query_range?q=" +
+                    urllib.parse.quote("{ } | count_over_time()") +
+                    f"&start={now - 300}&end={now}&step=300")
+    assert code == 200
+    total = sum(d["value"] for s in qr["series"]
+                for d in s.get("samples", []) if d["value"] == d["value"])
+    assert total == 1
+    # tags through the QUERY tier (remote ingester tag RPC)
+    code, tags = _get(url["query"] + "/api/search/tags")
+    assert code == 200
+
+
+def test_microservices_flush_to_shared_store(cluster):
+    apps, url = cluster
+    t0 = int((time.time() - 5) * 1e9)
+    otlp = {"resourceSpans": [{"scopeSpans": [{"spans": [{
+        "traceId": "dd" * 16, "spanId": "aa" * 8, "name": "flushed",
+        "startTimeUnixNano": str(t0),
+        "endTimeUnixNano": str(t0 + 10_000_000)}]}]}]}
+    _post(url["dist"] + "/v1/traces", json.dumps(otlp).encode())
+    # force the ingester to flush to the shared store
+    time.sleep(0.2)
+    apps["ing"].ingester.flush_all()
+    # query tier polls the store and finds the trace from the BACKEND
+    apps["query"].db.poll_now()
+    spans = apps["query"].db.find_trace_by_id("single-tenant", b"\xdd" * 16)
+    assert spans and spans[0]["name"] == "flushed"
