@@ -29,6 +29,23 @@ def _json_bytes(obj) -> bytes:
     return json.dumps(obj).encode()
 
 
+MAX_INFLATED_BODY = 64 << 20   # receiver message-size cap, like the
+                               # reference's receiver limits
+
+
+def _gunzip_capped(body: bytes, limit: int = MAX_INFLATED_BODY) -> bytes:
+    """Bounded streaming decompress: a gzip bomb hits the cap instead of
+    exhausting memory."""
+    import gzip
+    import io
+
+    with gzip.GzipFile(fileobj=io.BytesIO(body)) as f:
+        out = f.read(limit + 1)
+    if len(out) > limit:
+        raise ValueError(f"inflated body exceeds {limit} bytes")
+    return out
+
+
 class Handler(BaseHTTPRequestHandler):
     app = None  # set by serve()
 
@@ -111,15 +128,24 @@ class Handler(BaseHTTPRequestHandler):
     def _push(self, tenant: str) -> None:
         n = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(n)
+        if self.headers.get("Content-Encoding", "").lower() == "gzip":
+            try:
+                body = _gunzip_capped(body)
+            except Exception as e:
+                return self._err(400, f"bad gzip body: {e}")
         ctype = self.headers.get("Content-Type", "")
         from tempo_tpu.model.otlp import spans_from_otlp_json, spans_from_otlp_proto
-        if "json" in ctype:
-            spans = list(spans_from_otlp_json(json.loads(body)))
-        else:
-            from tempo_tpu import native
-            spans = native.spans_from_otlp_proto_native(body)
-            if spans is None:  # native layer unavailable
-                spans = list(spans_from_otlp_proto(body))
+        try:
+            if "json" in ctype:
+                spans = list(spans_from_otlp_json(json.loads(body)))
+            else:
+                from tempo_tpu import native
+                spans = native.spans_from_otlp_proto_native(body)
+                if spans is None:  # native layer unavailable
+                    spans = list(spans_from_otlp_proto(body))
+        except (ValueError, KeyError, TypeError) as e:
+            # malformed payload is the client's fault (OTLP spec: 400)
+            return self._err(400, f"malformed otlp payload: {e}")
         from tempo_tpu.distributor.distributor import RateLimited
         try:
             errs = self.app.distributor.push_spans(tenant, spans)
@@ -133,8 +159,16 @@ class Handler(BaseHTTPRequestHandler):
     def _push_zipkin(self, tenant: str) -> None:
         n = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(n)
+        if self.headers.get("Content-Encoding", "").lower() == "gzip":
+            try:
+                body = _gunzip_capped(body)
+            except Exception as e:
+                return self._err(400, f"bad gzip body: {e}")
         from tempo_tpu.model.zipkin import spans_from_zipkin_json
-        spans = list(spans_from_zipkin_json(json.loads(body)))
+        try:
+            spans = list(spans_from_zipkin_json(json.loads(body)))
+        except (ValueError, KeyError, TypeError) as e:
+            return self._err(400, f"malformed zipkin payload: {e}")
         from tempo_tpu.distributor.distributor import RateLimited
         try:
             errs = self.app.distributor.push_spans(tenant, spans)
@@ -214,6 +248,10 @@ class Handler(BaseHTTPRequestHandler):
         if path == "/internal/ingester/tags":
             return self._reply(200, _json_bytes(
                 {"scopes": self.app.ingester.tag_names(tenant)}))
+        if path == "/internal/ingester/tag_values":
+            return self._reply(200, _json_bytes(
+                {"tagValues": self.app.ingester.tag_values(
+                    tenant, q["name"], int(q.get("limit", 1000)))}))
         self._err(404, f"unknown internal path {path}")
 
     def _trace_by_id(self, tenant: str, hexid: str) -> None:
@@ -247,12 +285,16 @@ class Handler(BaseHTTPRequestHandler):
             "scopes": [{"name": k, "tags": v} for k, v in names.items()]}))
 
     def _tag_values(self, tenant: str, name: str, q: dict) -> None:
-        from tempo_tpu.block.fetch import scan_views
-        from tempo_tpu.traceql.engine import execute_tag_values, tag_values_request
-        req = tag_values_request(name)
-        views = (v for m in self.app.db.blocks(tenant)
-                 for v in scan_views(self.app.db.backend_block(m), req))
-        vals = execute_tag_values(name, views)
+        # routed through frontend (SLO accounting) or querier directly on
+        # frontend-less targets, so ingester recent data is included like
+        # /api/search/tags (ADVICE r1)
+        limit = int(q.get("limit", 1000))
+        if self.app.frontend is not None:
+            vals = self.app.frontend.tag_values(tenant, name, limit)
+        elif self.app.querier is not None:
+            vals = self.app.querier.tag_values(tenant, name, limit)
+        else:
+            return self._err(400, "no query module on this target")
         self._reply(200, _json_bytes({"tagValues": vals}))
 
     def _query_range(self, tenant: str, q: dict) -> None:
@@ -271,6 +313,10 @@ class Handler(BaseHTTPRequestHandler):
             "series": [s.to_json(ts_ms) for s in series]}))
 
     def _metrics_summary(self, tenant: str, q: dict) -> None:
+        if self.app.generator is None:
+            return self._err(
+                400, "metrics summary requires a generator module "
+                     f"(target={self.app.cfg.target} has none)")
         group_by = [g for g in q.get("groupBy", "").split(",") if g]
         res = self.app.generator.get_metrics(tenant, q.get("q", "{ }"),
                                              group_by)

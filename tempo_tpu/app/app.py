@@ -66,6 +66,9 @@ class App:
         self.generator: Generator | None = None
         self.querier: Querier | None = None
         self.frontend: Frontend | None = None
+        self.grpc_server = None
+        self.grpc_port: int = 0
+        self.frontend_worker = None
         self._lifecyclers: list[Lifecycler] = []
         # warm the native layer at startup so the first proto push never
         # pays the g++ compile inside a request handler
@@ -141,14 +144,26 @@ class App:
         self._join_ring("generator", "generator-0")
 
     def _peer_clients(self, kind: str):
-        """Remote peers from static config → (clients, populated ring)."""
+        """Remote peers from static config → (clients, populated ring).
+        The URL scheme selects the transport: http:// → the HTTP RPC
+        clients, grpc:// → the gRPC plane."""
         from tempo_tpu.ring.ring import _instance_tokens
         from tempo_tpu.rpc import RemoteGeneratorClient, RemoteIngesterClient
 
         addrs = getattr(self.cfg.peers, kind)
-        cls = RemoteIngesterClient if kind == "ingesters" \
-            else RemoteGeneratorClient
-        clients = {iid: cls(url) for iid, url in addrs.items()}
+
+        def make(url: str):
+            if url.startswith("grpc://"):
+                from tempo_tpu.grpcplane import (
+                    GrpcGeneratorClient, GrpcIngesterClient)
+                cls = GrpcIngesterClient if kind == "ingesters" \
+                    else GrpcGeneratorClient
+            else:
+                cls = RemoteIngesterClient if kind == "ingesters" \
+                    else RemoteGeneratorClient
+            return cls(url)
+
+        clients = {iid: make(url) for iid, url in addrs.items()}
         ring = Ring(replication_factor=1 if kind == "generators"
                     else self.cfg.distributor.rf,
                     heartbeat_timeout_s=0, now=self.now)
@@ -221,6 +236,18 @@ class App:
 
     def start_loops(self) -> None:
         """Background loops for the enabled modules (`App.Run`)."""
+        if self.cfg.server.grpc_listen_port:
+            from tempo_tpu.grpcplane import build_grpc_server
+            self.grpc_server, self.grpc_port = build_grpc_server(
+                self, f"{self.cfg.server.grpc_listen_address}:"
+                      f"{self.cfg.server.grpc_listen_port}")
+        if self.querier and self.cfg.querier_worker.frontend_address:
+            from tempo_tpu.grpcplane import FrontendWorker
+            self.frontend_worker = FrontendWorker(
+                self.cfg.querier_worker.frontend_address, self.querier,
+                worker_id=f"querier-{id(self) & 0xffff:x}",
+                parallelism=self.cfg.querier_worker.parallelism)
+            self.frontend_worker.start()
         if self.ingester:
             self.ingester.start()
         if self.generator:
@@ -239,6 +266,10 @@ class App:
     def shutdown(self) -> None:
         self.ready = False
         self._stop.set()
+        if self.frontend_worker:
+            self.frontend_worker.shutdown()
+        if self.grpc_server:
+            self.grpc_server.stop(grace=1).wait(2)
         if self.distributor:
             self.distributor.forwarders.shutdown()  # drain queued tees
         if self.ingester:

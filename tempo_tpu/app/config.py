@@ -30,7 +30,18 @@ from tempo_tpu.querier.querier import QuerierConfig
 class ServerConfig:
     http_listen_port: int = 3200
     http_listen_address: str = "127.0.0.1"
+    grpc_listen_port: int = 0           # 0 = gRPC disabled on this process
+    grpc_listen_address: str = "127.0.0.1"
     graceful_shutdown_timeout_s: float = 5.0
+
+
+@dataclasses.dataclass
+class WorkerConfig:
+    """Querier worker-pull config (`modules/querier/worker/worker.go`):
+    a standalone querier dials the frontend and pulls job batches."""
+
+    frontend_address: str = ""          # "grpc://host:port"; empty = no worker
+    parallelism: int = 2
 
 
 @dataclasses.dataclass
@@ -69,6 +80,7 @@ class Config:
     generator: GeneratorConfig = dataclasses.field(default_factory=GeneratorConfig)
     frontend: FrontendConfig = dataclasses.field(default_factory=FrontendConfig)
     querier: QuerierConfig = dataclasses.field(default_factory=QuerierConfig)
+    querier_worker: WorkerConfig = dataclasses.field(default_factory=WorkerConfig)
     compactor: CompactorConfig = dataclasses.field(default_factory=CompactorConfig)
     overrides_defaults: Limits = dataclasses.field(default_factory=Limits)
     per_tenant_override_config: str = ""   # runtime-config file path

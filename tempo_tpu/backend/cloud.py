@@ -1,60 +1,35 @@
-"""Cloud object-store backends: S3 / GCS / Azure.
+"""Cloud object-store backend factory: S3 / GCS / Azure.
 
-The reference ships full impls (`tempodb/backend/{s3,gcs,azure}/`) against
-cloud SDKs plus hedged HTTP requests (`s3/s3.go:129`). This environment has
-no cloud SDKs and zero egress, so these are config-compatible gated adapters:
-construction succeeds only if the SDK import works, otherwise a clear error
-points at the `local`/`mem` backends. The interface surface (RawReader/
-RawWriter) is identical, so swapping backends is a config change, as in the
-reference.
+The reference ships full impls (`tempodb/backend/{s3,gcs,azure}/`). Here:
+
+- **s3**: a real, SDK-free SigV4 client (`backend/s3.py`) that works
+  against any S3-compatible endpoint (AWS, MinIO, Ceph RGW, the test mock).
+- **gcs**: served through the same client via GCS's S3-interoperability XML
+  API (`storage.googleapis.com` + HMAC keys) — the supported SDK-free path.
+- **azure**: gated adapter; Azure Blob's SharedKey auth has no
+  S3-compatible mode and no SDK exists in this environment, so construction
+  raises with a clear pointer at the working backends.
 """
 
 from __future__ import annotations
 
 
-
-
-class _GatedCloudBackend:
-    sdk_module: str = ""
-    scheme: str = ""
+class AzureBackend:
+    """`tempodb/backend/azure/` analog — gated: requires the azure SDK,
+    which this environment does not ship."""
 
     def __init__(self, **config: object) -> None:
         try:
-            __import__(self.sdk_module)
+            __import__("azure.storage.blob")
         except ImportError as e:
             raise RuntimeError(
-                f"{self.scheme} backend requires the '{self.sdk_module}' SDK, "
-                f"which is not available in this environment; use the 'local' "
-                f"backend (same RawReader/RawWriter interface) instead"
+                "azure backend requires the 'azure.storage.blob' SDK, which "
+                "is not available in this environment; use the 's3' backend "
+                "(any S3-compatible endpoint) or 'local' instead"
             ) from e
-        self.config = config
         raise NotImplementedError(
-            f"{self.scheme} backend: SDK present but adapter not wired; "
-            f"see tempo_tpu/backend/local.py for the reference implementation shape"
-        )
-
-
-class S3Backend(_GatedCloudBackend):
-    """`tempodb/backend/s3/s3.go` analog (hedged requests via
-    pkg/hedgedmetrics are a no-op here). Implements RawReader/RawWriter
-    when wired."""
-
-    sdk_module = "boto3"
-    scheme = "s3"
-
-
-class GCSBackend(_GatedCloudBackend):
-    """`tempodb/backend/gcs/` analog."""
-
-    sdk_module = "google.cloud.storage"
-    scheme = "gcs"
-
-
-class AzureBackend(_GatedCloudBackend):
-    """`tempodb/backend/azure/` analog."""
-
-    sdk_module = "azure.storage.blob"
-    scheme = "azure"
+            "azure backend: SDK present but adapter not wired; "
+            "see tempo_tpu/backend/s3.py for the implementation shape")
 
 
 def open_backend(kind: str, **config: object):
@@ -68,9 +43,14 @@ def open_backend(kind: str, **config: object):
 
         return MemBackend()
     if kind == "s3":
+        from tempo_tpu.backend.s3 import S3Backend
+
         return S3Backend(**config)
     if kind == "gcs":
-        return GCSBackend(**config)
+        from tempo_tpu.backend.s3 import S3Backend
+
+        config.setdefault("endpoint", "storage.googleapis.com")
+        return S3Backend(**config)
     if kind == "azure":
         return AzureBackend(**config)
     raise ValueError(f"unknown backend {kind!r} (want local|mem|s3|gcs|azure)")

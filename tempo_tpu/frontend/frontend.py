@@ -54,16 +54,36 @@ class FrontendConfig:
 
 
 class _Job:
-    __slots__ = ("job", "fn", "result", "error", "event")
+    __slots__ = ("job", "fn", "spec", "result", "error", "event", "_lock",
+                 "_claimed")
 
-    def __init__(self, job: SearchJob, fn: Callable[[SearchJob], Any]):
+    def __init__(self, job: SearchJob, fn: Callable[[SearchJob], Any],
+                 spec: dict | None = None):
         self.job = job
         self.fn = fn
+        self.spec = spec      # JSON-safe descriptor for remote workers
         self.result: Any = None
         self.error: Exception | None = None
         self.event = threading.Event()
+        self._lock = threading.Lock()
+        self._claimed = False
+
+    def try_claim(self) -> bool:
+        """Exactly-once execution claim: local workers, remote worker
+        streams, and the issuer's inline fallback race for the same queued
+        job; whoever claims it runs it, everyone else skips."""
+        with self._lock:
+            if self._claimed:
+                return False
+            self._claimed = True
+            return True
 
     def run(self) -> None:
+        if not self.try_claim():
+            return
+        self.run_claimed()
+
+    def run_claimed(self) -> None:
         try:
             self.result = self.fn(self.job)
         except Exception as e:  # combiner decides whether partials suffice
@@ -86,7 +106,21 @@ class Frontend:
         self.queue = RequestQueue(self.cfg.max_outstanding_per_tenant)
         self.slos = SLORecorder(self.cfg.slo)
         self._workers: list[threading.Thread] = []
+        self._remote_lock = threading.Lock()
+        self._remote_workers = 0  # connected gRPC worker-pull streams
         self._stop = threading.Event()
+
+    @property
+    def remote_workers(self) -> int:
+        return self._remote_workers
+
+    def remote_worker_attached(self) -> None:
+        with self._remote_lock:
+            self._remote_workers += 1
+
+    def remote_worker_detached(self) -> None:
+        with self._remote_lock:
+            self._remote_workers -= 1
 
     # -- worker pool (querier pull model) ----------------------------------
 
@@ -110,16 +144,17 @@ class Frontend:
 
     def _run_jobs(self, tenant: str, jobs: Sequence[SearchJob],
                   fn: Callable[[SearchJob], Any],
-                  on_result: Callable[[Any], bool]) -> int:
+                  on_result: Callable[[Any], bool],
+                  spec_fn: Callable[[SearchJob], dict] | None = None) -> int:
         """Dispatch jobs; fold results via on_result (return False = early
         exit, like streaming combiners cancelling remaining work). Raises
         the first job error — a failed sub-query fails the whole query, as
         partial silent results are worse than an error. Keeps at most
         `concurrent_jobs` in flight so wide queries never trip the
         per-tenant outstanding cap. Returns bytes processed (SLO)."""
-        wrapped = [_Job(j, fn) for j in jobs]
+        wrapped = [_Job(j, fn, spec_fn(j) if spec_fn else None) for j in jobs]
         nbytes = 0
-        if not self._workers:
+        if not self._workers and not self.remote_workers:
             for wj in wrapped:          # inline single-binary path
                 wj.run()
                 if wj.error is not None:
@@ -136,6 +171,11 @@ class Frontend:
             while not wj.event.wait(timeout=0.5):
                 if self._stop.is_set():
                     raise RuntimeError("frontend shutting down")
+                if not self._workers and not self.remote_workers \
+                        and wj.try_claim():
+                    # every worker disconnected with this job still queued:
+                    # run it inline rather than hanging the query forever
+                    wj.run_claimed()
             if i + window < len(wrapped):
                 self.queue.enqueue(tenant, wrapped[i + window])
             if wj.error is not None:
@@ -148,8 +188,12 @@ class Frontend:
     # -- endpoints ---------------------------------------------------------
 
     def search(self, tenant: str, query: str, *, limit: int = 20,
-               start_s: float | None = None, end_s: float | None = None
+               start_s: float | None = None, end_s: float | None = None,
+               on_partial: Callable[[list], None] | None = None
                ) -> list:
+        """on_partial (optional) receives the combiner's current results
+        after each fold — the hook the streaming gRPC endpoint uses to
+        emit diff responses (`combiner/search.go`)."""
         t0 = self.now()
         end_s = end_s if end_s is not None else self.now()
         start_s = start_s if start_s is not None else end_s - 3600.0
@@ -162,6 +206,8 @@ class Frontend:
             for md in self.querier.search_recent(tenant, query, limit,
                                                  *ing_win):
                 combiner.add(md)
+            if on_partial is not None:
+                on_partial(combiner.results())
         if be_win is not None and not combiner.exhausted():
             metas = self.db.blocks(tenant, be_win[0], be_win[1])
             jobs = backend_search_jobs(tenant, metas, be_win[0], be_win[1],
@@ -170,6 +216,8 @@ class Frontend:
             def fold(res) -> bool:
                 for md in res:
                     combiner.add(md)
+                if on_partial is not None:
+                    on_partial(combiner.results())
                 return not combiner.exhausted()
 
             nbytes += self._run_jobs(
@@ -177,7 +225,12 @@ class Frontend:
                 lambda j: self.querier.search_block(
                     tenant, query, j.meta, j.row_groups, limit,
                     j.start_s, j.end_s),
-                fold)
+                fold,
+                spec_fn=lambda j: {
+                    "kind": "search_block", "tenant": tenant,
+                    "query": query, "meta": j.meta.to_json(),
+                    "row_groups": list(j.row_groups), "limit": limit,
+                    "start_s": j.start_s, "end_s": j.end_s})
         self.slos.record("search", tenant, self.now() - t0, nbytes)
         return combiner.results()
 
@@ -235,13 +288,42 @@ class Frontend:
                 lambda j: self.querier.query_range_block(
                     tenant, req, j.meta, j.row_groups,
                     clip_end_ns=cutoff_ns),
-                fold)
+                fold,
+                spec_fn=lambda j: {
+                    "kind": "query_range_block", "tenant": tenant,
+                    "query": query, "start_ns": req.start_ns,
+                    "end_ns": req.end_ns, "step_ns": req.step_ns,
+                    "meta": j.meta.to_json(),
+                    "row_groups": list(j.row_groups),
+                    "clip_end_ns": cutoff_ns})
         self.slos.record("metrics", tenant, self.now() - t0, nbytes)
         return comb.final(req)
+
+    def decode_job_result(self, spec: dict, result):
+        """Decode a remote worker's JSON job result back into the objects
+        the fold expects (the inverse of `execute_job_spec`)."""
+        import numpy as np
+
+        from tempo_tpu.traceql.engine import TraceSearchMetadata
+
+        if spec["kind"] == "search_block":
+            return [TraceSearchMetadata.from_json(t) for t in (result or [])]
+        if spec["kind"] == "query_range_block":
+            return [TimeSeries(
+                labels=tuple((k, v) for k, v in s["labels"]),
+                samples=np.asarray(s["samples"], np.float64))
+                for s in (result or [])]
+        raise ValueError(f"unknown job kind {spec['kind']!r}")
 
     def tag_names(self, tenant: str) -> dict[str, list[str]]:
         t0 = self.now()
         out = self.querier.tag_names(tenant)
+        self.slos.record("metadata", tenant, self.now() - t0, 0)
+        return out
+
+    def tag_values(self, tenant: str, name: str, limit: int = 1000) -> list[dict]:
+        t0 = self.now()
+        out = self.querier.tag_values(tenant, name, limit)
         self.slos.record("metadata", tenant, self.now() - t0, 0)
         return out
 

@@ -1,0 +1,335 @@
+"""gRPC server: generic method handlers bound to an App's modules.
+
+Services registered (mirroring `pkg/tempopb/tempo.proto:9-44` and the OTLP
+receiver factory `modules/distributor/receiver/shim.go:165-171`):
+
+- ``opentelemetry.proto.collector.trace.v1.TraceService/Export`` — the real
+  OTLP/gRPC protobuf, decoded by the native C++ scanner (fallback: the
+  Python wire codec). Stock OTel SDKs exporting OTLP/gRPC land here.
+- ``tempopb.Pusher/PushBytesV2`` — distributor→ingester push (varint-framed
+  span groups, the ingest-bus record encoding).
+- ``tempopb.MetricsGenerator/{PushSpans,QueryRange,GetMetrics}``.
+- ``tempopb.Querier/{FindTraceByID,SearchRecent,SearchTags,SearchTagValues}``
+  — the ingester-side query surface the querier fans out to.
+- ``tempopb.StreamingQuerier/Search`` — server-streaming search with diff
+  responses (`tempo.proto:30-38`, `combiner/search.go` diff combiner).
+- ``tempopb.Frontend/Process`` — the worker-pull job stream: remote queriers
+  dial the frontend and pull job batches (`v1/frontend.go:204-293`,
+  `worker/frontend_processor.go:69-195`).
+
+Tenant rides the ``x-scope-orgid`` metadata key, as in the reference's
+dskit user injection.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent import futures
+
+import grpc
+
+FAKE_TENANT = "single-tenant"
+
+OTLP_EXPORT = "/opentelemetry.proto.collector.trace.v1.TraceService/Export"
+
+
+def _ident(b):
+    return b
+
+
+def _tenant(context, multitenancy: bool) -> str:
+    md = dict(context.invocation_metadata() or ())
+    t = md.get("x-scope-orgid", "")
+    if not t:
+        if multitenancy:
+            context.abort(grpc.StatusCode.UNAUTHENTICATED, "no org id")
+        return FAKE_TENANT
+    return t
+
+
+def _jload(b: bytes) -> dict:
+    return json.loads(b or b"{}")
+
+
+def _jdump(obj) -> bytes:
+    return json.dumps(obj).encode()
+
+
+class _Services:
+    """All unary/stream handlers, bound to one App."""
+
+    def __init__(self, app) -> None:
+        self.app = app
+
+    # -- OTLP TraceService --------------------------------------------------
+
+    def otlp_export(self, request: bytes, context) -> bytes:
+        tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
+        from tempo_tpu import native
+        from tempo_tpu.model.otlp import spans_from_otlp_proto
+
+        try:
+            spans = native.spans_from_otlp_proto_native(request)
+            if spans is None:
+                spans = list(spans_from_otlp_proto(request))
+        except (ValueError, KeyError, TypeError) as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"malformed otlp payload: {e}")
+        from tempo_tpu.distributor.distributor import RateLimited
+
+        try:
+            self.app.distributor.push_spans(tenant, spans)
+        except RateLimited as e:
+            # the reference translates rate limits to ResourceExhausted with
+            # RetryInfo so SDK exporters back off (shim.go RetryableError)
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        return b""   # empty ExportTraceServiceResponse = full success
+
+    # -- Pusher (ingester) --------------------------------------------------
+
+    def push_bytes_v2(self, request: bytes, context) -> bytes:
+        tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
+        from tempo_tpu.rpc import decode_push_body
+
+        errs = self.app.ingester.push(tenant, decode_push_body(request))
+        return _jdump({"errors": errs})
+
+    # -- MetricsGenerator ---------------------------------------------------
+
+    def generator_push_spans(self, request: bytes, context) -> bytes:
+        tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
+        from tempo_tpu.rpc import decode_push_body
+
+        spans = [s for _tid, group in decode_push_body(request)
+                 for s in group]
+        self.app.generator.push_spans(tenant, spans)
+        return b"{}"
+
+    def generator_query_range(self, request: bytes, context) -> bytes:
+        tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
+        from tempo_tpu.traceql.engine_metrics import QueryRangeRequest
+
+        d = _jload(request)
+        req = QueryRangeRequest(query=d["query"], start_ns=d["start_ns"],
+                                end_ns=d["end_ns"], step_ns=d["step_ns"])
+        series = self.app.generator.query_range(
+            tenant, req, clip_start_ns=d.get("clip_start_ns"))
+        return _jdump({"series": [
+            {"labels": list(s.labels), "samples": list(map(float, s.samples))}
+            for s in series]})
+
+    def generator_get_metrics(self, request: bytes, context) -> bytes:
+        tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
+        d = _jload(request)
+        res = self.app.generator.get_metrics(
+            tenant, d.get("query", "{ }"), d.get("group_by", []))
+        return _jdump({"summaries": [s.to_json() for s in res.results()],
+                       "estimated": res.estimated})
+
+    # -- Querier (ingester-side query surface) ------------------------------
+
+    def find_trace_by_id(self, request: bytes, context) -> bytes:
+        tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
+        from tempo_tpu.rpc import spans_to_json
+
+        d = _jload(request)
+        spans = self.app.ingester.find_trace_by_id(
+            tenant, bytes.fromhex(d["tid"]))
+        return _jdump({"spans": spans_to_json(spans) if spans else None})
+
+    def search_recent(self, request: bytes, context) -> bytes:
+        tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
+        d = _jload(request)
+        res = self.app.ingester.search(
+            tenant, d.get("q", "{ }"), int(d.get("limit", 20)),
+            float(d.get("start", 0)), float(d.get("end", 0)))
+        return _jdump({"traces": [md.to_json() for md in res]})
+
+    def search_tags(self, request: bytes, context) -> bytes:
+        tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
+        return _jdump({"scopes": self.app.ingester.tag_names(tenant)})
+
+    def search_tag_values(self, request: bytes, context) -> bytes:
+        tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
+        d = _jload(request)
+        return _jdump({"tagValues": self.app.ingester.tag_values(
+            tenant, d["name"], int(d.get("limit", 1000)))})
+
+    # -- StreamingQuerier ---------------------------------------------------
+
+    def streaming_search(self, request: bytes, context):
+        """Server-streaming search: partial diff responses while sub-queries
+        complete, then the final message (`combiner/search.go` diffs)."""
+        tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
+        d = _jload(request)
+        import queue as _q
+
+        diffs: _q.Queue = _q.Queue()
+        sent: set[str] = set()
+
+        def on_partial(results) -> None:
+            fresh = [md for md in results if md.trace_id not in sent]
+            if fresh:
+                sent.update(md.trace_id for md in fresh)
+                diffs.put(fresh)
+
+        out: dict = {}
+
+        def run() -> None:
+            try:
+                out["res"] = self.app.frontend.search(
+                    tenant, d.get("q", "{ }"),
+                    limit=int(d.get("limit", 20)),
+                    start_s=float(d["start"]) if "start" in d else None,
+                    end_s=float(d["end"]) if "end" in d else None,
+                    on_partial=on_partial)
+            except Exception as e:  # surfaced as the final stream message
+                out["err"] = e
+            diffs.put(None)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        while True:
+            batch = diffs.get()
+            if batch is None:
+                break
+            yield _jdump({"traces": [md.to_json() for md in batch],
+                          "final": False})
+        t.join()
+        if "err" in out:
+            context.abort(grpc.StatusCode.INTERNAL, str(out["err"]))
+        res = out.get("res", [])
+        yield _jdump({"traces": [md.to_json() for md in res], "final": True,
+                      "metrics": {"inspectedTraces": len(res)}})
+
+    # -- Frontend worker-pull dispatch --------------------------------------
+
+    def frontend_process(self, request_iterator, context):
+        """One connected querier worker: stream job batches out, fold result
+        messages back into the pending jobs. The pull direction matches the
+        reference (querier dials frontend), so queriers scale out with zero
+        frontend-side discovery."""
+        fe = self.app.frontend
+        pending: dict[int, object] = {}
+        plock = threading.Condition()
+        next_id = [0]
+        done = threading.Event()
+
+        def read_results() -> None:
+            try:
+                for msg in request_iterator:
+                    m = _jload(msg)
+                    if m.get("type") == "hello":
+                        continue
+                    with plock:
+                        wj = pending.pop(int(m["job_id"]), None)
+                        plock.notify_all()
+                    if wj is None:
+                        continue
+                    if m["type"] == "result":
+                        wj.result = fe.decode_job_result(
+                            wj.spec, m.get("result"))
+                    else:
+                        wj.error = RuntimeError(m.get("error", "worker error"))
+                    wj.event.set()
+            except Exception:
+                pass
+            finally:
+                done.set()
+                with plock:
+                    plock.notify_all()
+
+        reader = threading.Thread(target=read_results, daemon=True)
+        reader.start()
+        fe.remote_worker_attached()
+        try:
+            while context.is_active() and not done.is_set():
+                batch = fe.queue.dequeue_batch(fe.cfg.max_batch_size,
+                                               timeout_s=0.2)
+                jobs = []
+                with plock:
+                    for wj in batch:
+                        if wj.spec is None:     # not remotable: run local
+                            wj.run()
+                            continue
+                        if not wj.try_claim():  # issuer already ran it
+                            continue
+                        jid = next_id[0]
+                        next_id[0] += 1
+                        pending[jid] = wj
+                        jobs.append({"job_id": jid, "spec": wj.spec})
+                if jobs:
+                    yield _jdump({"type": "jobs", "jobs": jobs})
+                    # one batch in flight per worker stream: wait for this
+                    # batch's results before pulling more so concurrent
+                    # workers share the queue (the reference's
+                    # request-response Process loop has the same effect)
+                    with plock:
+                        while pending and not done.is_set():
+                            plock.wait(timeout=0.2)
+                            if not context.is_active():
+                                break
+        finally:
+            fe.remote_worker_detached()
+            # worker went away: fail outstanding jobs fast so the query
+            # retries/errors instead of hanging (frontend cancels on
+            # disconnect in the reference too)
+            with plock:
+                for wj in pending.values():
+                    wj.error = RuntimeError("querier worker disconnected")
+                    wj.event.set()
+                pending.clear()
+
+
+def build_grpc_server(app, address: str = "127.0.0.1:0",
+                      max_workers: int = 16) -> tuple[grpc.Server, int]:
+    """Create + start a grpc server for the App's enabled modules.
+
+    Returns (server, bound_port). Only services whose backing module exists
+    on this target are registered — a `-target=ingester` process serves
+    Pusher + Querier, a frontend serves StreamingQuerier + Frontend, etc.
+    """
+    svc = _Services(app)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+
+    def unary(fn):
+        return grpc.unary_unary_rpc_method_handler(
+            fn, request_deserializer=_ident, response_serializer=_ident)
+
+    def sstream(fn):
+        return grpc.unary_stream_rpc_method_handler(
+            fn, request_deserializer=_ident, response_serializer=_ident)
+
+    def bidi(fn):
+        return grpc.stream_stream_rpc_method_handler(
+            fn, request_deserializer=_ident, response_serializer=_ident)
+
+    if app.distributor is not None:
+        server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
+            "opentelemetry.proto.collector.trace.v1.TraceService",
+            {"Export": unary(svc.otlp_export)}),))
+    if app.ingester is not None:
+        server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
+            "tempopb.Pusher", {"PushBytesV2": unary(svc.push_bytes_v2)}),))
+        server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
+            "tempopb.Querier",
+            {"FindTraceByID": unary(svc.find_trace_by_id),
+             "SearchRecent": unary(svc.search_recent),
+             "SearchTags": unary(svc.search_tags),
+             "SearchTagValues": unary(svc.search_tag_values)}),))
+    if app.generator is not None:
+        server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
+            "tempopb.MetricsGenerator",
+            {"PushSpans": unary(svc.generator_push_spans),
+             "QueryRange": unary(svc.generator_query_range),
+             "GetMetrics": unary(svc.generator_get_metrics)}),))
+    if app.frontend is not None:
+        server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
+            "tempopb.StreamingQuerier",
+            {"Search": sstream(svc.streaming_search)}),))
+        server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
+            "tempopb.Frontend", {"Process": bidi(svc.frontend_process)}),))
+    port = server.add_insecure_port(address)
+    server.start()
+    return server, port

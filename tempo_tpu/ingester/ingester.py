@@ -228,6 +228,29 @@ class Ingester:
                 out.setdefault(scope, set()).update(names)
         return {k: sorted(v) for k, v in out.items()}
 
+    def tag_values(self, tenant: str, name: str, limit: int = 1000) -> list[dict]:
+        """Distinct values of one attribute over live+WAL data and local
+        complete blocks (the ingester leg of `ExecuteTagValues`)."""
+        from tempo_tpu.block.fetch import scan_views
+        from tempo_tpu.traceql.engine import execute_tag_values, tag_values_request
+        from tempo_tpu.traceql.memview import view_from_traces
+
+        with self.lock:
+            if tenant not in self.instances:
+                return []
+        inst = self.instance(tenant)
+        req = tag_values_request(name)
+
+        def views():
+            traces = inst.all_recent_traces()
+            if traces:
+                v = view_from_traces(traces)
+                yield v, np.arange(v.n)
+            for b in inst.complete_blocks():
+                yield from scan_views(b, req)
+
+        return execute_tag_values(name, views(), limit=limit)
+
     # -- replay ------------------------------------------------------------
 
     def replay(self) -> None:

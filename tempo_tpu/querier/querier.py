@@ -147,3 +147,24 @@ class Querier:
                 used += sum(len(n) for n in fresh)
                 out[scope] |= fresh
         return {k: sorted(v) for k, v in out.items() if k in scopes or not scopes}
+
+    def tag_values(self, tenant: str, name: str, limit: int = 1000) -> list[dict]:
+        """Autocomplete values: ingester recent data + backend block scans,
+        deduped (`ExecuteTagValues` fan-out, querier side)."""
+        from tempo_tpu.block.fetch import scan_views
+        from tempo_tpu.traceql.engine import execute_tag_values, tag_values_request
+
+        seen: dict[str, dict] = {}
+        if self.ring is not None:
+            for inst in self.ring.healthy_instances():
+                client = self.clients.get(inst.id)
+                if client is None or not hasattr(client, "tag_values"):
+                    continue
+                for v in client.tag_values(tenant, name, limit):
+                    seen.setdefault(v["value"], v)
+        req = tag_values_request(name)
+        views = (v for m in self.db.blocks(tenant)
+                 for v in scan_views(self.db.backend_block(m), req))
+        for v in execute_tag_values(name, views, limit=limit):
+            seen.setdefault(v["value"], v)
+        return list(seen.values())[:limit]

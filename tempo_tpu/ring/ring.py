@@ -219,7 +219,14 @@ class Ring:
         seed = _hash_str(tenant)
         rng = np.random.default_rng(seed)
         picked: set[str] = set()
-        while len(picked) < size:
+        # _walk only returns token-owning instances: cap the target at that
+        # count (a zero-token registrant would otherwise never be picked and
+        # the loop would spin forever) and bound iterations as a backstop
+        owners = {i.id for i in self._instances.values() if len(i.tokens)}
+        target = min(size, len(owners))
+        for _ in range(64 * max(target, 1)):
+            if len(picked) >= target:
+                break
             tok = int(rng.integers(0, 2**32))
             for inst in self._walk(tok, len(self._instances)):
                 if inst.id not in picked:

@@ -73,17 +73,15 @@ class RemoteIngesterClient(_BaseClient):
         res = self._get("/internal/ingester/search", tenant,
                         {"q": query, "limit": limit,
                          "start": start_s, "end": end_s})
-        return [TraceSearchMetadata(
-            trace_id=t["traceID"],
-            root_service_name=t.get("rootServiceName", ""),
-            root_trace_name=t.get("rootTraceName", ""),
-            start_time_unix_nano=int(t.get("startTimeUnixNano", "0")),
-            duration_ms=t.get("durationMs", 0),
-            span_sets=t.get("spanSets", []))
-            for t in res.get("traces", [])]
+        return [TraceSearchMetadata.from_json(t)
+                for t in res.get("traces", [])]
 
     def tag_names(self, tenant: str) -> dict[str, list[str]]:
         return self._get("/internal/ingester/tags", tenant).get("scopes", {})
+
+    def tag_values(self, tenant: str, name: str, limit: int = 1000) -> list[dict]:
+        return self._get("/internal/ingester/tag_values", tenant,
+                         {"name": name, "limit": limit}).get("tagValues", [])
 
 
 class RemoteGeneratorClient(_BaseClient):

@@ -47,6 +47,17 @@ class TraceSearchMetadata:
             "spanSets": self.span_sets,
         }
 
+    @classmethod
+    def from_json(cls, t: dict) -> "TraceSearchMetadata":
+        """Inverse of to_json — the one decoder every RPC transport uses."""
+        return cls(
+            trace_id=t["traceID"],
+            root_service_name=t.get("rootServiceName", ""),
+            root_trace_name=t.get("rootTraceName", ""),
+            start_time_unix_nano=int(t.get("startTimeUnixNano", "0")),
+            duration_ms=t.get("durationMs", 0),
+            span_sets=t.get("spanSets", []))
+
 
 def compile_query(query: str | A.Pipeline,
                   start_ns: int = 0, end_ns: int = 0
@@ -214,9 +225,24 @@ def execute_tag_values(attr: str,
         c = resolve_attr(view, a)
         vals = c.values[c.exists]
         for v in np.unique(vals.astype(str) if c.t == "str" else vals):
-            key = str(v)
+            t = _tag_type(c.t)
+            if c.t == "num":
+                import math
+
+                f = float(v)
+                # integral numerics render as ints ("200", not "200.0"),
+                # matching the reference's typed tag values; non-finite
+                # floats (valid OTLP doubleValues) stay float-formatted
+                if math.isfinite(f) and f == int(f):
+                    key, t = str(int(f)), "int"
+                else:
+                    key = str(f)
+            elif c.t == "bool":
+                key = "true" if v else "false"
+            else:
+                key = str(v)
             if key not in seen:
-                seen[key] = {"type": _tag_type(c.t), "value": key}
+                seen[key] = {"type": t, "value": key}
             if len(seen) >= limit:
                 break
         if len(seen) >= limit:

@@ -15,6 +15,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402  (after env setup, before any test imports)
 
+# The axon sitecustomize hook registers the TPU platform and sets
+# jax_platforms="axon,cpu" at interpreter start, which overrides the env
+# var — and a wedged TPU tunnel then hangs every backend init. Explicitly
+# pin the config so tests are CPU-only no matter what the hook did.
+jax.config.update("jax_platforms", "cpu")
+
 # Persistent compile cache: XLA:CPU compiles cost ~1s each and dominate the
 # suite; cache them across runs.
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")

@@ -1,0 +1,227 @@
+"""In-process mock S3 server for the backend test matrix.
+
+The analog of the reference's minio test containers
+(`integration/poller/poller_test.go` backend fixtures): a ThreadingHTTPServer
+speaking the S3 subset the backend uses — GET/PUT/DELETE/HEAD on objects,
+Range reads, and ListObjectsV2 with prefix/delimiter/pagination.
+
+It VERIFIES AWS SigV4 on every request by rebuilding the canonical request
+from the wire (raw path + query + signed headers), independently of the
+client's signing code — so client-side canonicalization bugs (e.g. double
+percent-encoding) fail here the way they would against real S3/MinIO.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+ACCESS_KEY = "mock-access"
+SECRET_KEY = "mock-secret"
+REGION = "mock-region-1"
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+class MockS3Handler(BaseHTTPRequestHandler):
+    store: dict[str, bytes] = {}
+    lock = threading.Lock()
+    bucket = "test-bucket"
+
+    def log_message(self, fmt, *args):  # noqa: A003
+        pass
+
+    # -- sigv4 verification (independent of the client implementation) -----
+
+    def _verify_sig(self, payload: bytes) -> str | None:
+        """Returns an error string, or None if the signature checks out."""
+        auth = self.headers.get("Authorization", "")
+        if not auth.startswith("AWS4-HMAC-SHA256 "):
+            return "missing AWS4-HMAC-SHA256 authorization"
+        fields = dict(
+            p.strip().split("=", 1) for p in auth[len("AWS4-HMAC-SHA256 "):].split(","))
+        cred = fields["Credential"].split("/")
+        if cred[0] != ACCESS_KEY:
+            return "unknown access key"
+        datestamp, region, service = cred[1], cred[2], cred[3]
+        signed_headers = fields["SignedHeaders"].split(";")
+        amz_date = self.headers.get("x-amz-date", "")
+        body_sha = self.headers.get("x-amz-content-sha256", "")
+        if hashlib.sha256(payload).hexdigest() != body_sha:
+            return "payload hash mismatch"
+
+        split = urllib.parse.urlsplit(self.path)
+        canon_uri = split.path or "/"
+        q = urllib.parse.parse_qsl(split.query, keep_blank_values=True)
+        canon_query = "&".join(
+            f"{urllib.parse.quote(k, safe='-_.~')}="
+            f"{urllib.parse.quote(v, safe='-_.~')}"
+            for k, v in sorted(q))
+        canon_headers = "".join(
+            f"{h}:{(self.headers.get(h) or '').strip()}\n"
+            for h in signed_headers)
+        canon_req = "\n".join([
+            self.command, canon_uri, canon_query, canon_headers,
+            ";".join(signed_headers), body_sha])
+        scope = f"{datestamp}/{region}/{service}/aws4_request"
+        to_sign = "\n".join([
+            "AWS4-HMAC-SHA256", amz_date, scope,
+            hashlib.sha256(canon_req.encode()).hexdigest()])
+        k = _hmac(("AWS4" + SECRET_KEY).encode(), datestamp)
+        k = _hmac(k, region)
+        k = _hmac(k, service)
+        k = _hmac(k, "aws4_request")
+        sig = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+        if sig != fields["Signature"]:
+            return "SignatureDoesNotMatch"
+        # basic clock sanity, as S3 enforces
+        try:
+            dt = datetime.datetime.strptime(amz_date, "%Y%m%dT%H%M%SZ")
+        except ValueError:
+            return "bad x-amz-date"
+        skew = abs((datetime.datetime.now(datetime.timezone.utc)
+                    - dt.replace(tzinfo=datetime.timezone.utc)).total_seconds())
+        if skew > 900:
+            return "RequestTimeTooSkewed"
+        return None
+
+    # -- helpers ------------------------------------------------------------
+
+    def _key(self) -> str | None:
+        split = urllib.parse.urlsplit(self.path)
+        parts = split.path.lstrip("/").split("/", 1)
+        if parts[0] != self.bucket:
+            return None
+        return urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+
+    def _reply(self, code: int, body: bytes = b"",
+               headers: dict | None = None) -> None:
+        self.send_response(code)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _deny(self, msg: str) -> None:
+        self._reply(403, f"<Error><Code>{msg}</Code></Error>".encode())
+
+    # -- verbs --------------------------------------------------------------
+
+    def do_PUT(self) -> None:  # noqa: N802
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        if (err := self._verify_sig(body)) is not None:
+            return self._deny(err)
+        key = self._key()
+        if key is None or not key:
+            return self._reply(400)
+        with self.lock:
+            self.store[key] = body
+        self._reply(200)
+
+    def do_GET(self) -> None:  # noqa: N802
+        if (err := self._verify_sig(b"")) is not None:
+            return self._deny(err)
+        key = self._key()
+        if key is None:
+            return self._reply(404)
+        split = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(split.query, keep_blank_values=True))
+        if key == "" and q.get("list-type") == "2":
+            return self._list_v2(q)
+        with self.lock:
+            data = self.store.get(key)
+        if data is None:
+            return self._reply(
+                404, b"<Error><Code>NoSuchKey</Code></Error>")
+        rng = self.headers.get("Range", "")
+        if rng.startswith("bytes="):
+            lo_s, hi_s = rng[len("bytes="):].split("-", 1)
+            lo = int(lo_s)
+            hi = min(int(hi_s), len(data) - 1) if hi_s else len(data) - 1
+            if lo >= len(data):
+                return self._reply(416)
+            part = data[lo:hi + 1]
+            return self._reply(206, part, {
+                "Content-Range": f"bytes {lo}-{hi}/{len(data)}"})
+        self._reply(200, data)
+
+    def do_HEAD(self) -> None:  # noqa: N802
+        if (err := self._verify_sig(b"")) is not None:
+            return self._reply(403)
+        key = self._key()
+        with self.lock:
+            data = self.store.get(key or "")
+        if data is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        if (err := self._verify_sig(b"")) is not None:
+            return self._deny(err)
+        key = self._key()
+        with self.lock:
+            self.store.pop(key or "", None)
+        self._reply(204)
+
+    # -- ListObjectsV2 ------------------------------------------------------
+
+    def _list_v2(self, q: dict) -> None:
+        prefix = q.get("prefix", "")
+        delim = q.get("delimiter", "")
+        max_keys = int(q.get("max-keys", "1000"))
+        token = q.get("continuation-token", "")
+        with self.lock:
+            all_keys = sorted(k for k in self.store if k.startswith(prefix))
+        if token:
+            all_keys = [k for k in all_keys if k > token]
+        contents: list[str] = []
+        prefixes: list[str] = []
+        for k in all_keys:
+            if delim:
+                rest = k[len(prefix):]
+                if delim in rest:
+                    p = prefix + rest.split(delim, 1)[0] + delim
+                    if p not in prefixes:
+                        prefixes.append(p)
+                    continue
+            contents.append(k)
+            if len(contents) >= max_keys:
+                break
+        truncated = bool(contents) and contents[-1] != (all_keys[-1] if all_keys else "")
+        # pagination token = last emitted key (lexicographic resume)
+        parts = ["<?xml version=\"1.0\"?><ListBucketResult>"]
+        for k in contents:
+            parts.append(f"<Contents><Key>{k}</Key>"
+                         f"<Size>{len(self.store[k])}</Size></Contents>")
+        for p in prefixes:
+            parts.append(f"<CommonPrefixes><Prefix>{p}</Prefix></CommonPrefixes>")
+        parts.append(f"<IsTruncated>{'true' if truncated else 'false'}</IsTruncated>")
+        if truncated and contents:
+            parts.append(
+                f"<NextContinuationToken>{contents[-1]}</NextContinuationToken>")
+        parts.append("</ListBucketResult>")
+        self._reply(200, "".join(parts).encode())
+
+
+def start_mock_s3() -> tuple[ThreadingHTTPServer, int, type]:
+    """Returns (server, port, handler_cls). Each call gets an isolated
+    store (a fresh Handler subclass)."""
+    cls = type("BoundMockS3", (MockS3Handler,),
+               {"store": {}, "lock": threading.Lock()})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), cls)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, srv.server_address[1], cls

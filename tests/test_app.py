@@ -178,3 +178,68 @@ def test_http_e2e(server):
     with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
         text = r.read().decode()
     assert "tempo_distributor_spans_received_total 1" in text
+
+
+def test_tag_values_includes_ingester_recent_data(server):
+    """/api/search/tag/{name}/values must see unflushed ingester data
+    (ADVICE r1: previously only backend blocks were scanned)."""
+    import time
+    app, base = server
+    t0 = int((time.time() - 5) * 1e9)
+    body = json.dumps(OTLP).replace('"{t0}"', str(t0)) \
+                           .replace('"{t1}"', str(t0 + 50_000_000))
+    code, _ = _post(f"{base}/v1/traces", body.encode())
+    assert code == 200
+    code, res = _get(f"{base}/api/search/tag/.http.status_code/values")
+    assert code == 200
+    assert any(v["value"] == "200" for v in res["tagValues"])
+    code, res = _get(
+        f"{base}/api/search/tag/resource.service.name/values")
+    assert any(v["value"] == "shop" for v in res["tagValues"])
+
+
+def test_otlp_malformed_and_gzip(server):
+    import gzip
+    import time
+    app, base = server
+    # malformed protobuf → 400, not 500
+    req = urllib.request.Request(
+        f"{base}/v1/traces", data=b"\xff\xfe not proto",
+        headers={"Content-Type": "application/x-protobuf"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 400
+    # gzipped OTLP JSON is accepted
+    t0 = int((time.time() - 5) * 1e9)
+    body = json.dumps(OTLP).replace('"{t0}"', str(t0)) \
+                           .replace('"{t1}"', str(t0 + 50_000_000)) \
+                           .replace("0102030405060708090a0b0c0d0e0f10",
+                                    "ab" * 16)
+    req = urllib.request.Request(
+        f"{base}/v1/traces", data=gzip.compress(body.encode()),
+        headers={"Content-Type": "application/json",
+                 "Content-Encoding": "gzip"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 200
+    code, tr = _get(f"{base}/api/traces/{'ab' * 16}")
+    assert code == 200 and tr["spans"][0]["name"] == "checkout"
+
+
+def test_metrics_summary_without_generator(tmp_path):
+    cfg = Config()
+    cfg.storage.backend = "mem"
+    cfg.storage.wal_path = str(tmp_path / "wal")
+    cfg.target = "query-frontend"
+    cfg.server.http_listen_port = free_port()
+    app = App(cfg)
+    from tempo_tpu.app.api import serve
+    srv = serve(app, block=False)
+    base = f"http://127.0.0.1:{cfg.server.http_listen_port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/api/metrics/summary?q=%7B%20%7D",
+                                   timeout=10)
+        assert ei.value.code == 400  # clear error, not AttributeError 500
+    finally:
+        srv.shutdown()
+        app.shutdown()

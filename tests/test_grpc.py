@@ -1,0 +1,254 @@
+"""gRPC plane: OTLP/gRPC ingest, inter-service RPC, worker-pull scale-out.
+
+The gRPC analog of the reference's transport tests: a microservices
+cluster wired over grpc:// peers (shim.go receivers + tempo.proto
+services), plus the frontend↔querier worker-pull dispatch
+(`v1/frontend.go:204-293`, `worker/frontend_processor.go:69-195`).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+
+import grpc
+import pytest
+
+from tempo_tpu.app import App
+from tempo_tpu.app.config import Config
+from tempo_tpu.grpcplane import build_grpc_server
+from tempo_tpu.grpcplane.client import streaming_search
+
+
+def _port() -> int:
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]; s.close()
+    return p
+
+
+def _otlp_json_to_proto(payload: dict) -> bytes:
+    """Build an ExportTraceServiceRequest protobuf from OTLP JSON (enough
+    fields for the tests; exercises the receiver's real decode path)."""
+    from tempo_tpu.model.proto_wire import (
+        enc_field_bytes, enc_field_msg, enc_field_str, enc_field_varint)
+
+    def anyval(v: dict) -> bytes:
+        if "stringValue" in v:
+            return enc_field_str(1, v["stringValue"])
+        if "intValue" in v:
+            return enc_field_varint(3, int(v["intValue"]))
+        raise ValueError(v)
+
+    def attr(kv: dict) -> bytes:
+        return (enc_field_str(1, kv["key"]) +
+                enc_field_msg(2, anyval(kv["value"])))
+
+    out = b""
+    for rs in payload["resourceSpans"]:
+        rs_b = enc_field_msg(1, b"".join(
+            enc_field_msg(1, attr(a))
+            for a in rs.get("resource", {}).get("attributes", [])))
+        for ss in rs.get("scopeSpans", []):
+            spans_b = b""
+            for sp in ss["spans"]:
+                b = (enc_field_bytes(1, bytes.fromhex(sp["traceId"])) +
+                     enc_field_bytes(2, bytes.fromhex(sp["spanId"])) +
+                     enc_field_str(5, sp["name"]) +
+                     enc_field_varint(6, sp.get("kind", 0)) +
+                     enc_field_varint(7, int(sp["startTimeUnixNano"])) +
+                     enc_field_varint(8, int(sp["endTimeUnixNano"])))
+                for a in sp.get("attributes", []):
+                    b += enc_field_msg(9, attr(a))
+                spans_b += enc_field_msg(2, b)
+            rs_b += enc_field_msg(2, spans_b)
+        out += enc_field_msg(1, rs_b)
+    return out
+
+
+@pytest.fixture
+def grpc_cluster(tmp_path):
+    """distributor + ingester + generator + query tier over grpc:// peers."""
+    store = str(tmp_path / "store")
+    apps, servers = {}, {}
+
+    def boot(name, cfg):
+        cfg.server.http_listen_port = _port()
+        app = App(cfg)
+        app.overrides.set_tenant_patch("single-tenant", {
+            "generator": {"processors": ["span-metrics", "local-blocks"]}})
+        app.start_loops()
+        srv, port = build_grpc_server(app)
+        apps[name] = app
+        servers[name] = srv
+        return port
+
+    ing_cfg = Config(target="ingester")
+    ing_cfg.storage.backend = "local"
+    ing_cfg.storage.local_path = store
+    ing_cfg.storage.wal_path = str(tmp_path / "ing" / "wal")
+    ing_cfg.ingester.instance.trace_idle_s = 0.1
+    ing_port = boot("ing", ing_cfg)
+
+    gen_cfg = Config(target="metrics-generator")
+    gen_cfg.storage.backend = "local"
+    gen_cfg.storage.local_path = store
+    gen_cfg.generator.localblocks.data_dir = str(tmp_path / "gen-lb")
+    gen_port = boot("gen", gen_cfg)
+
+    q_cfg = Config(target="query-frontend")
+    q_cfg.storage.backend = "local"
+    q_cfg.storage.local_path = store
+    q_cfg.peers.ingesters = {"ing-1": f"grpc://127.0.0.1:{ing_port}"}
+    q_cfg.peers.generators = {"gen-1": f"grpc://127.0.0.1:{gen_port}"}
+    q_port = boot("query", q_cfg)
+
+    d_cfg = Config(target="distributor")
+    d_cfg.peers.ingesters = {"ing-1": f"grpc://127.0.0.1:{ing_port}"}
+    d_cfg.peers.generators = {"gen-1": f"grpc://127.0.0.1:{gen_port}"}
+    d_port = boot("dist", d_cfg)
+
+    yield apps, {"ing": ing_port, "gen": gen_port,
+                 "query": q_port, "dist": d_port}
+    for s in servers.values():
+        s.stop(grace=0.5)
+    for a in apps.values():
+        a.shutdown()
+
+
+def _otlp(trace_id: str, t0: int, name="grpc-op", svc="grpc-svc"):
+    return {"resourceSpans": [{
+        "resource": {"attributes": [
+            {"key": "service.name", "value": {"stringValue": svc}}]},
+        "scopeSpans": [{"spans": [{
+            "traceId": trace_id, "spanId": "ab" * 8, "name": name,
+            "kind": 2, "startTimeUnixNano": str(t0),
+            "endTimeUnixNano": str(t0 + 30_000_000),
+            "attributes": [{"key": "http.status_code",
+                            "value": {"intValue": "200"}}]}]}]}]}
+
+
+def test_grpc_microservices_e2e(grpc_cluster):
+    """OTLP/gRPC in at the distributor; trace-by-id, search, tag values and
+    metrics out of the query tier — all inter-service hops over gRPC."""
+    apps, ports = grpc_cluster
+    t0 = int((time.time() - 5) * 1e9)
+    body = _otlp_json_to_proto(_otlp("cd" * 16, t0))
+    with grpc.insecure_channel(f"127.0.0.1:{ports['dist']}") as ch:
+        export = ch.unary_unary(
+            "/opentelemetry.proto.collector.trace.v1.TraceService/Export")
+        resp = export(body, timeout=10)
+        assert resp == b""
+        # malformed payload → INVALID_ARGUMENT, not UNKNOWN/INTERNAL
+        with pytest.raises(grpc.RpcError) as ei:
+            export(b"\xff\xfe garbage", timeout=10)
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    fe = apps["query"].frontend
+    spans = fe.find_trace("single-tenant", bytes.fromhex("cd" * 16))
+    assert spans and spans[0]["name"] == "grpc-op"
+
+    res = fe.search("single-tenant",
+                    '{ resource.service.name = "grpc-svc" }')
+    assert len(res) == 1 and res[0].trace_id == "cd" * 16
+
+    vals = fe.tag_values("single-tenant", ".http.status_code")
+    assert any(v["value"] == "200" for v in vals)
+
+    # generator got the tee: span-metrics series exist
+    gi = apps["gen"].generator.instances.get("single-tenant")
+    assert gi is not None and gi.spans_received == 1
+
+
+def test_grpc_streaming_search(grpc_cluster):
+    apps, ports = grpc_cluster
+    t0 = int((time.time() - 5) * 1e9)
+    body = _otlp_json_to_proto(_otlp("ef" * 16, t0, name="stream-op"))
+    with grpc.insecure_channel(f"127.0.0.1:{ports['dist']}") as ch:
+        ch.unary_unary(
+            "/opentelemetry.proto.collector.trace.v1.TraceService/Export"
+        )(body, timeout=10)
+    msgs = list(streaming_search(
+        f"127.0.0.1:{ports['query']}", "single-tenant", "{ }"))
+    assert msgs[-1][1] is True                 # final message flagged
+    final = msgs[-1][0]
+    assert any(md.trace_id == "ef" * 16 for md in final)
+    # the partial diff arrived before the final (ingester leg streams first)
+    assert any(not fin and any(md.trace_id == "ef" * 16 for md in tr)
+               for tr, fin in msgs[:-1])
+
+
+def test_worker_pull_scale_out(tmp_path):
+    """1 frontend + 2 standalone querier processes: backend search jobs
+    demonstrably execute on both workers (VERDICT r1 item 4)."""
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.grpcplane.client import FrontendWorker
+
+    store = str(tmp_path / "store")
+
+    # seed the shared store with enough blocks to make many jobs
+    from tempo_tpu.db.tempodb import TempoDB
+
+    seed_db = TempoDB(LocalBackend(store), LocalBackend(store))
+    t_base = int((time.time() - 7200) * 1e9)   # old: backend window
+    for i in range(6):
+        tid = bytes([i + 1] * 16)
+        spans = [{"trace_id": tid, "span_id": bytes([i + 1] * 8),
+                  "name": f"op-{i}", "kind": 2, "service": "scale",
+                  "start_unix_nano": t_base + i * 1_000_000_000,
+                  "end_unix_nano": t_base + i * 1_000_000_000 + 5_000_000,
+                  "res_attrs": {"service.name": "scale"}}]
+        seed_db.write_block("single-tenant", [(tid, spans)])
+    seed_db.poll_now()
+    n_blocks = len(seed_db.blocks("single-tenant"))
+    assert n_blocks >= 2
+    seed_db.shutdown()
+
+    # frontend process (no local workers — remote pull only)
+    fe_cfg = Config(target="query-frontend")
+    fe_cfg.storage.backend = "local"
+    fe_cfg.storage.local_path = store
+    fe_cfg.server.http_listen_port = _port()
+    fe_app = App(fe_cfg)
+    fe_app.start_loops()
+    fe_app.db.poll_now()
+    fe_srv, fe_port = build_grpc_server(fe_app)
+
+    # two standalone querier processes dialing the frontend
+    workers = []
+    qapps = []
+    for i in range(2):
+        q_cfg = Config(target="querier")
+        q_cfg.storage.backend = "local"
+        q_cfg.storage.local_path = store
+        q_cfg.server.http_listen_port = _port()
+        qa = App(q_cfg)
+        qa.db.poll_now()
+        w = FrontendWorker(f"127.0.0.1:{fe_port}", qa.querier,
+                           worker_id=f"w{i}", parallelism=1)
+        w.start()
+        workers.append(w)
+        qapps.append(qa)
+
+    # wait for both worker streams to attach
+    deadline = time.time() + 5
+    while fe_app.frontend.remote_workers < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    assert fe_app.frontend.remote_workers == 2
+
+    try:
+        start = (t_base / 1e9) - 60
+        end = (t_base / 1e9) + 3600
+        res = fe_app.frontend.search("single-tenant", "{ }", limit=50,
+                                     start_s=start, end_s=end)
+        assert len(res) == 6
+        counts = [w.jobs_executed for w in workers]
+        assert sum(counts) >= n_blocks
+        assert all(c > 0 for c in counts), counts  # both workers pulled jobs
+    finally:
+        for w in workers:
+            w.shutdown()
+        fe_srv.stop(grace=0.5)
+        fe_app.shutdown()
+        for qa in qapps:
+            qa.shutdown()
