@@ -94,9 +94,31 @@ class Handler(BaseHTTPRequestHandler):
                 return self._set_overrides(tenant)
             if path.startswith("/internal/"):
                 return self._internal_post(tenant, path)
+            if path.startswith("/kv/"):
+                return self._kv_cas(path[len("/kv/"):])
         except Exception as e:
             return self._err(500, str(e))
         self._err(404, f"unknown path {path}")
+
+    # -- KV service (cross-process ring state; memberlist analog) ----------
+
+    def _kv_get(self, key: str) -> None:
+        from tempo_tpu.ring.kv import _value_to_json
+        ver, val = self.app.kv.get_versioned(key)
+        if val is None and ver == 0:
+            return self._err(404, f"no key {key}")
+        self._reply(200, _json_bytes({"version": ver,
+                                      "value": _value_to_json(val)}))
+
+    def _kv_cas(self, key: str) -> None:
+        from tempo_tpu.ring.kv import _value_from_json
+        n = int(self.headers.get("Content-Length", 0))
+        d = json.loads(self.rfile.read(n))
+        ok, ver = self.app.kv.cas_versioned(
+            key, int(d["expect_version"]), _value_from_json(d["value"]))
+        if not ok:
+            return self._err(409, f"version conflict on {key} (now {ver})")
+        self._reply(200, _json_bytes({"version": ver}))
 
     def _internal_post(self, tenant: str, path: str) -> None:
         """Inter-service RPC surface (the gRPC-plane analog; tempo_tpu.rpc
@@ -203,6 +225,8 @@ class Handler(BaseHTTPRequestHandler):
                 return self._status(path)
             if path == "/metrics":
                 return self._self_metrics()
+            if path.startswith("/kv/"):
+                return self._kv_get(path[len("/kv/"):])
             if path == "/usage_metrics":
                 d = self.app.distributor
                 text = d.usage.prometheus_text() if d is not None else ""
@@ -230,6 +254,13 @@ class Handler(BaseHTTPRequestHandler):
                 return self._internal_get(tenant, path, q)
         except Exception as e:
             return self._err(500, str(e))
+        self._err(404, f"unknown path {path}")
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        path = urlparse(self.path).path
+        if path.startswith("/kv/"):
+            self.app.kv.delete(path[len("/kv/"):])
+            return self._reply(204)
         self._err(404, f"unknown path {path}")
 
     def _internal_get(self, tenant: str, path: str, q: dict) -> None:

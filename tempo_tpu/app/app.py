@@ -47,6 +47,57 @@ TARGETS = {
 }
 
 
+def _make_remote_client(addr: str, kind: str):
+    """Transport by URL scheme: grpc:// → gRPC plane, else HTTP RPC."""
+    if addr.startswith("grpc://"):
+        from tempo_tpu.grpcplane import GrpcGeneratorClient, GrpcIngesterClient
+        cls = GrpcIngesterClient if kind == "ingesters" else GrpcGeneratorClient
+    else:
+        from tempo_tpu.rpc import RemoteGeneratorClient, RemoteIngesterClient
+        cls = RemoteIngesterClient if kind == "ingesters" \
+            else RemoteGeneratorClient
+    return cls(addr)
+
+
+class RingClientPool:
+    """Client lookup driven by live ring membership: instances discovered
+    via the shared KV resolve to RPC clients by their advertised address.
+    Replaces static `cfg.peers` maps in ring-KV deployments — the analog of
+    dskit's ring-aware client pools."""
+
+    def __init__(self, ring, kind: str) -> None:
+        self.ring = ring
+        self.kind = kind
+        self._cache: dict[str, tuple[str, object]] = {}
+
+    def _build(self, instance_id: str):
+        inst = self.ring.instance(instance_id)
+        if inst is None or not inst.addr:
+            return None
+        cached = self._cache.get(instance_id)
+        if cached is not None and cached[0] == inst.addr:
+            return cached[1]
+        client = _make_remote_client(inst.addr, self.kind)
+        self._cache[instance_id] = (inst.addr, client)
+        return client
+
+    def get(self, instance_id: str, default=None):
+        c = self._build(instance_id)
+        return c if c is not None else default
+
+    def __getitem__(self, instance_id: str):
+        c = self._build(instance_id)
+        if c is None:
+            raise KeyError(instance_id)
+        return c
+
+    def __contains__(self, instance_id: str) -> bool:
+        return self._build(instance_id) is not None
+
+    def __bool__(self) -> bool:
+        return True      # pool exists even while the ring is still empty
+
+
 class App:
     def __init__(self, cfg: Config | None = None,
                  now: Callable[[], float] = time.time) -> None:
@@ -54,7 +105,14 @@ class App:
         if self.cfg.target not in TARGETS:
             raise ValueError(f"unknown target {self.cfg.target!r}")
         self.now = now
-        self.kv = KVStore()
+        # ring_kv_url: "" = in-process KV + static wiring; "local" = host
+        # the shared KV on this process's /kv routes (ring mode); a URL =
+        # consume another process's KV (ring mode)
+        if self.cfg.ring_kv_url and self.cfg.ring_kv_url != "local":
+            from tempo_tpu.ring.kv import RemoteKVStore
+            self.kv = RemoteKVStore(self.cfg.ring_kv_url)
+        else:
+            self.kv = KVStore()
         self.ready = False
         self._stop = threading.Event()
         # modules (populated by _init_*)
@@ -129,41 +187,56 @@ class App:
             compactor=self.cfg.compactor,
             pool_workers=self.cfg.storage.pool_workers))
 
+    def _iid(self, kind: str) -> str:
+        """This process's ring identity for a module kind. Single-binary
+        keeps the -0 names; cross-process derives host+port identity (two
+        containers on different hosts with the same port must not collide
+        on one ring id — that would silently collapse RF to 1)."""
+        if self.cfg.instance_id:
+            return f"{kind}/{self.cfg.instance_id}"
+        if self.cfg.ring_kv_url:
+            import socket
+            return (f"{kind}-{socket.gethostname()}-"
+                    f"{self.cfg.server.http_listen_port}")
+        return f"{kind}-0"
+
+    def _advertise(self) -> str:
+        if self.cfg.advertise_addr:
+            return self.cfg.advertise_addr
+        s = self.cfg.server
+        host = s.http_listen_address
+        if host in ("", "0.0.0.0", "::"):
+            # the bind-any address is unroutable for peers: advertise the
+            # hostname instead (dskit's advertise-address inference)
+            import socket
+            host = socket.gethostname()
+        return f"http://{host}:{s.http_listen_port}"
+
     def _init_ingester(self) -> None:
         data_dir = os.path.dirname(self.cfg.storage.wal_path) or "./tempo-data"
+        iid = self._iid("ingester")
         self.ingester = Ingester(
             data_dir, flush_writer=self.backend, cfg=self.cfg.ingester,
-            overrides=self.overrides, now=self.now, instance_id="ingester-0")
-        self._join_ring("ingester", "ingester-0")
+            overrides=self.overrides, now=self.now, instance_id=iid)
+        self._join_ring("ingester", iid)
 
     def _init_generator(self) -> None:
         cfg = self.cfg.generator
         cfg.localblocks_flush_writer = self.backend
+        iid = self._iid("generator")
         self.generator = Generator(cfg, overrides=self.overrides,
-                                   instance_id="generator-0", now=self.now)
-        self._join_ring("generator", "generator-0")
+                                   instance_id=iid, now=self.now)
+        self._join_ring("generator", iid)
 
     def _peer_clients(self, kind: str):
         """Remote peers from static config → (clients, populated ring).
         The URL scheme selects the transport: http:// → the HTTP RPC
         clients, grpc:// → the gRPC plane."""
         from tempo_tpu.ring.ring import _instance_tokens
-        from tempo_tpu.rpc import RemoteGeneratorClient, RemoteIngesterClient
 
         addrs = getattr(self.cfg.peers, kind)
-
-        def make(url: str):
-            if url.startswith("grpc://"):
-                from tempo_tpu.grpcplane import (
-                    GrpcGeneratorClient, GrpcIngesterClient)
-                cls = GrpcIngesterClient if kind == "ingesters" \
-                    else GrpcGeneratorClient
-            else:
-                cls = RemoteIngesterClient if kind == "ingesters" \
-                    else RemoteGeneratorClient
-            return cls(url)
-
-        clients = {iid: make(url) for iid, url in addrs.items()}
+        clients = {iid: _make_remote_client(url, kind)
+                   for iid, url in addrs.items()}
         ring = Ring(replication_factor=1 if kind == "generators"
                     else self.cfg.distributor.rf,
                     heartbeat_timeout_s=0, now=self.now)
@@ -172,26 +245,41 @@ class App:
                                        tokens=_instance_tokens(iid, 128)))
         return clients, ring
 
+    def _shared_ring(self, key: str, rf: int) -> Ring:
+        return Ring(kv=self.kv, key=key, replication_factor=rf,
+                    heartbeat_timeout_s=self.cfg.heartbeat_timeout_s,
+                    now=self.now)
+
     def _init_distributor(self) -> None:
         if self.cfg.peers.ingesters:
             ing_clients, iring = self._peer_clients("ingesters")
+        elif self.cfg.ring_kv_url:
+            # dynamic membership over the shared KV ring: peers appear via
+            # their lifecyclers, clients resolve from advertised addrs
+            iring = self._shared_ring("ingester", self.cfg.distributor.rf)
+            ing_clients = RingClientPool(iring, "ingesters")
         else:
             iring = Ring(kv=self.kv, key="ingester",
                          replication_factor=self.cfg.distributor.rf,
                          now=self.now)
-            ing_clients = {"ingester-0": self.ingester} if self.ingester else {}
+            ing_clients = {self._iid("ingester"): self.ingester} \
+                if self.ingester else {}
         if self.cfg.peers.generators:
             gen_clients, gring = self._peer_clients("generators")
+        elif self.cfg.ring_kv_url:
+            gring = self._shared_ring("generator", 1)
+            gen_clients = RingClientPool(gring, "generators")
         else:
             gring = Ring(kv=self.kv, key="generator", replication_factor=1,
                          now=self.now) if self.generator else None
-            gen_clients = ({"generator-0": self.generator}
+            gen_clients = ({self._iid("generator"): self.generator}
                            if self.generator else None)
         self.distributor = Distributor(
             iring, ing_clients, overrides=self.overrides,
             generator_ring=gring, generator_clients=gen_clients,
             cfg=self.cfg.distributor, now=self.now)
-        if self.cfg.target == ALL and not self.cfg.peers.ingesters:
+        if self.cfg.target == ALL and not self.cfg.peers.ingesters \
+                and not self.cfg.ring_kv_url:
             self.distributor.cfg.rf = 1   # one in-process ingester
 
     def _init_querier(self) -> None:
@@ -201,26 +289,40 @@ class App:
                                    overrides=self.overrides,
                                    cfg=self.cfg.querier, now=self.now)
             return
+        if self.cfg.ring_kv_url:
+            iring = self._shared_ring("ingester", self.cfg.querier.rf)
+            self.querier = Querier(self.db, iring,
+                                   RingClientPool(iring, "ingesters"),
+                                   overrides=self.overrides,
+                                   cfg=self.cfg.querier, now=self.now)
+            return
         iring = Ring(kv=self.kv, key="ingester", replication_factor=1,
                      now=self.now)
         self.querier = Querier(
             self.db, iring,
-            {"ingester-0": self.ingester} if self.ingester else {},
+            {self._iid("ingester"): self.ingester} if self.ingester else {},
             overrides=self.overrides, cfg=self.cfg.querier, now=self.now)
         if self.cfg.target == ALL:
             self.querier.cfg.rf = 1
 
     def _init_frontend(self) -> None:
         gen_qr = self.generator.query_range if self.generator else None
-        if self.cfg.peers.generators:
-            clients, gring = self._peer_clients("generators")
+        if self.cfg.peers.generators or \
+                (self.cfg.ring_kv_url and self.generator is None):
+            if self.cfg.peers.generators:
+                clients, gring = self._peer_clients("generators")
+            else:
+                gring = self._shared_ring("generator", 1)
+                clients = RingClientPool(gring, "generators")
 
             def gen_qr(tenant, req, clip_start_ns=None,
                        _clients=clients, _ring=gring):
                 out = []
                 for inst in _ring.healthy_instances():
-                    out.extend(_clients[inst.id].query_range(
-                        tenant, req, clip_start_ns=clip_start_ns))
+                    client = _clients.get(inst.id)
+                    if client is not None:
+                        out.extend(client.query_range(
+                            tenant, req, clip_start_ns=clip_start_ns))
                 return out
         self.frontend = Frontend(
             self.db, self.querier, cfg=self.cfg.frontend,
@@ -230,7 +332,8 @@ class App:
 
     def _join_ring(self, key: str, instance_id: str) -> None:
         self._lifecyclers.append(
-            Lifecycler(self.kv, instance_id, key=key, now=self.now))
+            Lifecycler(self.kv, instance_id, key=key,
+                       addr=self._advertise(), now=self.now))
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -257,9 +360,15 @@ class App:
             if self.cfg.target in (ALL, COMPACTOR):
                 self.db.enable_compaction(self.cfg.compaction_interval_s)
         def heartbeat():
-            while not self._stop.wait(15.0):
+            while not self._stop.wait(self.cfg.heartbeat_interval_s):
                 for lc in self._lifecyclers:
-                    lc.heartbeat()
+                    try:
+                        lc.heartbeat()
+                    except Exception:
+                        # KV transiently unreachable: a missed beat is
+                        # recoverable, a dead heartbeat thread is not —
+                        # peers would mark this instance unhealthy forever
+                        pass
         threading.Thread(target=heartbeat, daemon=True).start()
         self.ready = True
 
@@ -281,7 +390,12 @@ class App:
         if self.db:
             self.db.shutdown()
         for lc in self._lifecyclers:
-            lc.leave()
+            try:
+                lc.leave()
+            except Exception:
+                pass      # KV process may already be gone at teardown
+        if hasattr(self.kv, "shutdown"):
+            self.kv.shutdown()
 
     # -- serving -----------------------------------------------------------
 

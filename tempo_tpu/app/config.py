@@ -72,6 +72,14 @@ class PeersConfig:
 class Config:
     target: str = "all"
     multitenancy_enabled: bool = False
+    # cross-process ring state: URL of a process serving /kv/* CAS routes
+    # (the memberlist-cluster analog). Empty = in-process KV (single binary
+    # or static peers).
+    ring_kv_url: str = ""
+    instance_id: str = ""               # auto: <target>-<http port>
+    advertise_addr: str = ""            # auto: http://<addr>:<http port>
+    heartbeat_interval_s: float = 15.0
+    heartbeat_timeout_s: float = 60.0
     peers: PeersConfig = dataclasses.field(default_factory=PeersConfig)
     server: ServerConfig = dataclasses.field(default_factory=ServerConfig)
     storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)

@@ -227,12 +227,19 @@ class _Services:
                         plock.notify_all()
                     if wj is None:
                         continue
-                    if m["type"] == "result":
-                        wj.result = fe.decode_job_result(
-                            wj.spec, m.get("result"))
-                    else:
-                        wj.error = RuntimeError(m.get("error", "worker error"))
-                    wj.event.set()
+                    try:
+                        if m["type"] == "result":
+                            wj.result = fe.decode_job_result(
+                                wj.spec, m.get("result"))
+                        else:
+                            wj.error = RuntimeError(
+                                m.get("error", "worker error"))
+                    except Exception as e:
+                        # a malformed result must still complete the job —
+                        # the issuer has no other wake-up path once claimed
+                        wj.error = e
+                    finally:
+                        wj.event.set()
             except Exception:
                 pass
             finally:

@@ -1,15 +1,20 @@
-"""In-memory CAS key-value store standing in for memberlist gossip.
+"""CAS key-value stores standing in for memberlist gossip.
 
 The reference propagates ring state via dskit memberlist gossip KV
 (`cmd/tempo/app/modules.go:593-625`). Within one process (the single-binary
-target, `modules.go:711,742`) every module shares one KV; multi-process
-deployments would swap this for an RPC-backed store — the interface
-(`get/cas/watch_key`) matches dskit's `kv.Client` semantics.
+target, `modules.go:711,742`) every module shares one `KVStore`;
+multi-process deployments point every process's `RemoteKVStore` at one
+process's `/kv/*` HTTP CAS routes — same `get/cas/watch_key` semantics as
+dskit's `kv.Client`, with polling watches replacing gossip push.
 """
 
 from __future__ import annotations
 
+import json
 import threading
+import urllib.error
+import urllib.parse
+import urllib.request
 from typing import Any, Callable
 
 
@@ -25,6 +30,24 @@ class KVStore:
         with self._lock:
             v = self._data.get(key)
             return v[1] if v else None
+
+    def get_versioned(self, key: str) -> tuple[int, Any]:
+        with self._lock:
+            return self._data.get(key, (0, None))
+
+    def cas_versioned(self, key: str, expect_version: int,
+                      value: Any) -> tuple[bool, int]:
+        """Conditional put for the HTTP KV service: succeeds only when the
+        stored version matches. Returns (ok, current_version)."""
+        with self._lock:
+            ver, _ = self._data.get(key, (0, None))
+            if ver != expect_version:
+                return False, ver
+            self._data[key] = (ver + 1, value)
+            watchers = list(self._watches.get(key, ()))
+        for w in watchers:
+            w(value)
+        return True, expect_version + 1
 
     def cas(self, key: str, update: Callable[[Any], Any],
             retries: int = 10) -> Any:
@@ -58,3 +81,148 @@ class KVStore:
     def keys(self) -> list[str]:
         with self._lock:
             return list(self._data)
+
+
+# ---------------------------------------------------------------------------
+# Cross-process KV: HTTP CAS client with polling watches
+# ---------------------------------------------------------------------------
+
+def _value_to_json(value: Any) -> Any:
+    """Ring desc-maps (the KV's dominant payload) serialize explicitly;
+    everything else must already be JSON-safe."""
+    from tempo_tpu.ring.ring import InstanceDesc
+
+    if isinstance(value, dict) and value and \
+            all(isinstance(v, InstanceDesc) for v in value.values()):
+        return {"__ring__": {
+            iid: {"id": d.id, "addr": d.addr, "zone": d.zone,
+                  "state": d.state, "tokens": [int(t) for t in d.tokens],
+                  "heartbeat_ts": d.heartbeat_ts,
+                  "registered_ts": d.registered_ts}
+            for iid, d in value.items()}}
+    return value
+
+
+def _value_from_json(value: Any) -> Any:
+    import numpy as np
+
+    from tempo_tpu.ring.ring import InstanceDesc
+
+    if isinstance(value, dict) and "__ring__" in value:
+        return {
+            iid: InstanceDesc(
+                id=d["id"], addr=d.get("addr", ""), zone=d.get("zone", ""),
+                state=d.get("state", "ACTIVE"),
+                tokens=np.asarray(d.get("tokens", []), np.uint32),
+                heartbeat_ts=d.get("heartbeat_ts", 0.0),
+                registered_ts=d.get("registered_ts", 0.0))
+            for iid, d in value["__ring__"].items()}
+    return value
+
+
+class RemoteKVStore:
+    """`kv.Client` over another process's `/kv/*` HTTP CAS routes.
+
+    The deployment analog of pointing every service at the memberlist
+    cluster (`modules.go:593-625`): rings and lifecyclers consume this
+    exactly like the in-process `KVStore`. Watches poll (default 1s) —
+    the latency envelope of gossip convergence, without the protocol.
+    """
+
+    def __init__(self, base_url: str, poll_interval_s: float = 1.0,
+                 timeout_s: float = 5.0) -> None:
+        self.base = base_url.rstrip("/")
+        self.poll_interval_s = poll_interval_s
+        self.timeout = timeout_s
+        self._watches: dict[str, list[Callable[[Any], None]]] = {}
+        self._versions: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._poller: threading.Thread | None = None
+
+    # -- http --------------------------------------------------------------
+
+    def _fetch(self, key: str) -> tuple[int, Any]:
+        url = f"{self.base}/kv/{urllib.parse.quote(key)}"
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout) as r:
+                d = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return 0, None
+            raise
+        return d["version"], _value_from_json(d["value"])
+
+    def get(self, key: str) -> Any:
+        return self._fetch(key)[1]
+
+    def cas(self, key: str, update: Callable[[Any], Any],
+            retries: int = 10) -> Any:
+        for _ in range(retries):
+            ver, cur = self._fetch(key)
+            new = update(cur)
+            if new is None:
+                return cur
+            body = json.dumps({"expect_version": ver,
+                               "value": _value_to_json(new)}).encode()
+            req = urllib.request.Request(
+                f"{self.base}/kv/{urllib.parse.quote(key)}", data=body,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                if e.code == 409:
+                    continue            # raced; retry with fresh value
+                raise
+            self._notify(key, new, ver + 1)
+            return new
+        raise RuntimeError(f"CAS contention on {key!r}")
+
+    # -- watches (polling) --------------------------------------------------
+
+    def watch_key(self, key: str, cb: Callable[[Any], None]) -> None:
+        with self._lock:
+            self._watches.setdefault(key, []).append(cb)
+            if self._poller is None:
+                self._poller = threading.Thread(target=self._poll_loop,
+                                                daemon=True)
+                self._poller.start()
+
+    def _notify(self, key: str, value: Any, version: int) -> None:
+        with self._lock:
+            # dedupe on equality, not monotonicity: a restarted KV host
+            # resets versions to 0, and a >= watermark would freeze every
+            # watcher until the counter climbed back past its old value
+            if self._versions.get(key) == version:
+                return
+            self._versions[key] = version
+            watchers = list(self._watches.get(key, ()))
+        for w in watchers:
+            try:
+                w(value)
+            except Exception:
+                pass
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            with self._lock:
+                keys = list(self._watches)
+            for k in keys:
+                try:
+                    ver, val = self._fetch(k)
+                except Exception:
+                    continue            # KV briefly unreachable: keep view
+                if val is not None:
+                    self._notify(k, val, ver)
+
+    def delete(self, key: str) -> None:
+        req = urllib.request.Request(
+            f"{self.base}/kv/{urllib.parse.quote(key)}", method="DELETE")
+        try:
+            urllib.request.urlopen(req, timeout=self.timeout).close()
+        except urllib.error.HTTPError:
+            pass
+
+    def shutdown(self) -> None:
+        self._stop.set()

@@ -127,6 +127,9 @@ class Ring:
     def instances(self) -> list[InstanceDesc]:
         return [self._instances[i] for i in self._ids]
 
+    def instance(self, instance_id: str) -> InstanceDesc | None:
+        return self._instances.get(instance_id)
+
     def healthy_instances(self) -> list[InstanceDesc]:
         return [i for i in self.instances() if self.healthy(i)]
 

@@ -142,3 +142,110 @@ def test_microservices_flush_to_shared_store(cluster):
     apps["query"].db.poll_now()
     spans = apps["query"].db.find_trace_by_id("single-tenant", b"\xdd" * 16)
     assert spans and spans[0]["name"] == "flushed"
+
+
+def test_ring_kv_cluster_survives_ingester_death(tmp_path):
+    """3 ingesters + distributor + query tier discovered via the shared
+    HTTP CAS KV ring (the memberlist analog, `modules.go:593-625`), RF3.
+    One ingester dies abruptly mid-test; writes (quorum 2/3) and reads
+    (quorum + heartbeat failover) still succeed — VERDICT r1 item 6."""
+    store = str(tmp_path / "store")
+    apps, servers = {}, {}
+
+    def boot(name, cfg, kv_url):
+        cfg.server.http_listen_port = _port()
+        cfg.ring_kv_url = kv_url
+        cfg.heartbeat_interval_s = 0.2
+        cfg.heartbeat_timeout_s = 1.5
+        app = App(cfg)
+        app.overrides.set_tenant_patch("single-tenant", {
+            "generator": {"processors": ["span-metrics"]}})
+        app.start_loops()
+        apps[name] = app
+        servers[name] = serve(app, block=False)
+        return f"http://127.0.0.1:{cfg.server.http_listen_port}"
+
+    # the distributor hosts the KV; everyone else dials it
+    d_cfg = Config(target="distributor")
+    d_cfg.distributor.rf = 3
+    kv_url = boot("dist", d_cfg, "local")
+
+    for i in range(3):
+        ing_cfg = Config(target="ingester")
+        ing_cfg.storage.backend = "local"
+        ing_cfg.storage.local_path = store
+        ing_cfg.storage.wal_path = str(tmp_path / f"ing{i}" / "wal")
+        ing_cfg.ingester.instance.trace_idle_s = 0.1
+        boot(f"ing{i}", ing_cfg, kv_url)
+
+    q_cfg = Config(target="query-frontend")
+    q_cfg.storage.backend = "local"
+    q_cfg.storage.local_path = store
+    q_cfg.querier.rf = 3
+    boot("query", q_cfg, kv_url)
+
+    try:
+        # wait for all 3 ingesters to appear on the distributor's ring
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if len(apps["dist"].distributor.ingester_ring) >= 3:
+                break
+            time.sleep(0.1)
+        assert len(apps["dist"].distributor.ingester_ring) == 3
+
+        url = {k: f"http://127.0.0.1:{a.cfg.server.http_listen_port}"
+               for k, a in apps.items()}
+        t0 = int((time.time() - 5) * 1e9)
+
+        def push(tid_hex: str) -> int:
+            otlp = {"resourceSpans": [{"resource": {"attributes": [
+                {"key": "service.name", "value": {"stringValue": "rk"}}]},
+                "scopeSpans": [{"spans": [{
+                    "traceId": tid_hex, "spanId": "ab" * 8, "name": "rk-op",
+                    "kind": 2, "startTimeUnixNano": str(t0),
+                    "endTimeUnixNano": str(t0 + 10_000_000)}]}]}]}
+            code, _ = _post(url["dist"] + "/v1/traces",
+                            json.dumps(otlp).encode())
+            return code
+
+        assert push("11" * 16) == 200
+        # RF3: every ingester holds the trace
+        held = sum(1 for i in range(3)
+                   if apps[f"ing{i}"].ingester.find_trace_by_id(
+                       "single-tenant", b"\x11" * 16))
+        assert held == 3
+
+        # read through the query tier (quorum across the ring)
+        code, tr = _get(url["query"] + f"/api/traces/{'11' * 16}")
+        assert code == 200 and tr["spans"][0]["name"] == "rk-op"
+
+        # --- kill one ingester ABRUPTLY (no graceful leave) ---
+        victim = apps.pop("ing1")
+        servers.pop("ing1").shutdown()
+        victim._stop.set()              # heartbeats stop; no lc.leave()
+
+        # writes still succeed immediately: quorum 2 of RF3
+        assert push("22" * 16) == 200
+        held = sum(1 for i in (0, 2)
+                   if apps[f"ing{i}"].ingester.find_trace_by_id(
+                       "single-tenant", b"\x22" * 16))
+        assert held == 2
+
+        # reads still succeed immediately (error budget covers the corpse)
+        code, tr = _get(url["query"] + f"/api/traces/{'22' * 16}")
+        assert code == 200 and tr["spans"][0]["name"] == "rk-op"
+
+        # after the heartbeat timeout the ring marks it unhealthy and
+        # search fan-out no longer touches it
+        time.sleep(2.0)
+        ring = apps["query"].querier.ring
+        healthy = {i.id for i in ring.healthy_instances()}
+        assert len(healthy) == 2 and victim._iid("ingester") not in healthy
+        code, res = _get(url["query"] + "/api/search?q=" +
+                         urllib.parse.quote('{ resource.service.name = "rk" }'))
+        assert code == 200 and len(res["traces"]) >= 1
+    finally:
+        for s in servers.values():
+            s.shutdown()
+        for a in apps.values():
+            a.shutdown()
