@@ -1,9 +1,16 @@
-"""North-star benchmark: sustained spans/sec through the fused spanmetrics
-registry update on one chip (BASELINE.json: target 10M spans/s on v5e-1).
+"""North-star benchmarks (BASELINE.json: 10M spans/s sustained on v5e-1).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline is value / 10M (the north-star target, since the reference
-publishes no absolute numbers — BASELINE.md).
+Prints ONE JSON line. The PRIMARY metric is the honest end-to-end number:
+OTLP protobuf bytes in → device series state (decode + intern + slot
+resolution + fused device update) through `Generator.push_spans`, the real
+PushSpans path of SURVEY.md §3.2. The same line carries the companion
+numbers in "extra":
+
+- kernel_spans_per_sec: the device-only fused spanmetrics update with
+  pre-staged arrays and donated buffers (round-1's headline; the ceiling).
+- query_range_ms: TraceQL metrics `rate()` latency over a written block
+  (ref `BenchmarkBackendBlockQueryRange`, `block_traceql_test.go:1095`).
+- search_ms: TraceQL search latency over the same block.
 """
 
 from __future__ import annotations
@@ -15,15 +22,16 @@ import time
 import numpy as np
 
 
-def main() -> None:
+def bench_kernel() -> float:
+    """Device-only fused update: spans/s."""
     import jax
     import jax.numpy as jnp
 
     from tempo_tpu.ops import sketches
     from tempo_tpu.registry import metrics as rm
 
-    n_spans = 262144          # one padded batch bucket
-    n_series = 4096           # active series (typical RED cardinality)
+    n_spans = 262144
+    n_series = 4096
     edges = (0.002, 0.004, 0.008, 0.016, 0.032, 0.064, 0.128, 0.256,
              0.512, 1.024, 2.048, 4.096, 8.192, 16.384)
     gamma, nb_dd = sketches.dd_params(0.01, 1e-9, 1e6)
@@ -43,7 +51,6 @@ def main() -> None:
                 size_c.values, dd.counts, dd.zeros)
 
     step = jax.jit(fused_step, donate_argnums=tuple(range(7)))
-
     rng = np.random.default_rng(0)
     state = (
         jnp.zeros((n_series,), jnp.float32),
@@ -60,24 +67,191 @@ def main() -> None:
         jnp.asarray(rng.integers(100, 5000, n_spans), jnp.float32),
         jnp.ones((n_spans,), jnp.float32),
     )
-
-    # warmup / compile
     state = step(*state, *batch)
     jax.block_until_ready(state)
-
     iters = 30
     t0 = time.time()
     for _ in range(iters):
         state = step(*state, *batch)
     jax.block_until_ready(state)
-    dt = time.time() - t0
+    return iters * n_spans / (time.time() - t0)
 
-    spans_per_sec = iters * n_spans / dt
+
+def _make_otlp_payload(n_spans: int, n_services: int = 16,
+                       n_names: int = 64, seed: int = 0) -> bytes:
+    """Synthesize a realistic OTLP ExportTraceServiceRequest."""
+    from tempo_tpu.model.proto_wire import (
+        enc_field_bytes, enc_field_msg, enc_field_str, enc_field_varint)
+
+    rng = np.random.default_rng(seed)
+    t0 = int(time.time() * 1e9)
+
+    def attr(k: str, v: str | int) -> bytes:
+        if isinstance(v, int):
+            av = enc_field_varint(3, v)
+        else:
+            av = enc_field_str(1, v)
+        return enc_field_str(1, k) + enc_field_msg(2, av)
+
+    out = []
+    per_rs = max(n_spans // n_services, 1)
+    left = n_spans
+    for svc in range(n_services):
+        take = min(per_rs, left) if svc < n_services - 1 else left
+        left -= take
+        if take <= 0:
+            break
+        spans = []
+        for _ in range(take):
+            dur = int(rng.lognormal(16, 1.0))
+            start = t0 - int(rng.integers(0, 10**9))
+            b = (enc_field_bytes(1, rng.bytes(16)) +
+                 enc_field_bytes(2, rng.bytes(8)) +
+                 enc_field_str(5, f"op-{int(rng.integers(0, n_names))}") +
+                 enc_field_varint(6, int(rng.integers(1, 6))) +
+                 enc_field_varint(7, start) +
+                 enc_field_varint(8, start + dur) +
+                 enc_field_msg(9, attr("http.status_code",
+                                       int(rng.integers(200, 500)))) +
+                 enc_field_msg(9, attr("http.method", "GET")) +
+                 enc_field_msg(15, enc_field_varint(3, int(rng.integers(0, 3)))))
+            spans.append(enc_field_msg(2, b))
+        rs = (enc_field_msg(1, enc_field_msg(
+                  1, attr("service.name", f"svc-{svc}"))) +
+              enc_field_msg(2, b"".join(spans)))
+        out.append(enc_field_msg(1, rs))
+    return b"".join(out)
+
+
+def bench_e2e_ingest() -> tuple[float, float, float]:
+    """OTLP bytes → series state.
+
+    Returns (spans_per_sec, payload_mb_per_sec, dict_path_spans_per_sec):
+    the first two through `Generator.push_otlp` (native C++ scan →
+    vectorized SpanBatch staging → fused device update — the generator's
+    OTLP-shaped PushSpans wire path), the third through the per-span-dict
+    `Generator.push_spans` route (the distributor-tee shape).
+    """
+    import jax
+
+    from tempo_tpu import native
+    from tempo_tpu.generator.generator import Generator
+    from tempo_tpu.generator.instance import GeneratorConfig
+    from tempo_tpu.model.otlp import spans_from_otlp_proto
+    from tempo_tpu.overrides import Overrides
+
+    n_spans = 16384
+    payload = _make_otlp_payload(n_spans)
+    cfg = GeneratorConfig(processors=("span-metrics",))
+    cfg.registry.disable_collection = True
+    gen = Generator(cfg, overrides=Overrides())
+
+    gen.push_otlp("bench", payload)        # warmup: compile + intern tables
+    proc = gen.instance("bench").processors["span-metrics"]
+    iters = 16
+    t0 = time.time()
+    for _ in range(iters):
+        gen.push_otlp("bench", payload)
+    jax.block_until_ready(proc.calls.state.values)
+    dt = time.time() - t0
+    fast_sps = iters * n_spans / dt
+    fast_mbs = iters * len(payload) / dt / 1e6
+
+    gen2 = Generator(GeneratorConfig(processors=("span-metrics",)),
+                     overrides=Overrides())
+
+    def once_dicts() -> None:
+        spans = native.spans_from_otlp_proto_native(payload)
+        if spans is None:
+            spans = list(spans_from_otlp_proto(payload))
+        gen2.push_spans("bench", spans)
+
+    once_dicts()
+    proc2 = gen2.instance("bench").processors["span-metrics"]
+    iters2 = 4
+    t0 = time.time()
+    for _ in range(iters2):
+        once_dicts()
+    jax.block_until_ready(proc2.calls.state.values)
+    dict_sps = iters2 * n_spans / (time.time() - t0)
+    return fast_sps, fast_mbs, dict_sps
+
+
+def bench_query(tmp_dir: str) -> tuple[float, float]:
+    """(query_range_ms, search_ms) over one written block, post-warmup."""
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.db.tempodb import TempoDB
+    from tempo_tpu.traceql.engine_metrics import QueryRangeRequest
+
+    rng = np.random.default_rng(1)
+    n = 100_000
+    now_s = time.time()
+    t_base = int((now_s - 1800) * 1e9)
+
+    def traces():
+        for i in range(n):
+            tid = rng.bytes(16)
+            start = t_base + int(rng.integers(0, int(600 * 1e9)))
+            yield tid, [{
+                "trace_id": tid, "span_id": rng.bytes(8),
+                "name": f"op-{int(rng.integers(0, 64))}",
+                "service": f"svc-{int(rng.integers(0, 16))}",
+                "kind": int(rng.integers(1, 6)),
+                "status_code": int(rng.integers(0, 3)),
+                "start_unix_nano": start,
+                "end_unix_nano": start + int(rng.lognormal(16, 1.0)),
+                "attrs": {"http.status_code": int(rng.integers(200, 500))},
+                "res_attrs": {"service.name": f"svc-{int(rng.integers(0, 16))}"},
+            }]
+
+    db = TempoDB(LocalBackend(tmp_dir), LocalBackend(tmp_dir))
+    db.write_block("bench", traces(), replication_factor=1)
+    db.poll_now()
+    req = QueryRangeRequest(
+        query="{ } | rate() by (resource.service.name)",
+        start_ns=t_base, end_ns=t_base + int(900 * 1e9),
+        step_ns=int(60 * 1e9))
+
+    def qr() -> None:
+        db.query_range("bench", req)
+
+    def search() -> None:
+        db.search("bench", '{ span.http.status_code >= 400 }', limit=20,
+                  start_s=t_base / 1e9, end_s=now_s)
+
+    qr(); search()          # warmup (compiles, page cache)
+    t0 = time.time()
+    for _ in range(3):
+        qr()
+    qr_ms = (time.time() - t0) / 3 * 1000
+    t0 = time.time()
+    for _ in range(3):
+        search()
+    s_ms = (time.time() - t0) / 3 * 1000
+    db.shutdown()
+    return qr_ms, s_ms
+
+
+def main() -> None:
+    import tempfile
+
+    e2e_sps, e2e_mbs, dict_sps = bench_e2e_ingest()
+    kernel_sps = bench_kernel()
+    with tempfile.TemporaryDirectory() as td:
+        qr_ms, search_ms = bench_query(td)
     print(json.dumps({
-        "metric": "spanmetrics_fused_update_throughput",
-        "value": round(spans_per_sec, 1),
+        "metric": "e2e_otlp_ingest_throughput",
+        "value": round(e2e_sps, 1),
         "unit": "spans/s",
-        "vs_baseline": round(spans_per_sec / 1e7, 4),
+        "vs_baseline": round(e2e_sps / 1e7, 4),
+        "extra": {
+            "e2e_otlp_mb_per_sec": round(e2e_mbs, 2),
+            "e2e_dict_path_spans_per_sec": round(dict_sps, 1),
+            "kernel_spans_per_sec": round(kernel_sps, 1),
+            "kernel_vs_baseline": round(kernel_sps / 1e7, 4),
+            "query_range_100k_spans_ms": round(qr_ms, 1),
+            "search_100k_spans_ms": round(search_ms, 1),
+        },
     }))
 
 

@@ -74,6 +74,19 @@ class Generator:
                 res_attrs=s.get("res_attrs"))
         inst.push_batch(b.build())
 
+    def push_otlp(self, tenant: str, data: bytes) -> int:
+        """OTLP ExportTraceServiceRequest bytes → series state, staged by
+        the vectorized native-scan path. The reference's PushSpansRequest
+        carries OTLP-shaped ResourceSpans (`tempo.proto` PushSpansRequest),
+        so raw-OTLP ingest at the generator is wire-parity, minus the
+        per-span Python staging. Returns span count."""
+        from tempo_tpu.model.otlp_batch import batch_from_otlp
+
+        inst = self.instance(tenant)
+        sb = batch_from_otlp(data, inst.registry.interner)
+        inst.push_batch(sb)
+        return sb.n
+
     # -- reads (frontend generator_query_range hook) -----------------------
 
     def query_range(self, tenant: str, req, clip_start_ns: int | None = None):

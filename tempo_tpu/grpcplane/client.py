@@ -99,6 +99,11 @@ class GrpcGeneratorClient(_BaseGrpcClient):
         self._call("/tempopb.MetricsGenerator/PushSpans",
                    _one_record(list(groups.items())), tenant)
 
+    def push_otlp(self, tenant: str, data: bytes) -> int:
+        res = _jload(self._call("/tempopb.MetricsGenerator/PushOTLP",
+                                data, tenant))
+        return int(res.get("spans", 0))
+
     def query_range(self, tenant: str, req, clip_start_ns: int | None = None):
         import numpy as np
 

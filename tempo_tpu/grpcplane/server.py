@@ -106,6 +106,17 @@ class _Services:
         self.app.generator.push_spans(tenant, spans)
         return b"{}"
 
+    def generator_push_otlp(self, request: bytes, context) -> bytes:
+        """Raw OTLP ResourceSpans payload — the wire shape of the
+        reference's PushSpansRequest — staged by the vectorized scan."""
+        tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
+        try:
+            n = self.app.generator.push_otlp(tenant, request)
+        except (ValueError, KeyError, TypeError) as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"malformed otlp payload: {e}")
+        return _jdump({"spans": n})
+
     def generator_query_range(self, request: bytes, context) -> bytes:
         tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
         from tempo_tpu.traceql.engine_metrics import QueryRangeRequest
@@ -329,6 +340,7 @@ def build_grpc_server(app, address: str = "127.0.0.1:0",
         server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
             "tempopb.MetricsGenerator",
             {"PushSpans": unary(svc.generator_push_spans),
+             "PushOTLP": unary(svc.generator_push_otlp),
              "QueryRange": unary(svc.generator_query_range),
              "GetMetrics": unary(svc.generator_get_metrics)}),))
     if app.frontend is not None:
