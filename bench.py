@@ -2,7 +2,7 @@
 
 Prints ONE JSON line. The PRIMARY metric is the honest end-to-end number:
 OTLP protobuf bytes in → device series state (decode + intern + slot
-resolution + fused device update) through `Generator.push_spans`, the real
+resolution + fused device update) through `Generator.push_otlp`, the real
 PushSpans path of SURVEY.md §3.2. The same line carries the companion
 numbers in "extra":
 
@@ -11,18 +11,31 @@ numbers in "extra":
 - query_range_ms: TraceQL metrics `rate()` latency over a written block
   (ref `BenchmarkBackendBlockQueryRange`, `block_traceql_test.go:1095`).
 - search_ms: TraceQL search latency over the same block.
+
+Hardened (round-3): the default invocation is an ORCHESTRATOR that runs a
+bounded platform probe and then each stage in its own subprocess with a
+timeout, so a wedged TPU tunnel (the round-2 failure: jax init blocking
+indefinitely inside the first jnp op) can never take the whole bench down.
+Any stage that fails or times out on the accelerator is retried on CPU and
+the final line is still emitted, tagged with "platform" and per-stage
+errors. rc is 0 whenever the orchestrator itself survives.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
+PROBE_TIMEOUT_S = 240      # TPU backend init alone can take ~60-90s
+STAGE_TIMEOUT_S = 900
 
-def bench_kernel() -> float:
+
+def bench_kernel() -> dict:
     """Device-only fused update: spans/s."""
     import jax
     import jax.numpy as jnp
@@ -74,7 +87,7 @@ def bench_kernel() -> float:
     for _ in range(iters):
         state = step(*state, *batch)
     jax.block_until_ready(state)
-    return iters * n_spans / (time.time() - t0)
+    return {"kernel_spans_per_sec": iters * n_spans / (time.time() - t0)}
 
 
 def _make_otlp_payload(n_spans: int, n_services: int = 16,
@@ -123,14 +136,13 @@ def _make_otlp_payload(n_spans: int, n_services: int = 16,
     return b"".join(out)
 
 
-def bench_e2e_ingest() -> tuple[float, float, float]:
+def bench_e2e_ingest() -> dict:
     """OTLP bytes → series state.
 
-    Returns (spans_per_sec, payload_mb_per_sec, dict_path_spans_per_sec):
-    the first two through `Generator.push_otlp` (native C++ scan →
-    vectorized SpanBatch staging → fused device update — the generator's
-    OTLP-shaped PushSpans wire path), the third through the per-span-dict
-    `Generator.push_spans` route (the distributor-tee shape).
+    e2e_* run through `Generator.push_otlp` (native C++ scan → vectorized
+    SpanBatch staging → fused device update — the generator's OTLP-shaped
+    PushSpans wire path); dict_path through the per-span-dict
+    `Generator.push_spans` route (the legacy distributor-tee shape).
     """
     import jax
 
@@ -174,11 +186,14 @@ def bench_e2e_ingest() -> tuple[float, float, float]:
         once_dicts()
     jax.block_until_ready(proc2.calls.state.values)
     dict_sps = iters2 * n_spans / (time.time() - t0)
-    return fast_sps, fast_mbs, dict_sps
+    return {"e2e_spans_per_sec": fast_sps, "e2e_mb_per_sec": fast_mbs,
+            "dict_path_spans_per_sec": dict_sps}
 
 
-def bench_query(tmp_dir: str) -> tuple[float, float]:
+def bench_query() -> dict:
     """(query_range_ms, search_ms) over one written block, post-warmup."""
+    import tempfile
+
     from tempo_tpu.backend.local import LocalBackend
     from tempo_tpu.db.tempodb import TempoDB
     from tempo_tpu.traceql.engine_metrics import QueryRangeRequest
@@ -204,55 +219,148 @@ def bench_query(tmp_dir: str) -> tuple[float, float]:
                 "res_attrs": {"service.name": f"svc-{int(rng.integers(0, 16))}"},
             }]
 
-    db = TempoDB(LocalBackend(tmp_dir), LocalBackend(tmp_dir))
-    db.write_block("bench", traces(), replication_factor=1)
-    db.poll_now()
-    req = QueryRangeRequest(
-        query="{ } | rate() by (resource.service.name)",
-        start_ns=t_base, end_ns=t_base + int(900 * 1e9),
-        step_ns=int(60 * 1e9))
+    with tempfile.TemporaryDirectory() as tmp_dir:
+        db = TempoDB(LocalBackend(tmp_dir), LocalBackend(tmp_dir))
+        db.write_block("bench", traces(), replication_factor=1)
+        db.poll_now()
+        req = QueryRangeRequest(
+            query="{ } | rate() by (resource.service.name)",
+            start_ns=t_base, end_ns=t_base + int(900 * 1e9),
+            step_ns=int(60 * 1e9))
 
-    def qr() -> None:
-        db.query_range("bench", req)
+        def qr() -> None:
+            db.query_range("bench", req)
 
-    def search() -> None:
-        db.search("bench", '{ span.http.status_code >= 400 }', limit=20,
-                  start_s=t_base / 1e9, end_s=now_s)
+        def search() -> None:
+            db.search("bench", '{ span.http.status_code >= 400 }', limit=20,
+                      start_s=t_base / 1e9, end_s=now_s)
 
-    qr(); search()          # warmup (compiles, page cache)
-    t0 = time.time()
-    for _ in range(3):
-        qr()
-    qr_ms = (time.time() - t0) / 3 * 1000
-    t0 = time.time()
-    for _ in range(3):
-        search()
-    s_ms = (time.time() - t0) / 3 * 1000
-    db.shutdown()
-    return qr_ms, s_ms
+        qr(); search()          # warmup (compiles, page cache)
+        t0 = time.time()
+        for _ in range(3):
+            qr()
+        qr_ms = (time.time() - t0) / 3 * 1000
+        t0 = time.time()
+        for _ in range(3):
+            search()
+        s_ms = (time.time() - t0) / 3 * 1000
+        db.shutdown()
+    return {"query_range_ms": qr_ms, "search_ms": s_ms}
 
 
-def main() -> None:
-    import tempfile
+# --- orchestrator ----------------------------------------------------------
 
-    e2e_sps, e2e_mbs, dict_sps = bench_e2e_ingest()
-    kernel_sps = bench_kernel()
-    with tempfile.TemporaryDirectory() as td:
-        qr_ms, search_ms = bench_query(td)
+STAGES = {"e2e": bench_e2e_ingest, "kernel": bench_kernel,
+          "query": bench_query}
+
+
+def _cpu_env(env: dict) -> dict:
+    """Env forcing the CPU backend; drops the axon sitecustomize trigger
+    (it overrides JAX_PLATFORMS via jax.config at interpreter start)."""
+    env = dict(env)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+def _run_child(args: list[str], env: dict, timeout_s: int) -> tuple[dict | None, str]:
+    """Run `python bench.py <args>`; return (parsed-last-JSON-line, err)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), *args],
+            env=env, capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout_s}s"
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "")[-800:]
+        return None, f"rc={proc.returncode}: {tail}"
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line), ""
+        except (json.JSONDecodeError, ValueError):
+            continue
+    return None, f"no JSON in output: {(proc.stdout or '')[-400:]}"
+
+
+def _probe_platform() -> tuple[str, dict]:
+    """Bounded probe of the accelerator backend; never wedges the bench.
+
+    Returns (platform_name, env_for_stages). Tries the default (axon/TPU)
+    backend in a killable child, retries once, then falls back to CPU.
+    """
+    base = dict(os.environ)
+    if os.environ.get("TEMPO_BENCH_FORCE_CPU"):
+        return "cpu", _cpu_env(base)
+    for attempt in range(2):
+        out, err = _run_child(["--probe"], base, PROBE_TIMEOUT_S)
+        if out and out.get("platform"):
+            return str(out["platform"]), base
+        print(f"bench: platform probe attempt {attempt + 1} failed: {err}",
+              file=sys.stderr)
+    return "cpu", _cpu_env(base)
+
+
+def main() -> int:
+    if "--probe" in sys.argv:
+        if os.environ.get("TEMPO_BENCH_PROBE_HANG"):   # fault-injection hook
+            time.sleep(10_000)
+        import jax
+        d = jax.devices()[0]
+        x = jax.numpy.ones((4, 4)) @ jax.numpy.ones((4, 4))
+        assert float(x[0, 0]) == 4.0
+        print(json.dumps({"platform": d.platform,
+                          "device": str(d)}))
+        return 0
+    for name, fn in STAGES.items():
+        if f"--stage={name}" in sys.argv:
+            print(json.dumps(fn()))
+            return 0
+
+    platform, env = _probe_platform()
+    results: dict = {}
+    errors: dict = {}
+    stage_platform: dict = {}
+    for name in STAGES:
+        out, err = _run_child([f"--stage={name}"], env, STAGE_TIMEOUT_S)
+        used = platform
+        if out is None and platform != "cpu":
+            print(f"bench: stage {name} failed on {platform} ({err}); "
+                  "retrying on cpu", file=sys.stderr)
+            out, err = _run_child([f"--stage={name}"], _cpu_env(env),
+                                  STAGE_TIMEOUT_S)
+            used = "cpu"
+        if out is None:
+            errors[name] = err
+        else:
+            results.update(out)
+            stage_platform[name] = used
+
+    e2e_sps = results.get("e2e_spans_per_sec")
+    kernel_sps = results.get("kernel_spans_per_sec")
+    extra = {
+        "platform": platform,
+        "stage_platform": stage_platform,
+        "e2e_otlp_mb_per_sec": round(results.get("e2e_mb_per_sec", 0), 2),
+        "e2e_dict_path_spans_per_sec": round(
+            results.get("dict_path_spans_per_sec", 0), 1),
+        "kernel_spans_per_sec": round(kernel_sps, 1) if kernel_sps else None,
+        "kernel_vs_baseline": round(kernel_sps / 1e7, 4) if kernel_sps else None,
+        "query_range_100k_spans_ms": round(results["query_range_ms"], 1)
+        if "query_range_ms" in results else None,
+        "search_100k_spans_ms": round(results["search_ms"], 1)
+        if "search_ms" in results else None,
+    }
+    if errors:
+        extra["errors"] = errors
     print(json.dumps({
         "metric": "e2e_otlp_ingest_throughput",
-        "value": round(e2e_sps, 1),
+        "value": round(e2e_sps, 1) if e2e_sps else 0.0,
         "unit": "spans/s",
-        "vs_baseline": round(e2e_sps / 1e7, 4),
-        "extra": {
-            "e2e_otlp_mb_per_sec": round(e2e_mbs, 2),
-            "e2e_dict_path_spans_per_sec": round(dict_sps, 1),
-            "kernel_spans_per_sec": round(kernel_sps, 1),
-            "kernel_vs_baseline": round(kernel_sps / 1e7, 4),
-            "query_range_100k_spans_ms": round(qr_ms, 1),
-            "search_100k_spans_ms": round(search_ms, 1),
-        },
+        "vs_baseline": round(e2e_sps / 1e7, 4) if e2e_sps else 0.0,
+        "extra": extra,
     }))
+    return 0
 
 
 if __name__ == "__main__":
