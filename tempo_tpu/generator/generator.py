@@ -83,8 +83,9 @@ class Generator:
         from tempo_tpu.model.otlp_batch import batch_from_otlp
 
         inst = self.instance(tenant)
-        sb = batch_from_otlp(data, inst.registry.interner)
-        inst.push_batch(sb)
+        sb, sizes = batch_from_otlp(data, inst.registry.interner,
+                                    return_sizes=True)
+        inst.push_batch(sb, span_sizes=sizes)
         return sb.n
 
     # -- reads (frontend generator_query_range hook) -----------------------

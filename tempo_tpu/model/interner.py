@@ -5,6 +5,16 @@ service name is interned to a dense int32 id on the host; device kernels see
 only id columns. This plays the role the reference's `LabelValueCombo` +
 series hashing plays in `modules/generator/registry/registry.go:139-144`,
 and of parquet dictionary encoding in the block layer.
+
+When the native library is available the id table lives in C++
+(`native.cpp Interner`): the OTLP staging pass (`native.otlp_stage`)
+interns every wire string without crossing back into Python, and this
+class fronts the C++ table with a str-keyed cache plus a lazily synced
+id → str mirror for reverse lookups. Raw wire bytes that are not valid
+UTF-8 are interned as-is in C++ and mirrored here with replacement
+characters — two such byte strings that decode identically keep distinct
+ids (the pure-Python path would merge them), which at worst duplicates a
+pathological series label.
 """
 
 from __future__ import annotations
@@ -17,6 +27,16 @@ import numpy as np
 INVALID_ID = -1
 
 
+def _native_interner():
+    try:
+        from tempo_tpu import native
+        if native.available():
+            return native.NativeInterner()
+    except Exception:
+        pass
+    return None
+
+
 class StringInterner:
     """Append-only str→int32 table with reverse lookup. Thread-safe."""
 
@@ -24,13 +44,42 @@ class StringInterner:
         self._lock = threading.Lock()
         self._ids: dict[str, int] = {}
         self._strs: list[str] = []
+        self._native = _native_interner()
 
     def __len__(self) -> int:
+        if self._native is not None:
+            return self._native.count()
         return len(self._strs)
+
+    def _sync_locked(self) -> None:
+        """Pull strings interned C++-side (otlp_stage) into the mirror."""
+        nat = self._native
+        if nat is None:
+            return
+        cnt = nat.count()
+        first = len(self._strs)
+        if cnt > first:
+            for b in nat.dump(first, cnt - first):
+                s = b.decode("utf-8", "replace")
+                self._ids.setdefault(s, len(self._strs))
+                self._strs.append(s)
+
+    def sync(self) -> None:
+        with self._lock:
+            self._sync_locked()
 
     def intern(self, s: str) -> int:
         sid = self._ids.get(s)
         if sid is not None:
+            return sid
+        nat = self._native
+        if nat is not None:
+            sid = nat.intern_bytes(s.encode("utf-8", "surrogatepass"))
+            with self._lock:
+                self._sync_locked()
+                # guarantee a cache hit for this exact str even when the
+                # mirror decode of its bytes differs (surrogates)
+                self._ids.setdefault(s, sid)
             return sid
         with self._lock:
             sid = self._ids.get(s)
@@ -45,15 +94,31 @@ class StringInterner:
 
     def get(self, s: str) -> int:
         """Lookup without inserting; INVALID_ID when absent (query-side)."""
-        return self._ids.get(s, INVALID_ID)
+        sid = self._ids.get(s)
+        if sid is not None:
+            return sid
+        if self._native is not None:
+            return self._native.find_bytes(s.encode("utf-8", "surrogatepass"))
+        return INVALID_ID
 
     def lookup(self, sid: int) -> str:
+        if sid >= len(self._strs):
+            self.sync()
         return self._strs[sid]
 
     def lookup_many(self, ids: np.ndarray) -> list[str]:
+        ids = np.asarray(ids)
+        if ids.size and int(ids.max()) >= len(self._strs):
+            self.sync()
         strs = self._strs
-        return [strs[i] if i >= 0 else "" for i in np.asarray(ids).tolist()]
+        return [strs[i] if i >= 0 else "" for i in ids.tolist()]
 
     def snapshot(self) -> list[str]:
         with self._lock:
+            self._sync_locked()
             return list(self._strs)
+
+    def native_handle(self):
+        """The NativeInterner behind this table, or None (staging uses it
+        to intern wire strings without crossing into Python)."""
+        return self._native

@@ -8,8 +8,10 @@ the native layer, it just gets faster with it.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
+import tempfile
 import threading
 
 import numpy as np
@@ -20,7 +22,28 @@ _LOCK = threading.Lock()
 
 _DIR = os.path.dirname(__file__)
 _SRC = os.path.join(_DIR, "native.cpp")
-_SO = os.path.join(_DIR, "_tempo_native.so")
+
+
+def _so_path() -> str:
+    """Source-hash-keyed build target in a user cache dir (the build
+    artifact is never committed; a stale hash simply rebuilds)."""
+    with open(_SRC, "rb") as f:
+        tag = hashlib.sha1(f.read()).hexdigest()[:12]
+    base = os.environ.get("TEMPO_TPU_CACHE") or os.path.join(
+        os.environ.get("XDG_CACHE_HOME")
+        or os.path.expanduser("~/.cache"), "tempo_tpu")
+    try:
+        os.makedirs(base, exist_ok=True)
+    except OSError:
+        # last resort: a per-uid private dir under tmp — never load a .so
+        # another user could have planted at a predictable shared path
+        base = os.path.join(tempfile.gettempdir(),
+                            f"tempo_tpu-{os.getuid()}")
+        os.makedirs(base, mode=0o700, exist_ok=True)
+        st = os.stat(base)
+        if st.st_uid != os.getuid() or (st.st_mode & 0o077):
+            raise OSError(f"refusing unsafe cache dir {base}")
+    return os.path.join(base, f"_tempo_native_{tag}.so")
 
 # numpy mirror of SpanRec (padding-free C layout, see native.cpp)
 SPAN_REC_DTYPE = np.dtype([
@@ -58,18 +81,63 @@ ATTR_REC_DTYPE = np.dtype([
 ])
 assert ATTR_REC_DTYPE.itemsize == 48
 
+# numpy mirrors of the otlp_stage output records (see native.cpp)
+STAGE_REC_DTYPE = np.dtype([
+    ("trace_id", np.uint8, 16),
+    ("span_id", np.uint8, 8),
+    ("parent_span_id", np.uint8, 8),
+    ("start_ns", np.uint64),
+    ("end_ns", np.uint64),
+    ("name_id", np.int32),
+    ("status_msg_id", np.int32),
+    ("service_id", np.int32),
+    ("res_idx", np.int32),
+    ("kind", np.int32),
+    ("status_code", np.int32),
+    ("span_len", np.int32),
+    ("tid_len", np.int32),
+    ("sid_len", np.int32),
+    ("pid_len", np.int32),
+])
+assert STAGE_REC_DTYPE.itemsize == 88
+
+STAGE_ATTR_DTYPE = np.dtype([
+    ("sval_off", np.int64),
+    ("ival", np.int64),
+    ("fval", np.float64),
+    ("sval_len", np.int32),
+    ("key_id", np.int32),
+    ("sval_id", np.int32),
+    ("typ", np.int32),
+    ("owner", np.int32),
+    ("_pad", np.int32),
+])
+assert STAGE_ATTR_DTYPE.itemsize == 48
+
+STAGE_RES_DTYPE = np.dtype([
+    ("service_id", np.int32),
+    ("attr_start", np.int32),
+    ("attr_count", np.int32),
+    ("_pad", np.int32),
+])
+assert STAGE_RES_DTYPE.itemsize == 16
+
 
 def _build() -> str | None:
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-        return _SO
-    tmp = f"{_SO}.{os.getpid()}.tmp"  # pid-unique: concurrent builds race
+    try:
+        so = _so_path()
+    except OSError:
+        return None
+    if os.path.exists(so):
+        return so
+    tmp = f"{so}.{os.getpid()}.tmp"  # pid-unique: concurrent builds race
     try:
         subprocess.run(
             ["g++", "-O3", "-march=native", "-shared", "-fPIC",
              "-o", tmp, _SRC],
             check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _SO)
-        return _SO
+        os.replace(tmp, so)
+        return so
     except Exception:
         try:
             os.unlink(tmp)
@@ -99,21 +167,50 @@ def _load():
                 pass
             return None
         try:
+            c = ctypes
+            u8p, i32p, i64p = (c.POINTER(c.c_uint8), c.POINTER(c.c_int32),
+                               c.POINTER(c.c_int64))
             lib.fnv1_tokens.argtypes = [
-                ctypes.c_char_p, ctypes.c_int64,
-                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
-                ctypes.c_int64, ctypes.POINTER(ctypes.c_uint32)]
+                c.c_char_p, c.c_int64, u8p, c.c_int64, c.c_int64,
+                c.POINTER(c.c_uint32)]
             lib.fnv1_tokens.restype = None
-            lib.otlp_scan.argtypes = [
-                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
-                ctypes.c_void_p, ctypes.c_int64]
-            lib.otlp_scan.restype = ctypes.c_int64
+            lib.otlp_scan.argtypes = [u8p, c.c_int64, c.c_void_p, c.c_int64]
+            lib.otlp_scan.restype = c.c_int64
             lib.otlp_scan2.argtypes = [
-                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
-                ctypes.c_void_p, ctypes.c_int64,
-                ctypes.c_void_p, ctypes.c_int64,
-                ctypes.POINTER(ctypes.c_int64)]
-            lib.otlp_scan2.restype = ctypes.c_int64
+                u8p, c.c_int64, c.c_void_p, c.c_int64,
+                c.c_void_p, c.c_int64, i64p]
+            lib.otlp_scan2.restype = c.c_int64
+            # interner
+            lib.interner_new.restype = c.c_void_p
+            lib.interner_free.argtypes = [c.c_void_p]
+            lib.interner_intern.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+            lib.interner_intern.restype = c.c_int32
+            lib.interner_find.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+            lib.interner_find.restype = c.c_int32
+            lib.interner_count.argtypes = [c.c_void_p]
+            lib.interner_count.restype = c.c_int64
+            lib.interner_dump.argtypes = [
+                c.c_void_p, c.c_int32, c.c_int32, u8p, c.c_int64, i32p]
+            lib.interner_dump.restype = c.c_int64
+            # row table
+            lib.rowtable_new.argtypes = [c.c_int32]
+            lib.rowtable_new.restype = c.c_void_p
+            lib.rowtable_free.argtypes = [c.c_void_p]
+            lib.rowtable_lookup.argtypes = [
+                c.c_void_p, i32p, c.c_int64, u8p, i32p, i64p, c.c_int64]
+            lib.rowtable_lookup.restype = c.c_int64
+            lib.rowtable_insert.argtypes = [c.c_void_p, i32p, c.c_int32]
+            lib.rowtable_insert.restype = None
+            lib.rowtable_remove.argtypes = [c.c_void_p, i32p]
+            lib.rowtable_remove.restype = None
+            lib.rowtable_size.argtypes = [c.c_void_p]
+            lib.rowtable_size.restype = c.c_int64
+            # full staging
+            lib.otlp_stage.argtypes = [
+                c.c_void_p, u8p, c.c_int64,
+                c.c_void_p, c.c_int64, c.c_void_p, c.c_int64,
+                c.c_void_p, c.c_int64, c.c_void_p, c.c_int64, i64p]
+            lib.otlp_stage.restype = c.c_int32
             _LIB = lib
         except Exception:
             _LIB = None
@@ -192,6 +289,154 @@ def otlp_scan2(data: bytes, cap_hint: int = 4096
             return recs[:n], attrs[: n_attrs.value]
         cap = max(cap, int(n))
         attr_cap = max(attr_cap, int(n_attrs.value))
+
+
+# -- persistent interner / row table ----------------------------------------
+
+class NativeInterner:
+    """Handle on the C++ string intern table (bytes → dense int32 id).
+
+    The Python StringInterner fronts this with a str-keyed cache and a
+    lazily synced id → str mirror; see tempo_tpu.model.interner."""
+
+    __slots__ = ("_h", "_lib")
+
+    def __init__(self) -> None:
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = ctypes.c_void_p(lib.interner_new())
+
+    def __del__(self) -> None:
+        h = getattr(self, "_h", None)
+        if h and getattr(self, "_lib", None) is not None:
+            try:
+                self._lib.interner_free(h)
+            except Exception:
+                pass
+
+    def intern_bytes(self, b: bytes) -> int:
+        return int(self._lib.interner_intern(self._h, b, len(b)))
+
+    def find_bytes(self, b: bytes) -> int:
+        return int(self._lib.interner_find(self._h, b, len(b)))
+
+    def count(self) -> int:
+        return int(self._lib.interner_count(self._h))
+
+    def dump(self, first: int, n: int) -> list[bytes]:
+        """Strings [first, first+n) as raw bytes (mirror sync)."""
+        if n <= 0:
+            return []
+        cap = max(n * 16, 1024)
+        lens = np.empty(n, np.int32)
+        while True:
+            out = np.empty(cap, np.uint8)
+            got = self._lib.interner_dump(
+                self._h, first, n,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap,
+                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            if got == -1:
+                raise IndexError(f"interner_dump [{first}, {first + n})")
+            if got < 0:
+                cap = -got
+                continue
+            buf = out.tobytes()
+            res, o = [], 0
+            for ln in lens.tolist():
+                res.append(buf[o:o + ln])
+                o += ln
+            return res
+
+class NativeRowTable:
+    """Handle on the C++ label-row → slot table (series resolution)."""
+
+    __slots__ = ("_h", "_lib", "n_labels")
+
+    def __init__(self, n_labels: int) -> None:
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self.n_labels = n_labels
+        self._h = ctypes.c_void_p(lib.rowtable_new(n_labels))
+
+    def __del__(self) -> None:
+        h = getattr(self, "_h", None)
+        if h and getattr(self, "_lib", None) is not None:
+            try:
+                self._lib.rowtable_free(h)
+            except Exception:
+                pass
+
+    def lookup(self, rows: np.ndarray, valid: np.ndarray | None
+               ) -> tuple[np.ndarray, np.ndarray]:
+        """(slots [n] int32 with -1 unresolved, miss first-occurrence idx).
+
+        Every reported miss MUST be resolved via insert() or remove()
+        before the next lookup (pending entries are not re-reported)."""
+        rows = np.ascontiguousarray(rows, np.int32)
+        n = rows.shape[0]
+        out = np.empty(n, np.int32)
+        miss = np.empty(n, np.int64)
+        vp = None
+        if valid is not None:
+            vbuf = np.ascontiguousarray(valid, np.uint8)
+            vp = vbuf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        n_miss = self._lib.rowtable_lookup(
+            self._h, rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n,
+            vp, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            miss.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n)
+        return out, miss[:n_miss]
+
+    def insert(self, row: np.ndarray, slot: int) -> None:
+        row = np.ascontiguousarray(row, np.int32)
+        self._lib.rowtable_insert(
+            self._h, row.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            slot)
+
+    def remove(self, row: np.ndarray) -> None:
+        row = np.ascontiguousarray(row, np.int32)
+        self._lib.rowtable_remove(
+            self._h, row.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+
+    def size(self) -> int:
+        return int(self._lib.rowtable_size(self._h))
+
+
+def otlp_stage(interner: "NativeInterner", data: bytes,
+               cap_hint: int = 4096):
+    """One-pass OTLP bytes → interned columns.
+
+    Returns (spans StageRec[], span_attrs StageAttr[], res_attrs
+    StageAttr[], resources StageRes[]) or None when the native library is
+    unavailable. Raises ValueError on malformed input."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, np.uint8)
+    bp = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    cap = max(cap_hint, 16)
+    acap, rcap, rescap = cap * 4, 256, 64
+    while True:
+        spans = np.zeros(cap, STAGE_REC_DTYPE)
+        sattrs = np.zeros(acap, STAGE_ATTR_DTYPE)
+        rattrs = np.zeros(rcap, STAGE_ATTR_DTYPE)
+        res = np.zeros(rescap, STAGE_RES_DTYPE)
+        n_out = np.zeros(4, np.int64)
+        rc = lib.otlp_stage(
+            interner._h, bp, len(data),
+            spans.ctypes.data, cap, sattrs.ctypes.data, acap,
+            rattrs.ctypes.data, rcap, res.ctypes.data, rescap,
+            n_out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if rc != 0:
+            raise ValueError("malformed OTLP protobuf payload")
+        ns, na, nr, nres = (int(x) for x in n_out)
+        if ns <= cap and na <= acap and nr <= rcap and nres <= rescap:
+            return spans[:ns], sattrs[:na], rattrs[:nr], res[:nres]
+        cap, acap = max(cap, ns), max(acap, na)
+        rcap, rescap = max(rcap, nr), max(rescap, nres)
 
 
 def spans_from_otlp_proto_native(data: bytes):

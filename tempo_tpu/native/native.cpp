@@ -17,6 +17,9 @@
 #include <cstdint>
 #include <cstring>
 #include <cstddef>
+#include <cstdlib>
+#include <mutex>
+#include <vector>
 
 extern "C" {
 
@@ -254,6 +257,542 @@ int64_t otlp_scan(const uint8_t* buf, int64_t buflen,
                   SpanRec* out, int64_t cap) {
     int64_t n_attrs = 0;
     return otlp_scan2(buf, buflen, out, cap, nullptr, 0, &n_attrs);
+}
+
+}  // extern "C"
+
+// --- persistent string interner --------------------------------------------
+//
+// The host-side dictionary behind tempo_tpu.model.interner.StringInterner:
+// bytes -> dense int32 id, append-only, with a string arena so Python can
+// lazily mirror id -> string. Replaces the per-unique-string Python loops
+// of the staging path (VERDICT r2: `_intern_ranges`' per-length passes and
+// the registry's per-row dict work dominated e2e ingest). Analog of the
+// reference's LabelValueCombo hashing (`registry/hash.go`), but shared by
+// every string column.
+
+namespace {
+
+static inline uint64_t fnv1a64(const uint8_t* p, int64_t n) {
+    uint64_t h = 0xCBF29CE484222325ull;
+    for (int64_t i = 0; i < n; i++) h = (h ^ p[i]) * 0x100000001B3ull;
+    return h;
+}
+
+struct StrEntry {
+    int64_t off;
+    int32_t len;
+    uint64_t hash;
+};
+
+struct Interner {
+    std::mutex mu;
+    std::vector<uint8_t> arena;
+    std::vector<StrEntry> entries;          // id -> entry
+    std::vector<int32_t> table;             // open addressing, -1 empty
+    uint64_t mask = 0;
+
+    Interner() {
+        table.assign(1 << 12, -1);
+        mask = table.size() - 1;
+    }
+
+    void grow() {
+        std::vector<int32_t> nt(table.size() * 2, -1);
+        uint64_t nmask = nt.size() - 1;
+        for (int32_t id = 0; id < (int32_t)entries.size(); id++) {
+            uint64_t i = entries[id].hash & nmask;
+            while (nt[i] != -1) i = (i + 1) & nmask;
+            nt[i] = id;
+        }
+        table.swap(nt);
+        mask = nmask;
+    }
+
+    // lookup-or-insert; lock held by caller
+    int32_t intern_locked(const uint8_t* s, int64_t len) {
+        uint64_t h = fnv1a64(s, len);
+        uint64_t i = h & mask;
+        while (true) {
+            int32_t id = table[i];
+            if (id == -1) break;
+            const StrEntry& e = entries[id];
+            if (e.hash == h && e.len == len &&
+                memcmp(arena.data() + e.off, s, len) == 0)
+                return id;
+            i = (i + 1) & mask;
+        }
+        int32_t id = (int32_t)entries.size();
+        StrEntry e{(int64_t)arena.size(), (int32_t)len, h};
+        arena.insert(arena.end(), s, s + len);
+        entries.push_back(e);
+        table[i] = id;
+        if (entries.size() * 10 > table.size() * 7) grow();
+        return id;
+    }
+
+    // lookup only; -1 when absent. lock held by caller.
+    int32_t find_locked(const uint8_t* s, int64_t len) const {
+        uint64_t h = fnv1a64(s, len);
+        uint64_t i = h & mask;
+        while (true) {
+            int32_t id = table[i];
+            if (id == -1) return -1;
+            const StrEntry& e = entries[id];
+            if (e.hash == h && e.len == len &&
+                memcmp(arena.data() + e.off, s, len) == 0)
+                return id;
+            i = (i + 1) & mask;
+        }
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* interner_new() { return new Interner(); }
+void interner_free(void* h) { delete (Interner*)h; }
+
+int32_t interner_intern(void* h, const uint8_t* s, int64_t len) {
+    Interner* it = (Interner*)h;
+    std::lock_guard<std::mutex> g(it->mu);
+    return it->intern_locked(s, len);
+}
+
+int32_t interner_find(void* h, const uint8_t* s, int64_t len) {
+    Interner* it = (Interner*)h;
+    std::lock_guard<std::mutex> g(it->mu);
+    return it->find_locked(s, len);
+}
+
+int64_t interner_count(void* h) {
+    Interner* it = (Interner*)h;
+    std::lock_guard<std::mutex> g(it->mu);
+    return (int64_t)it->entries.size();
+}
+
+// Copy strings [first, first+n) as concatenated bytes + lengths so Python
+// can mirror the id->string table incrementally. Returns total bytes
+// copied, or -needed when out_cap is too small (caller re-calls).
+int64_t interner_dump(void* h, int32_t first, int32_t n,
+                      uint8_t* out, int64_t out_cap, int32_t* lens) {
+    Interner* it = (Interner*)h;
+    std::lock_guard<std::mutex> g(it->mu);
+    if (first < 0 || first + n > (int64_t)it->entries.size()) return -1;
+    int64_t need = 0;
+    for (int32_t i = 0; i < n; i++) need += it->entries[first + i].len;
+    if (need > out_cap) return -need;
+    int64_t o = 0;
+    for (int32_t i = 0; i < n; i++) {
+        const StrEntry& e = it->entries[first + i];
+        memcpy(out + o, it->arena.data() + e.off, e.len);
+        lens[i] = e.len;
+        o += e.len;
+    }
+    return o;
+}
+
+}  // extern "C"
+
+// --- persistent label-row table ---------------------------------------------
+//
+// [n_labels] int32 rows -> slot id; the series-resolution hot path
+// (`registry/series.py lookup_or_create`). Python keeps slot lifecycle
+// (free list, budget, staleness); this table only answers "which slot is
+// this row" at C speed. Unseen rows are assigned a PENDING marker so each
+// distinct new row is reported once; Python either inserts a real slot or
+// removes the pending entry (budget rejection).
+
+namespace {
+
+constexpr int32_t kPending = -2;
+
+struct RowTable {
+    std::mutex mu;
+    int32_t n_labels;
+    std::vector<int32_t> rows;       // entry i -> rows[i*n_labels ..]
+    std::vector<int32_t> slots;      // entry i -> slot id, kPending, or -3
+    std::vector<int32_t> table;      // open addressing over entries
+    std::vector<uint64_t> hashes;
+    std::vector<int32_t> free_entries;  // tombstoned entry ids for reuse
+    uint64_t mask;
+    int64_t live = 0;
+    int64_t cells = 0;   // occupied index cells (live + stale duplicates)
+
+    explicit RowTable(int32_t nl) : n_labels(nl) {
+        table.assign(1 << 10, -1);
+        mask = table.size() - 1;
+    }
+
+    // Rebuild the index from live entries (dropping stale cells left by
+    // tombstone reuse); doubles only when genuinely dense.
+    void grow() {
+        size_t nsize = table.size();
+        if (live * 10 > (int64_t)nsize * 5) nsize *= 2;
+        std::vector<int32_t> nt(nsize, -1);
+        uint64_t nmask = nt.size() - 1;
+        for (int32_t e = 0; e < (int32_t)hashes.size(); e++) {
+            if (slots[e] == -3) continue;          // tombstone
+            uint64_t i = hashes[e] & nmask;
+            while (nt[i] != -1) i = (i + 1) & nmask;
+            nt[i] = e;
+        }
+        table.swap(nt);
+        mask = nmask;
+        cells = live;
+    }
+
+    inline uint64_t rhash(const int32_t* row) const {
+        return fnv1a64((const uint8_t*)row, n_labels * 4);
+    }
+
+    // find entry index or -1; lock held
+    int32_t find_entry(const int32_t* row, uint64_t h) const {
+        uint64_t i = h & mask;
+        while (true) {
+            int32_t e = table[i];
+            if (e == -1) return -1;
+            if (hashes[e] == h && slots[e] != -3 &&
+                memcmp(rows.data() + (int64_t)e * n_labels, row,
+                       n_labels * 4) == 0)
+                return e;
+            i = (i + 1) & mask;
+        }
+    }
+
+    int32_t add_entry(const int32_t* row, uint64_t h, int32_t slot) {
+        int32_t e;
+        if (!free_entries.empty()) {
+            e = free_entries.back();
+            free_entries.pop_back();
+            memcpy(rows.data() + (int64_t)e * n_labels, row, n_labels * 4);
+            hashes[e] = h;
+            slots[e] = slot;
+        } else {
+            e = (int32_t)hashes.size();
+            rows.insert(rows.end(), row, row + n_labels);
+            hashes.push_back(h);
+            slots.push_back(slot);
+        }
+        uint64_t i = h & mask;
+        while (table[i] != -1) i = (i + 1) & mask;
+        table[i] = e;
+        live++;
+        cells++;
+        if (cells * 10 > (int64_t)table.size() * 7) grow();
+        return e;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rowtable_new(int32_t n_labels) { return new RowTable(n_labels); }
+void rowtable_free(void* h) { delete (RowTable*)h; }
+
+// Resolve n rows to slots. valid may be null (all valid). Rows not in the
+// table get PENDING entries (deduped within the call) and out_slots=-1;
+// the first-occurrence index of each new distinct row is appended to
+// miss_idx. Returns the miss count. CONTRACT: pass miss_cap >= n (misses
+// can never exceed n), and resolve every reported miss (rowtable_insert
+// or rowtable_remove) before the next lookup — leftover pending entries
+// would resolve to -1 forever without being re-reported.
+int64_t rowtable_lookup(void* h, const int32_t* rows_in, int64_t n,
+                        const uint8_t* valid, int32_t* out_slots,
+                        int64_t* miss_idx, int64_t miss_cap) {
+    RowTable* t = (RowTable*)h;
+    std::lock_guard<std::mutex> g(t->mu);
+    int64_t miss = 0;
+    for (int64_t i = 0; i < n; i++) {
+        if (valid && !valid[i]) { out_slots[i] = -1; continue; }
+        const int32_t* row = rows_in + i * t->n_labels;
+        uint64_t hh = t->rhash(row);
+        int32_t e = t->find_entry(row, hh);
+        if (e == -1) {
+            t->add_entry(row, hh, kPending);
+            if (miss < miss_cap) miss_idx[miss] = i;
+            miss++;
+            out_slots[i] = -1;
+        } else if (t->slots[e] == kPending) {
+            // duplicate of a pending row within this batch: already
+            // reported; stays -1 until Python assigns the slot
+            out_slots[i] = -1;
+        } else {
+            out_slots[i] = t->slots[e];
+        }
+    }
+    return miss;
+}
+
+// Assign a real slot to a row (overwrites pending or inserts fresh).
+void rowtable_insert(void* h, const int32_t* row, int32_t slot) {
+    RowTable* t = (RowTable*)h;
+    std::lock_guard<std::mutex> g(t->mu);
+    uint64_t hh = t->rhash(row);
+    int32_t e = t->find_entry(row, hh);
+    if (e == -1) t->add_entry(row, hh, slot);
+    else t->slots[e] = slot;
+}
+
+// Remove a row (budget-rejected pending entry, or stale-purged series).
+// Tombstones the entry for reuse; its index cell stays until grow()
+// (stale cells only add probe steps — lookups check entry liveness).
+void rowtable_remove(void* h, const int32_t* row) {
+    RowTable* t = (RowTable*)h;
+    std::lock_guard<std::mutex> g(t->mu);
+    uint64_t hh = t->rhash(row);
+    int32_t e = t->find_entry(row, hh);
+    if (e != -1) {
+        t->slots[e] = -3;
+        t->free_entries.push_back(e);
+        t->live--;
+    }
+}
+
+int64_t rowtable_size(void* h) {
+    RowTable* t = (RowTable*)h;
+    std::lock_guard<std::mutex> g(t->mu);
+    return t->live;
+}
+
+}  // extern "C"
+
+// --- one-pass OTLP -> interned columns (otlp_stage) --------------------------
+//
+// The full staging kernel: OTLP ExportTraceServiceRequest bytes in, dense
+// intern-id columns out. Combines the wire scan with dictionary encoding so
+// Python never touches per-span or per-unique-string data on the generator
+// ingest path (`modules/generator/generator.go:275` PushSpans analog; the
+// distributor regroup stays on otlp_scan2). Non-scalar AnyValues (arrays,
+// kvlists, bytes) keep their byte ranges for a rare Python fixup pass.
+
+// Per-span staged record: fixed columns + intern ids. Padding-free
+// (descending alignment); mirrored by STAGE_REC_DTYPE in __init__.py.
+struct StageRec {
+    uint8_t  trace_id[16];
+    uint8_t  span_id[8];
+    uint8_t  parent_span_id[8];
+    uint64_t start_ns, end_ns;
+    int32_t  name_id, status_msg_id;   // status_msg_id = -1 when absent
+    int32_t  service_id, res_idx;      // resource of this span
+    int32_t  kind, status_code;
+    int32_t  span_len;                 // wire size (size_total accounting)
+    int32_t  tid_len, sid_len, pid_len;
+};
+
+// One staged attribute (span- or resource-scope). typ follows the ATTR_*
+// enums of model/span_batch.py: 1=string 2=bool 3=int 4=double; 0=other
+// (sval_off/len point at the raw AnyValue; Python stringifies + interns).
+struct StageAttr {
+    int64_t sval_off;
+    int64_t ival;
+    double  fval;
+    int32_t sval_len;
+    int32_t key_id;
+    int32_t sval_id;                   // -1 unless typ==1
+    int32_t typ;
+    int32_t owner;                     // span idx or resource idx
+    int32_t _pad;
+};
+
+// One distinct Resource (per ResourceSpans entry, position-deduped like the
+// Python path): service.name id + its attr range in the res-attr output.
+struct StageRes {
+    int32_t service_id;                // id of "" when absent
+    int32_t attr_start, attr_count;    // range into res attrs (pre-cap)
+    int32_t _pad;
+};
+
+namespace {
+
+struct StageCtx {
+    Interner* it;
+    const uint8_t* buf;
+    StageRec* spans; int64_t span_cap; int64_t n_spans = 0;
+    StageAttr* sattrs; int64_t sattr_cap; int64_t n_sattrs = 0;
+    StageAttr* rattrs; int64_t rattr_cap; int64_t n_rattrs = 0;
+    StageRes* res; int64_t res_cap; int64_t n_res = 0;
+    int32_t empty_id;
+    int32_t svc_key_id;                // id of "service.name"
+};
+
+// Parse one KeyValue into a StageAttr (interning key + string value).
+// Returns false on malformed bytes.
+static bool stage_keyvalue(StageCtx& c, const uint8_t* kv, uint64_t kvlen,
+                           int32_t owner, StageAttr& a) {
+    Cursor cur{kv, kv + kvlen, true};
+    uint32_t f, w; uint64_t v, l; const uint8_t* s;
+    a.sval_off = -1; a.ival = 0; a.fval = 0; a.sval_len = 0;
+    a.key_id = c.empty_id; a.sval_id = -1; a.typ = 0; a.owner = owner;
+    a._pad = 0;
+    const uint8_t* val_start = nullptr; uint64_t val_len = 0;
+    while (read_field(cur, f, w, v, s, l)) {
+        if (f == 1 && w == 2) a.key_id = c.it->intern_locked(s, l);
+        else if (f == 2 && w == 2) { val_start = s; val_len = l; }
+    }
+    if (!cur.ok) return false;
+    if (val_start) {
+        Cursor av{val_start, val_start + val_len, true};
+        while (read_field(av, f, w, v, s, l)) {
+            switch (f) {
+                case 1: if (w == 2) {
+                            a.typ = 1;
+                            a.sval_id = c.it->intern_locked(s, l);
+                            a.sval_off = s - c.buf;
+                            a.sval_len = (int32_t)l;
+                        } break;
+                case 2: a.typ = 2; a.fval = v ? 1.0 : 0.0; break;
+                case 3: a.typ = 3; a.ival = (int64_t)v; break;
+                case 4: { a.typ = 4; double d; memcpy(&d, &v, 8); a.fval = d; } break;
+                default:
+                    if (a.typ == 0) {
+                        a.sval_off = val_start - c.buf;
+                        a.sval_len = (int32_t)val_len;
+                    }
+                    break;
+            }
+        }
+        if (!av.ok) return false;
+    }
+    return true;
+}
+
+// Parse a Resource message: intern its attrs, find service.name.
+static bool stage_resource(StageCtx& c, const uint8_t* rm, uint64_t rmlen,
+                           StageRes& r) {
+    r.service_id = c.empty_id;
+    r.attr_start = (int32_t)c.n_rattrs;
+    r.attr_count = 0;
+    r._pad = 0;
+    if (!rm) return true;
+    Cursor cur{rm, rm + rmlen, true};
+    uint32_t f, w; uint64_t v, l; const uint8_t* s;
+    while (read_field(cur, f, w, v, s, l)) {
+        if (f != 1 || w != 2) continue;            // Resource.attributes
+        StageAttr a;
+        if (!stage_keyvalue(c, s, l, (int32_t)c.n_res, a)) return false;
+        if (c.n_rattrs < c.rattr_cap) c.rattrs[c.n_rattrs] = a;
+        c.n_rattrs++;
+        r.attr_count++;
+        if (a.key_id == c.svc_key_id && a.typ == 1)
+            r.service_id = a.sval_id;
+    }
+    return cur.ok;
+}
+
+static bool stage_span(StageCtx& c, const uint8_t* sp, uint64_t splen,
+                       int32_t res_idx, int32_t service_id) {
+    StageRec rec;
+    memset(&rec, 0, sizeof(rec));
+    rec.name_id = c.empty_id;
+    rec.status_msg_id = -1;
+    rec.service_id = service_id;
+    rec.res_idx = res_idx;
+    rec.span_len = (int32_t)splen;
+    int32_t span_idx = (int32_t)c.n_spans;
+    Cursor cur{sp, sp + splen, true};
+    uint32_t f, w; uint64_t v, l; const uint8_t* s;
+    while (read_field(cur, f, w, v, s, l)) {
+        if ((f <= 5 || f == 9 || f == 15) && w != 2) continue;
+        switch (f) {
+            case 1: rec.tid_len = (int32_t)l;
+                    if (l <= 16) memcpy(rec.trace_id, s, l); break;
+            case 2: rec.sid_len = (int32_t)l;
+                    if (l <= 8) memcpy(rec.span_id, s, l); break;
+            case 4: rec.pid_len = (int32_t)l;
+                    if (l <= 8) memcpy(rec.parent_span_id, s, l); break;
+            case 5: rec.name_id = c.it->intern_locked(s, l); break;
+            case 6: if (w == 0) rec.kind = (int32_t)v; break;
+            case 7: if (w != 2) rec.start_ns = v; break;
+            case 8: if (w != 2) rec.end_ns = v; break;
+            case 9: {
+                StageAttr a;
+                if (!stage_keyvalue(c, s, l, span_idx, a)) return false;
+                if (c.n_sattrs < c.sattr_cap) c.sattrs[c.n_sattrs] = a;
+                c.n_sattrs++;
+                break;
+            }
+            case 15: {
+                Cursor st{s, s + l, true};
+                uint32_t f5, w5; uint64_t v5, l5; const uint8_t* s5;
+                while (read_field(st, f5, w5, v5, s5, l5)) {
+                    if (f5 == 2 && w5 == 2)
+                        rec.status_msg_id = c.it->intern_locked(s5, l5);
+                    else if (f5 == 3) rec.status_code = (int32_t)v5;
+                }
+                if (!st.ok) return false;
+                break;
+            }
+            default: break;
+        }
+    }
+    if (!cur.ok) return false;
+    if (c.n_spans < c.span_cap) c.spans[c.n_spans] = rec;
+    c.n_spans++;
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Full staging pass. Returns 0 on success, -1 on malformed input. Counts
+// (which may exceed the caps; caller re-calls with bigger buffers and a
+// FRESH scan) are written to n_out[0..3] = spans, span_attrs, res_attrs,
+// resources. Interning is idempotent so a re-scan is safe.
+int32_t otlp_stage(void* interner, const uint8_t* buf, int64_t buflen,
+                   StageRec* spans, int64_t span_cap,
+                   StageAttr* sattrs, int64_t sattr_cap,
+                   StageAttr* rattrs, int64_t rattr_cap,
+                   StageRes* res, int64_t res_cap,
+                   int64_t* n_out) {
+    Interner* it = (Interner*)interner;
+    std::lock_guard<std::mutex> g(it->mu);
+    StageCtx c;
+    c.it = it; c.buf = buf;
+    c.spans = spans; c.span_cap = span_cap;
+    c.sattrs = sattrs; c.sattr_cap = sattr_cap;
+    c.rattrs = rattrs; c.rattr_cap = rattr_cap;
+    c.res = res; c.res_cap = res_cap;
+    static const uint8_t kEmpty = 0;
+    c.empty_id = it->intern_locked(&kEmpty, 0);
+    c.svc_key_id = it->intern_locked((const uint8_t*)"service.name", 12);
+
+    Cursor top{buf, buf + buflen, true};
+    uint32_t f, w; uint64_t v, len; const uint8_t* start;
+    while (read_field(top, f, w, v, start, len)) {
+        if (f != 1 || w != 2) continue;            // ResourceSpans
+        const uint8_t* rm = nullptr; uint64_t rmlen = 0;
+        uint32_t f2, w2; uint64_t v2, l2; const uint8_t* s2;
+        Cursor rs1{start, start + len, true};
+        while (read_field(rs1, f2, w2, v2, s2, l2)) {
+            if (f2 == 1 && w2 == 2) { rm = s2; rmlen = l2; }
+        }
+        if (!rs1.ok) return -1;
+        StageRes r;
+        if (!stage_resource(c, rm, rmlen, r)) return -1;
+        int32_t res_idx = (int32_t)c.n_res;
+        if (c.n_res < c.res_cap) c.res[c.n_res] = r;
+        c.n_res++;
+        Cursor rs{start, start + len, true};
+        while (read_field(rs, f2, w2, v2, s2, l2)) {
+            if (f2 != 2 || w2 != 2) continue;      // ScopeSpans
+            Cursor ss{s2, s2 + l2, true};
+            uint32_t f3, w3; uint64_t v3, l3; const uint8_t* s3;
+            while (read_field(ss, f3, w3, v3, s3, l3)) {
+                if (f3 != 2 || w3 != 2) continue;  // Span
+                if (!stage_span(c, s3, l3, res_idx, r.service_id)) return -1;
+            }
+            if (!ss.ok) return -1;
+        }
+        if (!rs.ok) return -1;
+    }
+    if (!top.ok) return -1;
+    n_out[0] = c.n_spans; n_out[1] = c.n_sattrs;
+    n_out[2] = c.n_rattrs; n_out[3] = c.n_res;
+    return 0;
 }
 
 }  // extern "C"

@@ -71,6 +71,13 @@ class SeriesTable:
         self.active = np.zeros(capacity, bool)
         self.last_seen = np.zeros(capacity, np.float64)
         self.discarded = 0  # combos rejected because the table was full
+        self._nat = None
+        try:
+            from tempo_tpu import native
+            if native.available():
+                self._nat = native.NativeRowTable(n_labels)
+        except Exception:
+            self._nat = None
 
     @property
     def active_count(self) -> int:
@@ -91,6 +98,8 @@ class SeriesTable:
             return out
         if valid is None:
             valid = np.ones(n, bool)
+        if self._nat is not None:
+            return self._lookup_native(rows, now, valid)
         uniq, inverse = np.unique(rows, axis=0, return_inverse=True)
         uslots = np.full(uniq.shape[0], -1, np.int32)
         # Only unique rows that actually appear in valid positions allocate.
@@ -116,12 +125,47 @@ class SeriesTable:
         out[~valid] = -1
         return out
 
+    def _lookup_native(self, rows: np.ndarray, now: float,
+                       valid: np.ndarray) -> np.ndarray:
+        """C++ row-table resolution: one native pass resolves every known
+        combo; only genuinely NEW combos (first occurrence per batch) cross
+        back into Python for slot allocation + budget accounting."""
+        rows = np.ascontiguousarray(rows, np.int32)
+        out, miss = self._nat.lookup(rows, valid)
+        if miss.size:
+            pend: dict[bytes, int] = {}
+            for i in miss.tolist():
+                row = rows[i]
+                key = row.tobytes()
+                if not self._free or (self.budget is not None
+                                      and not self.budget.take()):
+                    self.discarded += 1
+                    self._nat.remove(row)   # pending entry must not linger
+                    pend[key] = -1
+                    continue
+                slot = self._free.pop()
+                self._nat.insert(row, slot)
+                self.slot_keys[slot] = row
+                self.active[slot] = True
+                pend[key] = slot
+                out[i] = slot
+            # duplicates of new combos within this batch resolved host-side
+            unres = np.flatnonzero((out < 0) & valid)
+            for i in unres.tolist():
+                out[i] = pend.get(rows[i].tobytes(), -1)
+        live = out[out >= 0]
+        if live.size:
+            self.last_seen[live] = now
+        return out
+
     def purge_stale(self, older_than: float) -> np.ndarray:
         """Evict series idle since before `older_than`; returns evicted slots."""
         stale = np.flatnonzero(self.active & (self.last_seen < older_than))
         for slot in stale.tolist():
-            key = self.slot_keys[slot].tobytes()
-            self._slots.pop(key, None)
+            if self._nat is not None:
+                self._nat.remove(self.slot_keys[slot])
+            else:
+                self._slots.pop(self.slot_keys[slot].tobytes(), None)
             self.active[slot] = False
             self.slot_keys[slot] = -1
             self._free.append(slot)

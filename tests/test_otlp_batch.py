@@ -129,9 +129,48 @@ def test_fast_path_feeds_spanmetrics_identically():
 def test_fallback_without_native(monkeypatch):
     from tempo_tpu import native as nat
 
-    monkeypatch.setattr(nat, "otlp_scan2", lambda data, cap_hint=4096: None)
+    monkeypatch.setattr(nat, "otlp_stage",
+                        lambda interner, data, cap_hint=4096: None)
     data = _payload()
     it = StringInterner()
     sb = batch_from_otlp(data, it)
     assert sb.n == 51
     assert it.lookup(int(sb.service_id[0])) == "s0"
+
+
+@pytest.mark.skipif(not native.available(), reason="native scanner required")
+def test_service_name_last_occurrence_wins():
+    """Dict semantics: the LAST service.name occurrence wins regardless of
+    value type (regression: the staged path let the last STRING win)."""
+    import time
+
+    t0 = int((time.time() - 5) * 1e9)
+
+    def payload(attr_values) -> bytes:
+        resource = b"".join(
+            enc_field_msg(1, _attr("service.name", v)) for v in attr_values)
+        span = enc_field_msg(2, (
+            enc_field_bytes(1, b"\x01" * 16) + enc_field_bytes(2, b"\x02" * 8) +
+            enc_field_str(5, "op") + enc_field_varint(7, t0) +
+            enc_field_varint(8, t0 + 1000)))
+        return enc_field_msg(1, enc_field_msg(1, resource) +
+                             enc_field_msg(2, span))
+
+    cases = [
+        ([42, "strsvc"], "strsvc"),      # string last → string wins
+        (["strsvc", 42], "42"),          # int last → stringified int wins
+        (["x", True], "True"),           # bool last
+    ]
+    for values, want in cases:
+        data = payload(values)
+        it = StringInterner()
+        sb = batch_from_otlp(data, it)
+        got = it.lookup(int(sb.service_id[0]))
+        assert got == want, (got, want)
+        # and it must match the dict fallback path exactly
+        it2 = StringInterner()
+        b = SpanBatchBuilder(it2)
+        for s in spans_from_otlp_proto(data):
+            b.append(**s)
+        slow = b.build()
+        assert it2.lookup(int(slow.service_id[0])) == want
