@@ -135,6 +135,12 @@ class Handler(BaseHTTPRequestHandler):
             spans = [s for _tid, group in traces for s in group]
             self.app.generator.push_spans(tenant, spans)
             return self._reply(200, b"{}")
+        if path == "/internal/generator/push_otlp":
+            try:
+                n_spans = self.app.generator.push_otlp(tenant, body)
+            except (ValueError, KeyError, TypeError) as e:
+                return self._err(400, f"malformed otlp payload: {e}")
+            return self._reply(200, _json_bytes({"spans": n_spans}))
         if path == "/internal/generator/query_range":
             from tempo_tpu.traceql.engine_metrics import QueryRangeRequest
             d = json.loads(body)
@@ -157,20 +163,25 @@ class Handler(BaseHTTPRequestHandler):
                 return self._err(400, f"bad gzip body: {e}")
         ctype = self.headers.get("Content-Type", "")
         from tempo_tpu.model.otlp import spans_from_otlp_json, spans_from_otlp_proto
+        raw, recs = None, None
         try:
             if "json" in ctype:
                 spans = list(spans_from_otlp_json(json.loads(body)))
             else:
                 from tempo_tpu import native
-                spans = native.spans_from_otlp_proto_native(body)
+                spans, recs = native.spans_from_otlp_proto_native(
+                    body, return_recs=True)
                 if spans is None:  # native layer unavailable
                     spans = list(spans_from_otlp_proto(body))
+                raw = body    # scan order == spans order: tee can slice it
         except (ValueError, KeyError, TypeError) as e:
             # malformed payload is the client's fault (OTLP spec: 400)
             return self._err(400, f"malformed otlp payload: {e}")
         from tempo_tpu.distributor.distributor import RateLimited
         try:
-            errs = self.app.distributor.push_spans(tenant, spans)
+            errs = self.app.distributor.push_spans(tenant, spans,
+                                                   raw_otlp=raw,
+                                                   raw_recs=recs)
         except RateLimited as e:
             self.send_response(429)
             self.send_header("Retry-After", "1")

@@ -35,7 +35,7 @@ class IngesterClient(Protocol):
 
 
 class GeneratorClient(Protocol):
-    def push_spans(self, tenant: str, spans: Sequence[dict]) -> None: ...
+    def push_otlp(self, tenant: str, data: bytes) -> int: ...
 
 
 @dataclasses.dataclass
@@ -99,10 +99,19 @@ class Distributor:
     # -- entry -------------------------------------------------------------
 
     def push_spans(self, tenant: str, spans: Sequence[dict],
-                   size_bytes: int | None = None) -> dict[str, int]:
+                   size_bytes: int | None = None,
+                   raw_otlp: bytes | None = None,
+                   raw_recs: "np.ndarray | None" = None) -> dict[str, int]:
         """The PushTraces path (`distributor.go:398-488`): returns discard
         reason counts for partial failures; raises RateLimited when the
-        tenant bucket is empty."""
+        tenant bucket is empty.
+
+        `raw_otlp` is the original OTLP wire payload when the receiver had
+        one (OTLP http/grpc); the generator tee then forwards raw byte
+        slices instead of re-encoding (`sendToGenerators` ships proto, not
+        dicts). `spans` must be in payload scan order in that case;
+        `raw_recs` is the receiver's native SpanRec scan of the same bytes
+        (passed along so the tee does not scan twice)."""
         lim = self.overrides.for_tenant(tenant)
         sz = size_bytes if size_bytes is not None else _approx_bytes(spans)
         rate = effective_rate(lim.ingestion.rate_strategy,
@@ -116,6 +125,12 @@ class Distributor:
         self.metrics["spans_received_total"] += len(spans)
         self.metrics["bytes_received_total"] += sz
         self.usage.observe(tenant, spans, sz)
+
+        orig_spans = spans
+        if lim.ingestion.max_attribute_bytes:
+            # truncation rewrites attrs; the raw payload no longer matches
+            raw_otlp = None
+            raw_recs = None
 
         spans, errs = self._validate(spans, lim)
         if not spans:
@@ -138,7 +153,9 @@ class Distributor:
         errs2 = self._send_to_ingesters(tenant, groups, tokens, lim)
         for k, v in errs2.items():
             errs[k] = errs.get(k, 0) + v
-        self._send_to_generators(tenant, groups, tokens, lim)
+        self._send_to_generators(tenant, groups, tokens, lim,
+                                 raw_otlp=raw_otlp, raw_recs=raw_recs,
+                                 orig_spans=orig_spans)
         return errs
 
     # -- stages ------------------------------------------------------------
@@ -195,17 +212,59 @@ class Distributor:
 
     def _send_to_generators(self, tenant: str,
                             groups: list[tuple[bytes, list[dict]]],
-                            tokens: np.ndarray, lim) -> None:
+                            tokens: np.ndarray, lim,
+                            raw_otlp: bytes | None = None,
+                            raw_recs: "np.ndarray | None" = None,
+                            orig_spans: Sequence[dict] | None = None) -> None:
         """Tee traces to metrics-generators (RF1, best-effort — generator
-        loss degrades metrics, not trace durability; `distributor.go:563`)."""
+        loss degrades metrics, not trace durability; `distributor.go:563`).
+
+        Always OTLP bytes on the wire (PushOTLP → the generator's
+        vectorized staging): raw payload slices when the receiver handed
+        one over, re-encoded from the span dicts otherwise. The per-span
+        dict JSON tee is gone — it paid a triple decode (VERDICT r2 #10)."""
         if self.generator_ring is None or not self.generator_clients:
             return
         if not lim.generator.processors:
             return
 
+        # original-order index per span object: maps validated dicts back
+        # to raw wire slices without annotating them. Built only here —
+        # the bus path and processor-less tenants never pay for it.
+        recs = None
+        n_scanned = -1
+        wi_by_id: dict[int, int] = {}
+        if raw_otlp is not None and orig_spans is not None:
+            recs = raw_recs
+            if recs is None:
+                from tempo_tpu import native
+                try:
+                    recs = native.otlp_scan(raw_otlp)
+                except ValueError:
+                    recs = None
+            if recs is not None:
+                n_scanned = len(recs)
+                if n_scanned != len(orig_spans):
+                    recs = None    # decode disagreement: re-encode instead
+                else:
+                    wi_by_id = {id(s): i for i, s in enumerate(orig_spans)}
+
+        from tempo_tpu.model.otlp import encode_spans_otlp, slice_otlp_payload
+
         def send(inst: InstanceDesc, items: list[int]) -> None:
+            client = self.generator_clients[inst.id]
+            if recs is not None:
+                wis = [wi_by_id.get(id(s))
+                       for i in items for s in groups[i][1]]
+                if None not in wis:
+                    if len(wis) == n_scanned:
+                        client.push_otlp(tenant, raw_otlp)   # single target
+                    else:
+                        client.push_otlp(
+                            tenant, slice_otlp_payload(raw_otlp, recs, wis))
+                    return
             spans = [s for i in items for s in groups[i][1]]
-            self.generator_clients[inst.id].push_spans(tenant, spans)
+            client.push_otlp(tenant, encode_spans_otlp(spans))
 
         try:
             do_batch(self.generator_ring, tokens, list(range(len(groups))),

@@ -70,7 +70,8 @@ class _Services:
         from tempo_tpu.model.otlp import spans_from_otlp_proto
 
         try:
-            spans = native.spans_from_otlp_proto_native(request)
+            spans, recs = native.spans_from_otlp_proto_native(
+                request, return_recs=True)
             if spans is None:
                 spans = list(spans_from_otlp_proto(request))
         except (ValueError, KeyError, TypeError) as e:
@@ -79,7 +80,8 @@ class _Services:
         from tempo_tpu.distributor.distributor import RateLimited
 
         try:
-            self.app.distributor.push_spans(tenant, spans)
+            self.app.distributor.push_spans(tenant, spans,
+                                            raw_otlp=request, raw_recs=recs)
         except RateLimited as e:
             # the reference translates rate limits to ResourceExhausted with
             # RetryInfo so SDK exporters back off (shim.go RetryableError)

@@ -186,3 +186,109 @@ def spans_from_otlp_proto(data: bytes):
                 if kvs:
                     span["attrs"] = _pb_attrs(kvs)
                 yield span
+
+
+# ---------------------------------------------------------------------------
+# OTLP/protobuf encoding (the distributor→generator tee wire shape)
+# ---------------------------------------------------------------------------
+
+def _enc_anyvalue(v: Any) -> bytes:
+    if isinstance(v, bool):
+        return pw.enc_field_varint(2, 1 if v else 0)
+    if isinstance(v, int):
+        return pw.enc_field_varint(3, v & ((1 << 64) - 1))
+    if isinstance(v, float):
+        return pw.enc_field_double(4, v)
+    if isinstance(v, bytes):
+        return pw.enc_field_bytes(7, v)
+    return pw.enc_field_str(1, str(v))
+
+
+def _enc_attrs(fnum: int, attrs: dict[str, Any] | None) -> bytes:
+    if not attrs:
+        return b""
+    return b"".join(
+        pw.enc_field_msg(fnum, pw.enc_field_str(1, k) +
+                         pw.enc_field_msg(2, _enc_anyvalue(v)))
+        for k, v in attrs.items())
+
+
+def encode_spans_otlp(spans: Iterable[dict]) -> bytes:
+    """Flat span dicts → ExportTraceServiceRequest bytes.
+
+    The inverse of `spans_from_otlp_proto`, used when the distributor tees
+    spans that did not arrive as raw OTLP (Zipkin/Jaeger receivers, or
+    after attribute truncation) — the tee is always OTLP on the wire
+    (`sendToGenerators` `distributor.go:563` ships tempopb ResourceSpans).
+    Spans are grouped into ResourceSpans by res_attrs content.
+    """
+    groups: dict[tuple, list[dict]] = {}
+    for s in spans:
+        ra = s.get("res_attrs") or {}
+        if not ra and s.get("service"):
+            ra = {"service.name": s["service"]}
+        key = tuple(sorted((k, repr(v)) for k, v in ra.items()))
+        groups.setdefault(key, []).append(s)
+    out = []
+    for _, group in groups.items():
+        ra = group[0].get("res_attrs") or {}
+        if not ra and group[0].get("service"):
+            ra = {"service.name": group[0]["service"]}
+        span_bufs = []
+        for s in group:
+            status = b""
+            if s.get("status_message"):
+                status += pw.enc_field_str(2, s["status_message"])
+            if s.get("status_code"):
+                status += pw.enc_field_varint(3, int(s["status_code"]))
+            b = (pw.enc_field_bytes(1, s.get("trace_id", b"")) +
+                 pw.enc_field_bytes(2, s.get("span_id", b"")))
+            if s.get("parent_span_id"):
+                b += pw.enc_field_bytes(4, s["parent_span_id"])
+            b += pw.enc_field_str(5, s.get("name", ""))
+            if s.get("kind"):
+                b += pw.enc_field_varint(6, int(s["kind"]))
+            # fields 7/8 are fixed64 in trace.proto (varint would decode as
+            # unknown fields in conformant consumers)
+            b += (pw.enc_field_fixed64(7, int(s.get("start_unix_nano", 0))) +
+                  pw.enc_field_fixed64(8, int(s.get("end_unix_nano", 0))) +
+                  _enc_attrs(9, s.get("attrs")))
+            if status:
+                b += pw.enc_field_msg(15, status)
+            span_bufs.append(pw.enc_field_msg(2, b))
+        rs = (pw.enc_field_msg(1, _enc_attrs(1, ra)) +
+              pw.enc_field_msg(2, b"".join(span_bufs)))
+        out.append(pw.enc_field_msg(1, rs))
+    return b"".join(out)
+
+
+def slice_otlp_payload(raw: bytes, recs, wire_indices) -> bytes:
+    """Rebuild an OTLP payload containing only `wire_indices` spans, by
+    concatenating raw wire slices (no re-encoding). `recs` is the native
+    scan's SpanRec array over `raw` (span_off/span_len + res_off/res_len
+    byte ranges). The per-instance splitter of the generator tee — the
+    analog of the per-trace proto re-marshal in `sendToGenerators`."""
+    out = []
+    cur_res: tuple[int, int] | None = None
+    span_bufs: list[bytes] = []
+
+    def flush() -> None:
+        if not span_bufs:
+            return
+        ro, rl = cur_res
+        rs = b""
+        if ro >= 0:
+            rs += pw.enc_field_msg(1, raw[ro:ro + rl])
+        rs += pw.enc_field_msg(2, b"".join(span_bufs))
+        out.append(pw.enc_field_msg(1, rs))
+        span_bufs.clear()
+
+    for i in sorted(wire_indices):
+        res = (int(recs["res_off"][i]), int(recs["res_len"][i]))
+        if res != cur_res:
+            flush()
+            cur_res = res
+        o, ln = int(recs["span_off"][i]), int(recs["span_len"][i])
+        span_bufs.append(pw.enc_field_msg(2, raw[o:o + ln]))
+    flush()
+    return b"".join(out)

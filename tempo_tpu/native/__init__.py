@@ -439,13 +439,16 @@ def otlp_stage(interner: "NativeInterner", data: bytes,
         rcap, rescap = max(rcap, nr), max(rescap, nres)
 
 
-def spans_from_otlp_proto_native(data: bytes):
+def spans_from_otlp_proto_native(data: bytes, return_recs: bool = False):
     """Native scan → flat span dicts (the wire-entry contract of
     `model.otlp.spans_from_otlp_proto`). The C pass extracts every fixed
-    field and attribute range; python only slices strings and builds dicts."""
+    field and attribute range; python only slices strings and builds dicts.
+    With `return_recs` returns (dicts, SpanRec array) so the caller can
+    reuse the wire offsets (the distributor tee slices raw payloads with
+    them) without a second scan."""
     scanned = otlp_scan2(data)
     if scanned is None:
-        return None
+        return (None, None) if return_recs else None
     recs, attrs = scanned
     from tempo_tpu.model.otlp import _pb_anyvalue
 
@@ -525,4 +528,4 @@ def spans_from_otlp_proto_native(data: bytes):
             v = _pb_anyvalue(data[a_sval_off[j]: a_sval_off[j] + a_sval_len[j]]) \
                 if a_sval_off[j] >= 0 else None
         out[a_span[j]]["attrs"][k] = v
-    return out
+    return (out, recs) if return_recs else out

@@ -15,6 +15,7 @@ answer to dskit's per-key ring walks.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Any, Callable, Sequence
 
@@ -63,6 +64,82 @@ class ReplicationSet:
         return len(self.instances) - self.max_errors
 
 
+class _RingState:
+    """One immutable membership snapshot: instance map + derived token
+    tables + lazily built walk tables. Readers grab `ring._state` once and
+    work off a consistent view — the KV poller thread publishes a NEW
+    snapshot with a single attribute assignment, so a lookup can never see
+    fresh ids with stale owners (ADVICE r2 #1)."""
+
+    __slots__ = ("instances", "ids", "tokens", "owners", "walk_cache",
+                 "shuffle_cache")
+
+    def __init__(self, instances: dict[str, InstanceDesc]) -> None:
+        self.instances = instances
+        ids, toks, owners = [], [], []
+        for idx, inst in enumerate(sorted(instances.values(),
+                                          key=lambda i: i.id)):
+            ids.append(inst.id)
+            toks.append(inst.tokens)
+            owners.append(np.full(len(inst.tokens), idx, np.int64))
+        self.ids = ids
+        if toks and sum(len(t) for t in toks):
+            all_t = np.concatenate(toks)
+            all_o = np.concatenate(owners)
+            order = np.argsort(all_t, kind="stable")
+            self.tokens = all_t[order]
+            self.owners = all_o[order]
+        else:
+            self.tokens = np.zeros(0, np.uint32)
+            self.owners = np.zeros(0, np.int64)
+        # rf -> per-token-position replication member ids (health-agnostic)
+        self.walk_cache: dict[int, list[list[str]]] = {}
+        # (tenant, size) -> shuffle-sharded sub-Ring for THIS snapshot
+        self.shuffle_cache: dict[tuple[str, int], "Ring"] = {}
+
+    def walk_from(self, start: int, rf: int) -> list[InstanceDesc]:
+        """Clockwise walk from ring position `start` collecting rf distinct
+        instances (distinct zones first when zones are in play, like dskit
+        zone-awareness)."""
+        picked: list[InstanceDesc] = []
+        seen_ids: set[str] = set()
+        seen_zones: set[str] = set()
+        distinct = len({i.zone for i in self.instances.values()})
+        for off in range(len(self.tokens)):
+            idx = (start + off) % len(self.tokens)
+            inst = self.instances[self.ids[int(self.owners[idx])]]
+            if inst.id in seen_ids:
+                continue
+            if inst.zone and distinct >= rf and inst.zone in seen_zones:
+                continue
+            seen_ids.add(inst.id)
+            seen_zones.add(inst.zone)
+            picked.append(inst)
+            if len(picked) == rf:
+                break
+        return picked
+
+    def walk_table(self, rf: int) -> list[list[str]]:
+        """Replication member ids per ring position, built once per
+        snapshot: replica sets depend only on WHERE a token lands, so a
+        batch of any size resolves with one searchsorted plus a unique over
+        at most len(self.tokens) positions. Racing builders may duplicate
+        work; the dict write is atomic either way."""
+        tab = self.walk_cache.get(rf)
+        if tab is None:
+            tab = [[i.id for i in self.walk_from(p, rf)]
+                   for p in range(len(self.tokens))]
+            self.walk_cache[rf] = tab
+        return tab
+
+    def walk(self, token: int, rf: int) -> list[InstanceDesc]:
+        if len(self.tokens) == 0:
+            return []
+        start = int(np.searchsorted(self.tokens, token, side="left")) \
+            % len(self.tokens)
+        return self.walk_from(start, rf)
+
+
 class Ring:
     """The ring view: sorted token table → owning instances."""
 
@@ -75,10 +152,8 @@ class Ring:
         self.rf = replication_factor
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.now = now
-        self._instances: dict[str, InstanceDesc] = {}
-        self._tokens = np.zeros(0, np.uint32)
-        self._owners = np.zeros(0, np.int64)   # token idx -> instance index
-        self._ids: list[str] = []
+        self._state = _RingState({})
+        self._wlock = threading.Lock()   # writers only; readers are lockless
         if kv is not None:
             kv.watch_key(key, self._on_update)
             cur = kv.get(key)
@@ -87,35 +162,26 @@ class Ring:
 
     # -- membership --------------------------------------------------------
 
+    @property
+    def _instances(self) -> dict[str, InstanceDesc]:
+        return self._state.instances
+
     def _on_update(self, desc_map: dict[str, InstanceDesc]) -> None:
-        self._instances = dict(desc_map)
-        self._rebuild()
+        with self._wlock:
+            self._state = _RingState(dict(desc_map))
 
     def register(self, inst: InstanceDesc) -> None:
         """Local registration (tests / single-binary); Lifecycler for KV."""
-        self._instances[inst.id] = inst
-        self._rebuild()
+        with self._wlock:
+            m = dict(self._state.instances)
+            m[inst.id] = inst
+            self._state = _RingState(m)
 
     def unregister(self, instance_id: str) -> None:
-        self._instances.pop(instance_id, None)
-        self._rebuild()
-
-    def _rebuild(self) -> None:
-        ids, toks, owners = [], [], []
-        for idx, inst in enumerate(sorted(self._instances.values(), key=lambda i: i.id)):
-            ids.append(inst.id)
-            toks.append(inst.tokens)
-            owners.append(np.full(len(inst.tokens), idx, np.int64))
-        self._ids = ids
-        if toks and sum(len(t) for t in toks):
-            all_t = np.concatenate(toks)
-            all_o = np.concatenate(owners)
-            order = np.argsort(all_t, kind="stable")
-            self._tokens = all_t[order]
-            self._owners = all_o[order]
-        else:
-            self._tokens = np.zeros(0, np.uint32)
-            self._owners = np.zeros(0, np.int64)
+        with self._wlock:
+            m = dict(self._state.instances)
+            m.pop(instance_id, None)
+            self._state = _RingState(m)
 
     def healthy(self, inst: InstanceDesc) -> bool:
         if inst.state != ACTIVE:
@@ -125,52 +191,26 @@ class Ring:
         return self.now() - inst.heartbeat_ts <= self.heartbeat_timeout_s
 
     def instances(self) -> list[InstanceDesc]:
-        return [self._instances[i] for i in self._ids]
+        st = self._state
+        return [st.instances[i] for i in st.ids]
 
     def instance(self, instance_id: str) -> InstanceDesc | None:
-        return self._instances.get(instance_id)
+        return self._state.instances.get(instance_id)
 
     def healthy_instances(self) -> list[InstanceDesc]:
         return [i for i in self.instances() if self.healthy(i)]
 
     def __len__(self) -> int:
-        return len(self._instances)
+        return len(self._state.instances)
 
     # -- lookups -----------------------------------------------------------
 
     def _walk(self, token: int, rf: int) -> list[InstanceDesc]:
-        """Clockwise walk collecting rf distinct instances (distinct zones
-        first when zones are in play, like dskit zone-awareness)."""
-        if len(self._tokens) == 0:
-            return []
-        start = int(np.searchsorted(self._tokens, token, side="left")) % len(self._tokens)
-        picked: list[InstanceDesc] = []
-        seen_ids: set[str] = set()
-        seen_zones: set[str] = set()
-        distinct = len({i.zone for i in self._instances.values()})
-        for off in range(len(self._tokens)):
-            idx = (start + off) % len(self._tokens)
-            inst = self._instances[self._ids[int(self._owners[idx])]]
-            if inst.id in seen_ids:
-                continue
-            if inst.zone and distinct >= rf and inst.zone in seen_zones:
-                continue
-            seen_ids.add(inst.id)
-            seen_zones.add(inst.zone)
-            picked.append(inst)
-            if len(picked) == rf:
-                break
-        return picked
+        return self._state.walk(token, rf)
 
-    def get(self, token: int, rf: int | None = None) -> ReplicationSet:
-        """Replication set for one token, filtered to healthy instances.
-
-        max_errors follows dskit: tolerate (rf - quorum) failures where
-        quorum = rf//2 + 1; unhealthy instances eat into the error budget
-        (`distributor.go:826-887` per-trace quorum accounting).
-        """
-        rf = rf or self.rf
-        full = self._walk(token, rf)
+    def _set_at(self, st: _RingState, pos: int, rf: int) -> ReplicationSet:
+        """ReplicationSet for ring position `pos`, health-filtered now."""
+        full = [st.instances[iid] for iid in st.walk_table(rf)[pos]]
         if not full:
             # an empty ring can never satisfy quorum — failing loudly beats
             # a ReplicationSet of nobody that "succeeds" while dropping data
@@ -185,13 +225,38 @@ class Ring:
                 f"too many unhealthy instances ({len(full) - len(healthy)}/{len(full)})")
         return ReplicationSet(healthy, max_errors)
 
+    def get(self, token: int, rf: int | None = None) -> ReplicationSet:
+        """Replication set for one token, filtered to healthy instances.
+
+        max_errors follows dskit: tolerate (rf - quorum) failures where
+        quorum = rf//2 + 1; unhealthy instances eat into the error budget
+        (`distributor.go:826-887` per-trace quorum accounting).
+        """
+        rf = rf or self.rf
+        st = self._state
+        if len(st.tokens) == 0:
+            raise RuntimeError("ring is empty: no instances registered")
+        pos = int(np.searchsorted(st.tokens, token, side="left")) \
+            % len(st.tokens)
+        return self._set_at(st, pos, rf)
+
     def batch_lookup(self, tokens: np.ndarray, rf: int | None = None
                      ) -> tuple[list[ReplicationSet], np.ndarray]:
-        """Vectorized: unique primary owner per token via one searchsorted;
-        returns per-unique-token ReplicationSets + inverse index [len(tokens)]."""
+        """Vectorized: one searchsorted maps every token to its ring
+        position; replica sets materialize per unique POSITION (≤ total
+        token count of the ring, independent of batch size). Returns
+        per-unique-position ReplicationSets + inverse index [len(tokens)]."""
         rf = rf or self.rf
-        uniq, inverse = np.unique(np.asarray(tokens, np.uint32), return_inverse=True)
-        return [self.get(int(t), rf) for t in uniq], inverse
+        st = self._state
+        tokens = np.asarray(tokens, np.uint32)
+        if len(st.tokens) == 0:
+            if len(tokens):
+                raise RuntimeError("ring is empty: no instances registered")
+            return [], np.zeros(0, np.int64)
+        pos = np.searchsorted(st.tokens, tokens, side="left") \
+            % len(st.tokens)
+        uniq, inverse = np.unique(pos, return_inverse=True)
+        return [self._set_at(st, int(p), rf) for p in uniq], inverse
 
     def owns(self, member_id: str, key: str | int) -> bool:
         """Ring-job ownership: does member_id own hash(key)?  The compactor
@@ -200,8 +265,9 @@ class Ring:
         Ownership walks past UNHEALTHY instances: a crashed peer's job
         share fails over to the next live instance instead of black-holing
         until the stale descriptor is removed."""
+        st = self._state
         token = key if isinstance(key, int) else _hash_str(str(key))
-        for inst in self._walk(token, len(self._instances) or 1):
+        for inst in st.walk(token, len(st.instances) or 1):
             if self.healthy(inst):
                 return inst.id == member_id
         return False
@@ -215,28 +281,34 @@ class Ring:
         seed tokens derived from the tenant pick spread-out instances, so a
         tenant's blast radius is its shard, not the whole ring.
         """
-        if size <= 0 or size >= len(self._instances):
+        st = self._state
+        if size <= 0 or size >= len(st.instances):
             return self
-        sub = Ring(replication_factor=self.rf,
-                   heartbeat_timeout_s=self.heartbeat_timeout_s, now=self.now)
+        cached = st.shuffle_cache.get((tenant, size))
+        if cached is not None:
+            return cached
         seed = _hash_str(tenant)
         rng = np.random.default_rng(seed)
         picked: set[str] = set()
-        # _walk only returns token-owning instances: cap the target at that
+        # walk only returns token-owning instances: cap the target at that
         # count (a zero-token registrant would otherwise never be picked and
         # the loop would spin forever) and bound iterations as a backstop
-        owners = {i.id for i in self._instances.values() if len(i.tokens)}
+        owners = {i.id for i in st.instances.values() if len(i.tokens)}
         target = min(size, len(owners))
         for _ in range(64 * max(target, 1)):
             if len(picked) >= target:
                 break
             tok = int(rng.integers(0, 2**32))
-            for inst in self._walk(tok, len(self._instances)):
+            for inst in st.walk(tok, len(st.instances)):
                 if inst.id not in picked:
                     picked.add(inst.id)
                     break
-        for iid in picked:
-            sub.register(self._instances[iid])
+        sub = Ring(replication_factor=self.rf,
+                   heartbeat_timeout_s=self.heartbeat_timeout_s, now=self.now)
+        sub._state = _RingState({iid: st.instances[iid] for iid in picked})
+        # cached per parent snapshot: a membership change builds a fresh
+        # _RingState, so stale shards (and their walk tables) die with it
+        st.shuffle_cache[(tenant, size)] = sub
         return sub
 
 
@@ -296,32 +368,32 @@ def do_batch(ring: Ring, tokens: np.ndarray, indexes: Sequence[Any],
     """
     sets, inverse = ring.batch_lookup(tokens, rf)
     by_instance: dict[str, tuple[InstanceDesc, list[Any]]] = {}
-    item_quorum = np.zeros(len(sets), np.int64)
     item_maxerr = np.array([rs.max_errors for rs in sets], np.int64)
-    members: list[list[str]] = []
     for ui, rs in enumerate(sets):
-        members.append([i.id for i in rs.instances])
         for inst in rs.instances:
             by_instance.setdefault(inst.id, (inst, []))[1].append(ui)
 
+    # group item positions by unique ring position once (argsort), instead
+    # of one O(n) scan per unique position per replica
+    order = np.argsort(inverse, kind="stable")
+    counts = np.bincount(inverse, minlength=len(sets))
+    bounds = np.zeros(len(sets) + 1, np.int64)
+    np.cumsum(counts, out=bounds[1:])
+
     failures = np.zeros(len(sets), np.int64)
-    successes = np.zeros(len(sets), np.int64)
     errs: list[Exception] = []
     for iid, (inst, uis) in by_instance.items():
-        items = [[indexes[j] for j in np.nonzero(inverse == ui)[0]] for ui in uis]
-        flat = [x for sub in items for x in sub]
+        flat = [indexes[j]
+                for ui in uis
+                for j in order[bounds[ui]:bounds[ui + 1]].tolist()]
         try:
             send(inst, flat)
         except Exception as e:  # instance failed: charge every item it held
             errs.append(e)
             for ui in uis:
                 failures[ui] += 1
-        else:
-            for ui in uis:
-                successes[ui] += 1
     bad = failures > item_maxerr
     if bad.any():
         raise RuntimeError(
             f"{int(bad.sum())} item group(s) failed quorum "
             f"(first error: {errs[0] if errs else 'n/a'})")
-    del item_quorum, members

@@ -95,6 +95,11 @@ class RemoteGeneratorClient(_BaseClient):
             encode_push(list(groups.items()), max_record_bytes=1 << 62))
         self._post("/internal/generator/push", body, tenant)
 
+    def push_otlp(self, tenant: str, data: bytes) -> int:
+        res = self._post("/internal/generator/push_otlp", data, tenant,
+                         ctype="application/x-protobuf")
+        return int(res.get("spans", 0))
+
     def query_range(self, tenant: str, req, clip_start_ns: int | None = None):
         from tempo_tpu.traceql.engine_metrics import TimeSeries
         import numpy as np

@@ -158,8 +158,11 @@ def test_distributor_bus_replaces_generator_tee():
     class CapturingGen:
         def __init__(self):
             self.spans = []
-        def push_spans(self, tenant, spans):
-            self.spans.extend(spans)
+        def push_otlp(self, tenant, data):
+            from tempo_tpu.model.otlp import spans_from_otlp_proto
+            got = list(spans_from_otlp_proto(data))
+            self.spans.extend(got)
+            return len(got)
 
     class NullIng:
         def __init__(self):

@@ -223,10 +223,15 @@ def test_generator_tee(rig):
     t, now, backend, ring, ingesters, dist = rig
 
     class CapturingGen:
+        """Tee protocol: OTLP bytes on the wire (PushOTLP), decoded here to
+        count what arrived."""
         def __init__(self):
             self.spans = []
-        def push_spans(self, tenant, spans):
-            self.spans.extend(spans)
+        def push_otlp(self, tenant, data):
+            from tempo_tpu.model.otlp import spans_from_otlp_proto
+            got = list(spans_from_otlp_proto(data))
+            self.spans.extend(got)
+            return len(got)
 
     gens = {"gen-0": CapturingGen(), "gen-1": CapturingGen()}
     gring = Ring(replication_factor=1, now=now)
@@ -243,3 +248,52 @@ def test_generator_tee(rig):
     total = sum(len(g.spans) for g in gens.values())
     assert total == 20          # RF1: each span at exactly one generator
     assert all(len(g.spans) > 0 for g in gens.values())  # spread over both
+
+
+def test_generator_tee_raw_otlp_slicing(rig):
+    """An OTLP receiver hands the raw payload to push_spans; the tee must
+    forward raw wire slices (no re-encode) partitioned per generator, with
+    content identical to the decoded spans."""
+    import numpy as np
+
+    from tempo_tpu import native
+    from tempo_tpu.model.otlp import encode_spans_otlp, spans_from_otlp_proto
+
+    t, now, backend, ring, ingesters, dist = rig
+
+    class CapturingGen:
+        def __init__(self):
+            self.spans = []
+        def push_otlp(self, tenant, data):
+            got = list(spans_from_otlp_proto(data))
+            self.spans.extend(got)
+            return len(got)
+
+    gens = {"gen-0": CapturingGen(), "gen-1": CapturingGen()}
+    gring = Ring(replication_factor=1, now=now)
+    for gid in gens:
+        gring.register(InstanceDesc(id=gid, state=ACTIVE,
+                                    tokens=_instance_tokens(gid, 64),
+                                    heartbeat_ts=now()))
+    dist.generator_ring = gring
+    dist.generator_clients = gens
+    dist.overrides.set_tenant_patch(
+        "t1", {"generator": {"processors": ["span-metrics"]}})
+
+    src = [mkspan(bytes([i]) * 16, b"\x01" * 8,
+                  attrs={"http.status_code": 200 + i},
+                  res_attrs={"service.name": f"svc-{i % 3}"})
+           for i in range(1, 21)]
+    raw = encode_spans_otlp(src)
+    decoded = list(spans_from_otlp_proto(raw))
+    assert len(decoded) == 20
+    dist.push_spans("t1", decoded, raw_otlp=raw)
+
+    got = sorted((s["trace_id"], s) for g in gens.values() for s in g.spans)
+    want = sorted((s["trace_id"], s) for s in decoded)
+    assert len(got) == 20
+    for (gt, gs), (wt, ws) in zip(got, want):
+        assert gt == wt
+        assert gs == ws          # full span dict round-trips the slice
+    if native.available():
+        assert all(len(g.spans) > 0 for g in gens.values())
