@@ -276,8 +276,57 @@ def bench_query() -> dict:
         for _ in range(3):
             search()
         s_ms = (time.time() - t0) / 3 * 1000
+        scan = _bench_scan_plane(db)
         db.shutdown()
-    return {"query_range_ms": qr_ms, "search_ms": s_ms}
+    return {"query_range_ms": qr_ms, "search_ms": s_ms, **scan}
+
+
+def _bench_scan_plane(db) -> dict:
+    """Fetch-path predicate plane on ≥1M spans scanned from the written
+    block: the device-resident BlockScanPlane (dictionary-coded columns
+    uploaded once, one fused dispatch per block per query) vs the numpy
+    mask loop (ref `block_traceql.go:1538` condition compilation)."""
+    import os
+
+    from tempo_tpu.block.device_scan import BlockScanPlane
+    from tempo_tpu.block.fetch import condition_mask, scan_views
+    from tempo_tpu.block.reader import BackendBlock
+    from tempo_tpu.traceql.conditions import extract_conditions
+    from tempo_tpu.traceql.parser import parse
+
+    req = extract_conditions(parse('{ name =~ "op-1." && duration > 20ms }'))
+    preds = [c for c in req.conditions if c.op is not None]
+    views = []
+    for m in db.blocklist.metas("bench"):
+        for view, _ in scan_views(BackendBlock(db.r, m)):
+            views.append(view)
+    n_rows = sum(v.n for v in views)
+    # scale the scan to >= 1M spans: the device plane evaluates the WHOLE
+    # scan as one resident fused dispatch; numpy walks the same rows
+    reps = max(1, (1_000_000 + n_rows - 1) // n_rows)
+    scan_views_list = views * reps
+    out = {"scan_spans": n_rows * reps}
+
+    os.environ["TEMPO_TPU_DEVICE_SCAN"] = "0"
+    np_masks = [condition_mask(v, req) for v in scan_views_list]  # warmup
+    t0 = time.time()
+    np_masks = [condition_mask(v, req) for v in scan_views_list]
+    out["scan_numpy_ms"] = (time.time() - t0) * 1000
+    os.environ.pop("TEMPO_TPU_DEVICE_SCAN", None)
+
+    plane = BlockScanPlane(scan_views_list)  # one-time column upload
+    dev_mask = plane.mask(preds, req.all_conditions)     # compile warmup
+    if dev_mask is None:
+        out["scan_device_ms"] = None
+        return out
+    t0 = time.time()
+    dev_mask = plane.mask(preds, req.all_conditions)
+    out["scan_device_ms"] = (time.time() - t0) * 1000
+    out["scan_masks_equal"] = bool(
+        (np.concatenate(np_masks) == dev_mask).all())
+    out["scan_device_spans_per_sec"] = out["scan_spans"] / (
+        out["scan_device_ms"] / 1000)
+    return out
 
 
 # --- orchestrator ----------------------------------------------------------
@@ -382,6 +431,11 @@ def main() -> int:
         if "query_range_ms" in results else None,
         "search_100k_spans_ms": round(results["search_ms"], 1)
         if "search_ms" in results else None,
+        "scan_device_ms": round(results["scan_device_ms"], 1)
+        if "scan_device_ms" in results else None,
+        "scan_numpy_ms": round(results["scan_numpy_ms"], 1)
+        if "scan_numpy_ms" in results else None,
+        "scan_spans": results.get("scan_spans"),
     }
     if errors:
         extra["errors"] = errors

@@ -1,0 +1,324 @@
+"""Device predicate plane for block scans.
+
+The storage-level first pass (`condition_mask`) evaluated every pushdown
+predicate as a numpy mask over object-dtype string columns — the hot loop
+of SURVEY §3.3 (ref `block_traceql.go:1538` compiling conditions into
+per-value predicate iterators, `parquetquery/predicates.go:15`) never
+touched the chip. Here the dictionary-coded form of the scan does:
+
+- string columns stay dictionary-coded (parquet already stores them that
+  way): codes are an int32 device column; a predicate becomes a tiny
+  boolean lookup table built on host over the DICTIONARY (|dict| entries,
+  not |rows|) — equality and full regex both cost O(|dict|) host work —
+  then one device gather. This is the reference's dictionary-page
+  predicate pushdown (`predicates.go` `*DictionaryPredicate`) turned into
+  a gather instead of a page scan.
+- numeric intrinsics (duration, kind, status, nested-set coords) compare
+  as device vectors against the literal.
+- masks AND/OR-combine on device; one transfer returns the final mask.
+
+Comparisons run in float32 on device (TPU has no f64): a value within
+~6e-8 relative distance of a numeric literal may flip versus the exact
+numpy path. Set TEMPO_TPU_DEVICE_SCAN=0 to force the numpy plane.
+
+Unsupported shapes (attribute-list columns, non-literal operands) return
+None and the caller falls back to the numpy mask loop.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import re
+from typing import Optional, Sequence
+
+import numpy as np
+
+from tempo_tpu.traceql import ast as A
+
+_NUM_OPS = {A.Op.EQ, A.Op.NEQ, A.Op.GT, A.Op.GTE, A.Op.LT, A.Op.LTE}
+_STR_OPS = {A.Op.EQ, A.Op.NEQ, A.Op.REGEX, A.Op.NOT_REGEX}
+
+_NUM_INTRINSICS = {
+    A.Intrinsic.DURATION: "duration",
+    A.Intrinsic.KIND: "kind",
+    A.Intrinsic.STATUS: "status",
+    A.Intrinsic.NESTED_SET_LEFT: "nestedSetLeft",
+    A.Intrinsic.NESTED_SET_RIGHT: "nestedSetRight",
+    A.Intrinsic.NESTED_SET_PARENT: "nestedSetParent",
+}
+
+
+def enabled() -> bool:
+    """Per-row-group sync offload policy for `condition_mask` — OPT-IN
+    (TEMPO_TPU_DEVICE_SCAN=1). Two reasons it is not the default: each
+    synchronous mask pays a full device round trip (ruinous through a
+    high-latency accelerator link), and numeric compares run in float32,
+    which can flip values within ~6e-8 relative distance of a literal
+    versus the exact float64 numpy plane. The block-level
+    `BlockScanPlane` (explicit API, one fused dispatch per block) is the
+    production device plane."""
+    return os.environ.get("TEMPO_TPU_DEVICE_SCAN", "") == "1"
+
+
+def _dict_term(op: A.Op, v, dvals: list) :
+    """Compile a string predicate over dictionary values into a (sig
+    entry, lut) pair; None when the shape is unsupported. Regexes are
+    ANCHORED (fullmatch), matching `eval.regex_match_col` / pkg/regexp."""
+    if op not in _STR_OPS or not isinstance(v, str):
+        return None
+    if op in (A.Op.EQ, A.Op.NEQ):
+        matched = [i for i, s in enumerate(dvals) if s == v]
+    else:
+        try:
+            rx = re.compile(v)
+        except re.error:
+            return None
+        matched = [i for i, s in enumerate(dvals) if rx.fullmatch(s)]
+    lut = np.zeros(len(dvals) + 1, bool)       # last slot: null -> False
+    if matched:
+        lut[np.asarray(matched)] = True
+    return ("lut", None, op in (A.Op.NEQ, A.Op.NOT_REGEX)), lut
+
+
+def _num_term(op: A.Op, v):
+    """(sig entry, float literal) for a numeric compare; None otherwise."""
+    if op not in _NUM_OPS or isinstance(v, (str, bytes)):
+        return None
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    return ("cmp", op, False), f
+
+
+def _dict_codes(view, key: str, arrow_col):
+    """(codes[int32] with nulls mapped to |dict|, dict values) — cached on
+    the view; the arrow column is usually already dictionary-encoded on
+    disk, so this is an index copy, not a re-encode."""
+    cache = view.meta.setdefault("_dict_codes", {})
+    got = cache.get(key)
+    if got is None:
+        import pyarrow as pa
+
+        arr = arrow_col
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        d = arr.dictionary_encode() if not pa.types.is_dictionary(arr.type) \
+            else arr
+        if isinstance(d, pa.ChunkedArray):
+            d = d.combine_chunks()
+        vals = ["" if v is None else str(v) for v in d.dictionary.to_pylist()]
+        idx = d.indices.to_numpy(zero_copy_only=False)
+        codes = np.where(np.isnan(idx), len(vals), idx).astype(np.int32) \
+            if idx.dtype.kind == "f" else np.asarray(idx, np.int32)
+        got = cache[key] = (codes, vals)
+    return got
+
+
+def _col_for(view, attr: A.Attribute):
+    """("dict", key, codes, dictvals) | ("num", key, values) | None."""
+    if attr.intrinsic == A.Intrinsic.NAME:
+        c = view.meta.get("name_col")
+        if c is not None:
+            return ("dict", "name") + _dict_codes(view, "name", c)
+    if (attr.intrinsic == A.Intrinsic.NONE and attr.name == "service.name"
+            and attr.scope in (A.Scope.RESOURCE, A.Scope.NONE)):
+        c = view.meta.get("service_col")
+        if c is not None:
+            return ("dict", "service") + _dict_codes(view, "service", c)
+    key = _NUM_INTRINSICS.get(attr.intrinsic)
+    if key:
+        col = view.col(key)
+        if col is not None:
+            return ("num", key, col.values)
+    return None
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_mask(sig: tuple, all_conditions: bool):
+    """One fused jitted kernel per predicate-plan shape: the whole
+    conjunction/disjunction is a single device dispatch per row group."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(*args):
+        i = 0
+        mask = None
+        for kind, op, neg in sig:
+            if kind == "lut":
+                codes, lut = args[i], args[i + 1]
+                i += 2
+                m = jnp.take(lut, codes)
+                if neg:
+                    m = ~m
+            else:
+                col, lit = args[i], args[i + 1]
+                i += 2
+                if op == A.Op.EQ:
+                    m = col == lit
+                elif op == A.Op.NEQ:
+                    m = col != lit
+                elif op == A.Op.GT:
+                    m = col > lit
+                elif op == A.Op.GTE:
+                    m = col >= lit
+                elif op == A.Op.LT:
+                    m = col < lit
+                else:
+                    m = col <= lit
+            mask = m if mask is None else (mask & m if all_conditions
+                                           else mask | m)
+        return mask
+
+    return jax.jit(fn)
+
+
+def _dev_array(view, key: str, values: np.ndarray, dtype):
+    """Device-resident copy of a scan column, cached on the view so a
+    multi-query/multi-pass scan transfers each column once."""
+    import jax.numpy as jnp
+
+    cache = view.meta.setdefault("_dev_arrays", {})
+    arr = cache.get(key)
+    if arr is None:
+        arr = cache[key] = jnp.asarray(np.asarray(values, dtype))
+    return arr
+
+
+class BlockScanPlane:
+    """Device-resident scan cache for one block: dictionary-coded string
+    columns and float32 numeric intrinsics, concatenated across row groups
+    and uploaded ONCE. A query's pushdown conjunction then costs one fused
+    device dispatch for the whole block and one small boolean D2H — the
+    economics that make the device plane win even when the chip sits
+    behind a high-latency link (per-row-group sync offload does not).
+
+    Per-row-group dictionaries unify into one block dictionary on host
+    (O(distinct strings)); codes remap through a small lut before upload.
+    """
+
+    _DICT_KEYS = ("name", "service")
+
+    def __init__(self, views: Sequence) -> None:
+        import jax.numpy as jnp
+
+        self.n = int(sum(v.n for v in views))
+        self._dev: dict[str, object] = {}
+        self._dicts: dict[str, list[str]] = {}
+        for key, meta_key in (("name", "name_col"), ("service", "service_col")):
+            parts = []
+            block_ids: dict[str, int] = {}
+            ok = True
+            for v in views:
+                c = v.meta.get(meta_key)
+                if c is None:
+                    ok = False
+                    break
+                codes, dvals = _dict_codes(v, key, c)
+                lut = np.empty(len(dvals) + 1, np.int32)
+                for i, s in enumerate(dvals):
+                    lut[i] = block_ids.setdefault(s, len(block_ids))
+                lut[len(dvals)] = -1          # null marker
+                parts.append(lut[codes])
+            if ok and parts:
+                merged = np.concatenate(parts)
+                nulls = merged < 0
+                merged[nulls] = len(block_ids)   # null -> lut false slot
+                self._dev[f"dict:{key}"] = jnp.asarray(merged)
+                self._dicts[key] = [s for s, _ in sorted(
+                    block_ids.items(), key=lambda kv: kv[1])]
+        for num_key in set(_NUM_INTRINSICS.values()):
+            cols = [v.col(num_key) for v in views]
+            if all(c is not None for c in cols):
+                self._dev[f"num:{num_key}"] = jnp.asarray(np.concatenate(
+                    [np.asarray(c.values, np.float32) for c in cols]))
+
+    def _plan(self, preds: Sequence, all_conditions: bool):
+        import jax.numpy as jnp
+
+        sig, args = [], []
+        for c in preds:
+            if not c.operands:
+                return None
+            v = c.operands[0].value
+            attr = c.attr
+            dkey = None
+            if attr.intrinsic == A.Intrinsic.NAME:
+                dkey = "name"
+            elif (attr.intrinsic == A.Intrinsic.NONE
+                    and attr.name == "service.name"
+                    and attr.scope in (A.Scope.RESOURCE, A.Scope.NONE)):
+                dkey = "service"
+            if dkey is not None:
+                codes = self._dev.get(f"dict:{dkey}")
+                if codes is None:
+                    return None
+                term = _dict_term(c.op, v, self._dicts[dkey])
+                if term is None:
+                    return None
+                sig.append(term[0])
+                args.extend((codes, jnp.asarray(term[1])))
+                continue
+            nkey = _NUM_INTRINSICS.get(attr.intrinsic)
+            col = self._dev.get(f"num:{nkey}") if nkey else None
+            if col is None:
+                return None
+            term = _num_term(c.op, v)
+            if term is None:
+                return None
+            sig.append(term[0])
+            args.extend((col, jnp.float32(term[1])))
+        return (tuple(sig), args) if sig else None
+
+    def mask_async(self, preds: Sequence, all_conditions: bool):
+        """Launch the fused block mask; returns a device array (or None
+        when a predicate shape is unsupported). No sync, no D2H."""
+        plan = self._plan(preds, all_conditions)
+        if plan is None:
+            return None
+        sig, args = plan
+        return _compiled_mask(sig, all_conditions)(*args)
+
+    def mask(self, preds: Sequence, all_conditions: bool
+             ) -> Optional[np.ndarray]:
+        m = self.mask_async(preds, all_conditions)
+        return None if m is None else np.asarray(m)
+
+
+def device_pred_mask(view, preds: Sequence, all_conditions: bool
+                     ) -> Optional[np.ndarray]:
+    """Evaluate pushdown predicates on device; None when unsupported."""
+    if not enabled() or not preds:
+        return None
+    import jax.numpy as jnp
+
+    sig = []
+    args = []
+    for c in preds:
+        if not c.operands:
+            return None
+        info = _col_for(view, c.attr)
+        if info is None:
+            return None
+        v = c.operands[0].value
+        if info[0] == "dict":
+            _, key, codes, dvals = info
+            term = _dict_term(c.op, v, dvals)
+            if term is None:
+                return None
+            sig.append(term[0])
+            args.append(_dev_array(view, f"dict:{key}", codes, np.int32))
+            args.append(jnp.asarray(term[1]))
+        else:
+            _, key, values = info
+            term = _num_term(c.op, v)
+            if term is None:
+                return None
+            sig.append(term[0])
+            args.append(_dev_array(view, f"num:{key}", values, np.float32))
+            args.append(jnp.float32(term[1]))
+    if not sig:
+        return None
+    fn = _compiled_mask(tuple(sig), all_conditions)
+    return np.asarray(fn(*args))

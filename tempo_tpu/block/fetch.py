@@ -129,6 +129,31 @@ def _attr_col_from_lists(tbl_cols: dict, kc: str, vc: str, t: str, key: str,
 
 
 def _hex_col(arr, n: int) -> np.ndarray:
+    """Hex strings for a binary column without per-row Python: one C-level
+    .hex() over the arrow data buffer, then string slicing by offsets."""
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    if n == 0:
+        return np.empty(0, object)
+    try:
+        if pa.types.is_fixed_size_binary(arr.type) and arr.null_count == 0:
+            w = arr.type.byte_width
+            data = memoryview(arr.buffers()[1])[arr.offset * w:
+                                                (arr.offset + n) * w]
+            hexs = bytes(data).hex()
+            return np.array([hexs[2 * w * i: 2 * w * (i + 1)]
+                             for i in range(n)], object)
+        if (pa.types.is_binary(arr.type)
+                or pa.types.is_large_binary(arr.type)):
+            odt = np.int32 if pa.types.is_binary(arr.type) else np.int64
+            offs = np.frombuffer(arr.buffers()[1], odt,
+                                 count=n + 1, offset=arr.offset * odt().itemsize)
+            hexs = bytes(memoryview(arr.buffers()[2])).hex()
+            o2 = (offs * 2).tolist()
+            # nulls have equal offsets -> "" (matches the old loop)
+            return np.array([hexs[o2[i]:o2[i + 1]] for i in range(n)], object)
+    except Exception:
+        pass
     raw = _np_str(arr)
     out = np.empty(n, object)
     for i in range(n):
@@ -360,16 +385,19 @@ def condition_mask(view: ColumnView, req: FetchSpansRequest) -> np.ndarray:
         # cross-attribute compare): any span might match — no prefilter
         mask = np.ones(n, bool)
     else:
-        mask = None
-        for c in preds:
-            expr = A.BinaryOp(c.op, c.attr, c.operands[0])
-            m = eval_expr(view, expr).bool_mask()
-            if mask is None:
-                mask = m
-            elif req.all_conditions:
-                mask &= m
-            else:
-                mask |= m
+        from tempo_tpu.block.device_scan import device_pred_mask
+
+        mask = device_pred_mask(view, preds, req.all_conditions)
+        if mask is None:
+            for c in preds:
+                expr = A.BinaryOp(c.op, c.attr, c.operands[0])
+                m = eval_expr(view, expr).bool_mask()
+                if mask is None:
+                    mask = m
+                elif req.all_conditions:
+                    mask &= m
+                else:
+                    mask |= m
         if mask is None:
             mask = np.ones(n, bool)
     if req.start_ns or req.end_ns:

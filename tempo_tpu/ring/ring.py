@@ -72,7 +72,7 @@ class _RingState:
     fresh ids with stale owners (ADVICE r2 #1)."""
 
     __slots__ = ("instances", "ids", "tokens", "owners", "walk_cache",
-                 "shuffle_cache")
+                 "shuffle_ids", "shuffle_rings", "fingerprint")
 
     def __init__(self, instances: dict[str, InstanceDesc]) -> None:
         self.instances = instances
@@ -92,10 +92,22 @@ class _RingState:
         else:
             self.tokens = np.zeros(0, np.uint32)
             self.owners = np.zeros(0, np.int64)
-        # rf -> per-token-position replication member ids (health-agnostic)
-        self.walk_cache: dict[int, list[list[str]]] = {}
-        # (tenant, size) -> shuffle-sharded sub-Ring for THIS snapshot
-        self.shuffle_cache: dict[tuple[str, int], "Ring"] = {}
+        # walk/shuffle results depend only on membership (ids, zones,
+        # tokens) — NOT on heartbeats — so snapshots with an identical
+        # fingerprint share them (a heartbeat-only KV update must not
+        # re-derive O(total-tokens * rf) walk tables)
+        self.fingerprint = hash(tuple(
+            (i, instances[i].zone, instances[i].tokens.tobytes())
+            for i in ids))
+        # rf -> {ring position -> replication member ids}, built lazily
+        # per touched position (health-agnostic)
+        self.walk_cache: dict[int, dict[int, list[str]]] = {}
+        # (tenant, size) -> picked member ids (reusable across snapshots
+        # with the same fingerprint)
+        self.shuffle_ids: dict[tuple[str, int], tuple[str, ...]] = {}
+        # (tenant, size) -> sub-Ring built from THIS snapshot's descs
+        # (never shared: health reads the current heartbeat_ts)
+        self.shuffle_rings: dict[tuple[str, int], "Ring"] = {}
 
     def walk_from(self, start: int, rf: int) -> list[InstanceDesc]:
         """Clockwise walk from ring position `start` collecting rf distinct
@@ -119,18 +131,18 @@ class _RingState:
                 break
         return picked
 
-    def walk_table(self, rf: int) -> list[list[str]]:
-        """Replication member ids per ring position, built once per
-        snapshot: replica sets depend only on WHERE a token lands, so a
-        batch of any size resolves with one searchsorted plus a unique over
-        at most len(self.tokens) positions. Racing builders may duplicate
-        work; the dict write is atomic either way."""
-        tab = self.walk_cache.get(rf)
-        if tab is None:
-            tab = [[i.id for i in self.walk_from(p, rf)]
-                   for p in range(len(self.tokens))]
-            self.walk_cache[rf] = tab
-        return tab
+    def walk_members(self, pos: int, rf: int) -> list[str]:
+        """Replication member ids for one ring position, cached lazily:
+        replica sets depend only on WHERE a token lands, so a batch of any
+        size resolves with one searchsorted plus a unique over at most
+        len(self.tokens) positions — and only positions actually hit ever
+        pay the walk. Racing builders may duplicate work; the dict write
+        is atomic either way."""
+        tab = self.walk_cache.setdefault(rf, {})
+        got = tab.get(pos)
+        if got is None:
+            got = tab[pos] = [i.id for i in self.walk_from(pos, rf)]
+        return got
 
     def walk(self, token: int, rf: int) -> list[InstanceDesc]:
         if len(self.tokens) == 0:
@@ -166,22 +178,33 @@ class Ring:
     def _instances(self) -> dict[str, InstanceDesc]:
         return self._state.instances
 
+    def _publish(self, m: dict[str, InstanceDesc]) -> None:
+        """Build + swap a snapshot; heartbeat-only updates (identical
+        membership fingerprint) inherit the previous snapshot's walk
+        tables and shuffle picks instead of re-deriving them."""
+        st = _RingState(m)
+        old = self._state
+        if old is not None and old.fingerprint == st.fingerprint:
+            st.walk_cache = old.walk_cache
+            st.shuffle_ids = old.shuffle_ids
+        self._state = st
+
     def _on_update(self, desc_map: dict[str, InstanceDesc]) -> None:
         with self._wlock:
-            self._state = _RingState(dict(desc_map))
+            self._publish(dict(desc_map))
 
     def register(self, inst: InstanceDesc) -> None:
         """Local registration (tests / single-binary); Lifecycler for KV."""
         with self._wlock:
             m = dict(self._state.instances)
             m[inst.id] = inst
-            self._state = _RingState(m)
+            self._publish(m)
 
     def unregister(self, instance_id: str) -> None:
         with self._wlock:
             m = dict(self._state.instances)
             m.pop(instance_id, None)
-            self._state = _RingState(m)
+            self._publish(m)
 
     def healthy(self, inst: InstanceDesc) -> bool:
         if inst.state != ACTIVE:
@@ -210,7 +233,7 @@ class Ring:
 
     def _set_at(self, st: _RingState, pos: int, rf: int) -> ReplicationSet:
         """ReplicationSet for ring position `pos`, health-filtered now."""
-        full = [st.instances[iid] for iid in st.walk_table(rf)[pos]]
+        full = [st.instances[iid] for iid in st.walk_members(pos, rf)]
         if not full:
             # an empty ring can never satisfy quorum — failing loudly beats
             # a ReplicationSet of nobody that "succeeds" while dropping data
@@ -284,31 +307,35 @@ class Ring:
         st = self._state
         if size <= 0 or size >= len(st.instances):
             return self
-        cached = st.shuffle_cache.get((tenant, size))
+        key = (tenant, size)
+        cached = st.shuffle_rings.get(key)
         if cached is not None:
             return cached
-        seed = _hash_str(tenant)
-        rng = np.random.default_rng(seed)
-        picked: set[str] = set()
-        # walk only returns token-owning instances: cap the target at that
-        # count (a zero-token registrant would otherwise never be picked and
-        # the loop would spin forever) and bound iterations as a backstop
-        owners = {i.id for i in st.instances.values() if len(i.tokens)}
-        target = min(size, len(owners))
-        for _ in range(64 * max(target, 1)):
-            if len(picked) >= target:
-                break
-            tok = int(rng.integers(0, 2**32))
-            for inst in st.walk(tok, len(st.instances)):
-                if inst.id not in picked:
-                    picked.add(inst.id)
+        picked = st.shuffle_ids.get(key)
+        if picked is None:
+            seed = _hash_str(tenant)
+            rng = np.random.default_rng(seed)
+            sel: set[str] = set()
+            # walk only returns token-owning instances: cap the target at
+            # that count (a zero-token registrant would otherwise never be
+            # picked and the loop would spin forever) and bound iterations
+            owners = {i.id for i in st.instances.values() if len(i.tokens)}
+            target = min(size, len(owners))
+            for _ in range(64 * max(target, 1)):
+                if len(sel) >= target:
                     break
+                tok = int(rng.integers(0, 2**32))
+                for inst in st.walk(tok, len(st.instances)):
+                    if inst.id not in sel:
+                        sel.add(inst.id)
+                        break
+            picked = st.shuffle_ids[key] = tuple(sorted(sel))
         sub = Ring(replication_factor=self.rf,
                    heartbeat_timeout_s=self.heartbeat_timeout_s, now=self.now)
+        # built from THIS snapshot's descs: health must read fresh
+        # heartbeats; the picked-ids layer is what survives heartbeats
         sub._state = _RingState({iid: st.instances[iid] for iid in picked})
-        # cached per parent snapshot: a membership change builds a fresh
-        # _RingState, so stale shards (and their walk tables) die with it
-        st.shuffle_cache[(tenant, size)] = sub
+        st.shuffle_rings[key] = sub
         return sub
 
 

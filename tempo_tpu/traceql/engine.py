@@ -138,7 +138,44 @@ def execute_search(
     for view, cand in view_iter:
         if len(cand) == 0:
             continue
-        for ss in evaluate_pipeline(q, view):
+        spansets = evaluate_pipeline(q, view)
+        if not spansets:
+            continue
+        # Vectorized pre-pass: per-spanset time bounds via one reduceat,
+        # window filter, then metadata (hex ids, root names, JSON) is built
+        # for the top-`limit` most recent spansets ONLY — everything older
+        # could never displace them in the combiner.
+        st = view.meta.get("start_unix_nano")
+        dur = view.meta.get("duration_ns")
+        if st is not None and len(spansets) > limit:
+            lens = np.fromiter((len(ss.rows) for ss in spansets), np.int64,
+                               len(spansets))
+            allrows = np.concatenate([ss.rows for ss in spansets])
+            bounds = np.zeros(len(spansets), np.int64)
+            np.cumsum(lens[:-1], out=bounds[1:])
+            t0s = np.minimum.reduceat(st[allrows], bounds)
+            t1s = np.maximum.reduceat(st[allrows] + dur[allrows], bounds)
+            ok = np.ones(len(spansets), bool)
+            if start_ns:
+                ok &= t1s >= start_ns
+            if end_ns:
+                ok &= t0s < end_ns
+            idxs = np.flatnonzero(ok)
+            # Rank by the COMBINER's key — a trace's start is the min over
+            # its merged spansets — and keep every spanset of each chosen
+            # trace, so multi-spanset traces (by() queries) neither rank
+            # nor truncate differently than the unfiltered path.
+            first_rows = allrows[bounds[idxs]]
+            tkeys = view.trace_idx[first_rows]
+            ut, inv = np.unique(tkeys, return_inverse=True)
+            tmin = np.full(len(ut), np.inf)
+            np.minimum.at(tmin, inv, t0s[idxs])
+            top = np.argsort(-tmin, kind="stable")[:limit]
+            chosen_traces = set(ut[top].tolist())
+            spansets = [spansets[i]
+                        for i, t in zip(idxs.tolist(), tkeys.tolist())
+                        if t in chosen_traces]
+        for ss in spansets:
             md = _trace_metadata(view, ss, start_ns, end_ns)
             if md is not None:
                 combiner.add(md)

@@ -253,16 +253,15 @@ class MetricsEvaluator:
             return
 
         # group-by key columns → host series slots
-        grouped = self._group_keys(view, rows)
+        grouped = self._group_slots(view, rows)
         if grouped is None:
             slots = np.zeros(len(rows), np.int32)
             self.series.lookup([()])
         else:
-            keep, key_tuples = grouped
+            keep, slots = grouped
             rows, step = rows[keep], step[keep]
             if len(rows) == 0:
                 return
-            slots = self.series.lookup(key_tuples)
         self._ensure_capacity()
 
         vals = None
@@ -326,8 +325,13 @@ class MetricsEvaluator:
             return np.empty(0, np.int64)
         return np.unique(np.concatenate([ss.rows for ss in spansets]))
 
-    def _group_keys(self, view: ColumnView, rows: np.ndarray):
-        """(keep_mask, [key tuples]) or None when there's no by()."""
+    def _group_slots(self, view: ColumnView, rows: np.ndarray):
+        """(keep_mask, slots[int32]) or None when there's no by().
+
+        Vectorized: each group column factorizes to integer codes, codes
+        compose into one key per row, and only UNIQUE combos build Python
+        label tuples — the per-span tuple loop of `GroupingAggregator`
+        becomes O(distinct series) host work."""
         if not self.m.by:
             return None
         cols = [(str(e), eval_expr(view, e)) for e in self.m.by]
@@ -335,11 +339,29 @@ class MetricsEvaluator:
         for _, c in cols:
             keep &= c.exists[rows]  # spans missing a group key are dropped
         kept = rows[keep]
-        keys: list[tuple] = []
-        vals = [(name, c.values, c.t) for name, c in cols]
-        for r in kept:
-            keys.append(tuple((name, _fmt_label(v[r], t)) for name, v, t in vals))
-        return keep, keys
+        if len(kept) == 0:
+            return keep, np.zeros(0, np.int32)
+        codes: list[np.ndarray] = []
+        uniqs: list[tuple[str, np.ndarray, str]] = []
+        for name, c in cols:
+            vals = c.values[kept]
+            if vals.dtype == object:    # python-object compares are O(n) py
+                vals = vals.astype("U")
+            u, inv = np.unique(vals, return_inverse=True)
+            codes.append(inv.astype(np.int64))
+            uniqs.append((name, u, c.t))
+        comp = codes[0]
+        for code, (_, u, _) in zip(codes[1:], uniqs[1:]):
+            comp = comp * len(u) + code
+        ucomp, first, inv = np.unique(comp, return_index=True,
+                                      return_inverse=True)
+        tuples = [
+            tuple((name, _fmt_label(u[codes[k][fi]], t))
+                  for k, (name, u, t) in enumerate(uniqs))
+            for fi in first.tolist()
+        ]
+        uslots = self.series.lookup(tuples)
+        return keep, uslots[inv].astype(np.int32)
 
     def _observe_compare(self, view: ColumnView, rows: np.ndarray,
                          step: np.ndarray) -> None:

@@ -1,0 +1,125 @@
+"""Device predicate plane vs the numpy mask loop: identical candidates.
+
+The storage prefilter (`condition_mask`) may run dictionary-coded masks on
+device (`block/device_scan.py`); the numpy path is the semantic reference.
+Both must produce the same candidate rows for every supported shape, and
+unsupported shapes must fall back cleanly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tempo_tpu.backend.mem import MemBackend
+from tempo_tpu.block import device_scan
+from tempo_tpu.db.tempodb import TempoDB
+from tempo_tpu.traceql.conditions import extract_conditions
+from tempo_tpu.traceql.parser import parse
+
+T0 = 1_700_000_000
+
+
+@pytest.fixture(scope="module")
+def block_db():
+    rng = np.random.default_rng(42)
+    be = MemBackend()
+    db = TempoDB(be, be)
+    traces = []
+    for i in range(600):
+        tid = rng.bytes(16)
+        start = int((T0 + i) * 1e9)
+        traces.append((tid, [{
+            "trace_id": tid, "span_id": rng.bytes(8),
+            "name": f"op-{i % 7}", "service": f"svc-{i % 4}",
+            "kind": int(i % 6), "status_code": int(i % 3),
+            "start_unix_nano": start,
+            "end_unix_nano": start + int(rng.integers(1, 500)) * 1_000_000,
+            "attrs": {"http.status_code": 200 + (i % 300)},
+        }]))
+    db.write_block("t", traces, replication_factor=1)
+    db.poll_now()
+    return db
+
+
+QUERIES = [
+    '{ name = "op-3" }',
+    '{ name != "op-3" }',
+    '{ name =~ "op-[12]" }',
+    '{ name !~ "op-[12]" }',
+    '{ resource.service.name = "svc-2" }',
+    '{ duration > 100ms }',
+    '{ duration <= 20ms }',
+    '{ kind = server }',
+    '{ status = error }',
+    '{ name = "op-3" && duration > 50ms }',
+    '{ name = "op-1" || name = "op-2" }',
+    # unsupported on device (attr list column) -> numpy fallback, still equal
+    '{ span.http.status_code >= 400 }',
+    '{ name = "op-3" && span.http.status_code >= 400 }',
+]
+
+
+def _candidates(db, query: str) -> list[tuple[int, np.ndarray]]:
+    from tempo_tpu.block.fetch import scan_views
+    from tempo_tpu.block.reader import BackendBlock
+
+    q = parse(query)
+    req = extract_conditions(q)
+    out = []
+    metas = db.blocklist.metas("t")
+    for m in metas:
+        block = BackendBlock(db.r, m)
+        for i, (view, cand) in enumerate(scan_views(block, req)):
+            out.append((i, np.sort(cand)))
+    return out
+
+
+@pytest.mark.parametrize("query", QUERIES)
+def test_device_mask_matches_numpy(block_db, query, monkeypatch):
+    monkeypatch.setenv("TEMPO_TPU_DEVICE_SCAN", "1")
+    dev = _candidates(block_db, query)
+    monkeypatch.setenv("TEMPO_TPU_DEVICE_SCAN", "0")
+    ref = _candidates(block_db, query)
+    assert len(dev) == len(ref)
+    for (i, a), (j, b) in zip(dev, ref):
+        assert i == j
+        np.testing.assert_array_equal(a, b)
+
+
+def test_device_plane_actually_engages(block_db, monkeypatch):
+    """Sanity: the supported shapes really take the device path (guard
+    against silent permanent fallback)."""
+    from tempo_tpu.block.fetch import scan_views
+    from tempo_tpu.block.reader import BackendBlock
+
+    monkeypatch.setenv("TEMPO_TPU_DEVICE_SCAN", "1")
+    q = parse('{ name = "op-3" && duration > 50ms }')
+    req = extract_conditions(q)
+    meta = block_db.blocklist.metas("t")[0]
+    block = BackendBlock(block_db.r, meta)
+    for view, _cand in scan_views(block, req):
+        preds = [c for c in req.conditions if c.op is not None]
+        mask = device_scan.device_pred_mask(view, preds, req.all_conditions)
+        assert mask is not None and mask.dtype == bool
+        break
+
+
+def test_regex_is_anchored(block_db):
+    """Regression: device regexes must fullmatch like the numpy plane —
+    `op-1` must NOT match `op-10` (and !~ must keep it)."""
+    from tempo_tpu.block.fetch import scan_views
+    from tempo_tpu.block.reader import BackendBlock
+    from tempo_tpu.traceql.ast import Op
+
+    meta = block_db.blocklist.metas("t")[0]
+    block = BackendBlock(block_db.r, meta)
+    views = [v for v, _ in scan_views(block, None)]
+    plane = device_scan.BlockScanPlane(views)
+    q = parse('{ name =~ "op-1" }')
+    req = extract_conditions(q)
+    preds = [c for c in req.conditions if c.op is not None]
+    mask = plane.mask(preds, req.all_conditions)
+    names = np.concatenate([np.asarray(v.col("name").values) for v in views])
+    assert mask is not None
+    assert set(names[mask]) == {"op-1"}, set(names[mask])
