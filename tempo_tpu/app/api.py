@@ -90,6 +90,8 @@ class Handler(BaseHTTPRequestHandler):
                 return self._push(tenant)
             if path == "/api/v2/spans":       # zipkin v2 receiver
                 return self._push_zipkin(tenant)
+            if path == "/api/traces":         # jaeger thrift-http collector
+                return self._push_jaeger(tenant)
             if path == "/api/overrides":
                 return self._set_overrides(tenant)
             if path.startswith("/internal/"):
@@ -153,14 +155,39 @@ class Handler(BaseHTTPRequestHandler):
                 for s in series]}))
         self._err(404, f"unknown internal path {path}")
 
-    def _push(self, tenant: str) -> None:
+    # -- ingest receivers (shared preamble; shim.go:165-171 factory map) ---
+
+    def _ingest_body(self) -> bytes | None:
+        """Read + gunzip a receiver body; None when a 400 was already
+        sent (shared by every ingest endpoint)."""
         n = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(n)
         if self.headers.get("Content-Encoding", "").lower() == "gzip":
             try:
                 body = _gunzip_capped(body)
             except Exception as e:
-                return self._err(400, f"bad gzip body: {e}")
+                self._err(400, f"bad gzip body: {e}")
+                return None
+        return body
+
+    def _push_decoded(self, tenant: str, spans, ok_status: int,
+                      raw_otlp=None, raw_recs=None) -> None:
+        """Distributor push + the shared rate-limit/partial-error replies."""
+        from tempo_tpu.distributor.distributor import RateLimited
+        try:
+            errs = self.app.distributor.push_spans(
+                tenant, spans, raw_otlp=raw_otlp, raw_recs=raw_recs)
+        except RateLimited:
+            self.send_response(429)
+            self.send_header("Retry-After", "1")
+            self.end_headers()
+            return
+        self._reply(ok_status, _json_bytes({"errors": errs} if errs else {}))
+
+    def _push(self, tenant: str) -> None:
+        body = self._ingest_body()
+        if body is None:
+            return
         ctype = self.headers.get("Content-Type", "")
         from tempo_tpu.model.otlp import spans_from_otlp_json, spans_from_otlp_proto
         raw, recs = None, None
@@ -177,41 +204,32 @@ class Handler(BaseHTTPRequestHandler):
         except (ValueError, KeyError, TypeError) as e:
             # malformed payload is the client's fault (OTLP spec: 400)
             return self._err(400, f"malformed otlp payload: {e}")
-        from tempo_tpu.distributor.distributor import RateLimited
-        try:
-            errs = self.app.distributor.push_spans(tenant, spans,
-                                                   raw_otlp=raw,
-                                                   raw_recs=recs)
-        except RateLimited as e:
-            self.send_response(429)
-            self.send_header("Retry-After", "1")
-            self.end_headers()
+        self._push_decoded(tenant, spans, 200, raw_otlp=raw, raw_recs=recs)
+
+    def _push_jaeger(self, tenant: str) -> None:
+        """Jaeger collector endpoint (`/api/traces`, TBinaryProtocol Batch)
+        — the thrift_http receiver of the reference's jaeger shim. Jaeger
+        collectors reply 202 Accepted."""
+        body = self._ingest_body()
+        if body is None:
             return
-        self._reply(200, _json_bytes({"errors": errs} if errs else {}))
+        from tempo_tpu.model.jaeger import spans_from_jaeger_thrift
+        try:
+            spans = spans_from_jaeger_thrift(body)
+        except (ValueError, KeyError, TypeError) as e:
+            return self._err(400, f"malformed jaeger payload: {e}")
+        self._push_decoded(tenant, spans, 202)
 
     def _push_zipkin(self, tenant: str) -> None:
-        n = int(self.headers.get("Content-Length", 0))
-        body = self.rfile.read(n)
-        if self.headers.get("Content-Encoding", "").lower() == "gzip":
-            try:
-                body = _gunzip_capped(body)
-            except Exception as e:
-                return self._err(400, f"bad gzip body: {e}")
+        body = self._ingest_body()
+        if body is None:
+            return
         from tempo_tpu.model.zipkin import spans_from_zipkin_json
         try:
             spans = list(spans_from_zipkin_json(json.loads(body)))
         except (ValueError, KeyError, TypeError) as e:
             return self._err(400, f"malformed zipkin payload: {e}")
-        from tempo_tpu.distributor.distributor import RateLimited
-        try:
-            errs = self.app.distributor.push_spans(tenant, spans)
-        except RateLimited:
-            self.send_response(429)
-            self.send_header("Retry-After", "1")
-            self.end_headers()
-            return
-        # zipkin collectors reply 202
-        self._reply(202, _json_bytes({"errors": errs} if errs else {}))
+        self._push_decoded(tenant, spans, 202)   # zipkin replies 202
 
     def _set_overrides(self, tenant: str) -> None:
         n = int(self.headers.get("Content-Length", 0))

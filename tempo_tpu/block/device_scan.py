@@ -75,7 +75,7 @@ def _dict_term(op: A.Op, v, dvals: list) :
         except re.error:
             return None
         matched = [i for i, s in enumerate(dvals) if rx.fullmatch(s)]
-    lut = np.zeros(len(dvals) + 1, bool)       # last slot: null -> False
+    lut = np.zeros(len(dvals), bool)
     if matched:
         lut[np.asarray(matched)] = True
     return ("lut", None, op in (A.Op.NEQ, A.Op.NOT_REGEX)), lut
@@ -93,9 +93,12 @@ def _num_term(op: A.Op, v):
 
 
 def _dict_codes(view, key: str, arrow_col):
-    """(codes[int32] with nulls mapped to |dict|, dict values) — cached on
-    the view; the arrow column is usually already dictionary-encoded on
-    disk, so this is an index copy, not a re-encode."""
+    """(codes[int32], dict values) — cached on the view; the arrow column
+    is usually already dictionary-encoded on disk, so this is an index
+    copy, not a re-encode. Nulls become the dictionary entry "None",
+    matching the numpy plane's astype(str) semantics exactly (a null name
+    DOES match `{ name = "None" }` there), so negation stays a plain
+    complement."""
     cache = view.meta.setdefault("_dict_codes", {})
     got = cache.get(key)
     if got is None:
@@ -110,8 +113,15 @@ def _dict_codes(view, key: str, arrow_col):
             d = d.combine_chunks()
         vals = ["" if v is None else str(v) for v in d.dictionary.to_pylist()]
         idx = d.indices.to_numpy(zero_copy_only=False)
-        codes = np.where(np.isnan(idx), len(vals), idx).astype(np.int32) \
-            if idx.dtype.kind == "f" else np.asarray(idx, np.int32)
+        if idx.dtype.kind == "f":              # nulls present
+            try:
+                none_id = vals.index("None")
+            except ValueError:
+                none_id = len(vals)
+                vals = vals + ["None"]
+            codes = np.where(np.isnan(idx), none_id, idx).astype(np.int32)
+        else:
+            codes = np.asarray(idx, np.int32)
         got = cache[key] = (codes, vals)
     return got
 
@@ -216,16 +226,15 @@ class BlockScanPlane:
                     ok = False
                     break
                 codes, dvals = _dict_codes(v, key, c)
-                lut = np.empty(len(dvals) + 1, np.int32)
+                # per-view dict ids -> block dict ids (nulls are already
+                # the "None" entry inside dvals, see _dict_codes)
+                lut = np.empty(len(dvals), np.int32)
                 for i, s in enumerate(dvals):
                     lut[i] = block_ids.setdefault(s, len(block_ids))
-                lut[len(dvals)] = -1          # null marker
-                parts.append(lut[codes])
+                parts.append(lut[codes] if len(dvals) else codes)
             if ok and parts:
-                merged = np.concatenate(parts)
-                nulls = merged < 0
-                merged[nulls] = len(block_ids)   # null -> lut false slot
-                self._dev[f"dict:{key}"] = jnp.asarray(merged)
+                self._dev[f"dict:{key}"] = jnp.asarray(
+                    np.concatenate(parts))
                 self._dicts[key] = [s for s, _ in sorted(
                     block_ids.items(), key=lambda kv: kv[1])]
         for num_key in set(_NUM_INTRINSICS.values()):

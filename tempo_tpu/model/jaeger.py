@@ -1,0 +1,273 @@
+"""Jaeger ingest: thrift-over-HTTP collector payloads → span dicts.
+
+The reference hosts a jaeger receiver inside the distributor's OTel shim
+(`modules/distributor/receiver/shim.go:165-171`); Jaeger SDK reporters
+POST a TBinaryProtocol-encoded `jaeger.thrift` Batch to
+`/api/traces` with content-type application/x-thrift. This module is a
+from-scratch minimal TBinaryProtocol reader for exactly the structures in
+the public jaeger.thrift IDL (Batch/Process/Span/Tag/SpanRef/Log) plus
+the OTel semantic mapping (span.kind / error tags → kind/status), the
+same translation the jaeger receiver performs before handing ptraces to
+the distributor.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Iterator
+
+# thrift TBinaryProtocol type ids
+T_STOP, T_BOOL, T_BYTE, T_DOUBLE = 0, 2, 3, 4
+T_I16, T_I32, T_I64, T_STRING = 6, 8, 10, 11
+T_STRUCT, T_MAP, T_SET, T_LIST = 12, 13, 14, 15
+
+_KIND_FROM_STR = {"unspecified": 0, "internal": 1, "server": 2,
+                  "client": 3, "producer": 4, "consumer": 5}
+
+
+class _R:
+    """Cursor over TBinaryProtocol bytes."""
+
+    __slots__ = ("b", "i")
+
+    def __init__(self, b: bytes):
+        self.b = b
+        self.i = 0
+
+    def u8(self) -> int:
+        v = self.b[self.i]
+        self.i += 1
+        return v
+
+    def i16(self) -> int:
+        v = struct.unpack_from(">h", self.b, self.i)[0]
+        self.i += 2
+        return v
+
+    def i32(self) -> int:
+        v = struct.unpack_from(">i", self.b, self.i)[0]
+        self.i += 4
+        return v
+
+    def i64(self) -> int:
+        v = struct.unpack_from(">q", self.b, self.i)[0]
+        self.i += 8
+        return v
+
+    def f64(self) -> float:
+        v = struct.unpack_from(">d", self.b, self.i)[0]
+        self.i += 8
+        return v
+
+    def raw(self) -> bytes:
+        n = self.i32()
+        if n < 0 or self.i + n > len(self.b):
+            raise ValueError("thrift string overruns buffer")
+        v = self.b[self.i:self.i + n]
+        self.i += n
+        return v
+
+    # minimum wire bytes per element of each type (guards collection
+    # counts: an attacker-supplied count must fit the remaining buffer
+    # before any loop runs, or a tiny payload spins for billions of steps)
+    _MIN = {T_BOOL: 1, T_BYTE: 1, T_DOUBLE: 8, T_I16: 2, T_I32: 4,
+            T_I64: 8, T_STRING: 4, T_STRUCT: 1, T_MAP: 6, T_SET: 5,
+            T_LIST: 5}
+
+    def count(self, elem_type: int) -> int:
+        n = self.i32()
+        per = self._MIN.get(elem_type)
+        if per is None:
+            raise ValueError(f"unknown thrift type {elem_type}")
+        if n < 0 or n * per > len(self.b) - self.i:
+            raise ValueError("thrift collection count overruns buffer")
+        return n
+
+    def skip(self, t: int) -> None:
+        if t == T_BOOL or t == T_BYTE:
+            self.i += 1
+        elif t == T_I16:
+            self.i += 2
+        elif t == T_I32:
+            self.i += 4
+        elif t in (T_I64, T_DOUBLE):
+            self.i += 8
+        elif t == T_STRING:
+            self.raw()
+        elif t == T_STRUCT:
+            while True:
+                ft = self.u8()
+                if ft == T_STOP:
+                    break
+                self.i16()
+                self.skip(ft)
+        elif t in (T_LIST, T_SET):
+            et = self.u8()
+            for _ in range(self.count(et)):
+                self.skip(et)
+        elif t == T_MAP:
+            kt, vt = self.u8(), self.u8()
+            n = self.count(kt)
+            if n * self._MIN[vt] > len(self.b) - self.i:
+                raise ValueError("thrift map count overruns buffer")
+            for _ in range(n):
+                self.skip(kt)
+                self.skip(vt)
+        else:
+            raise ValueError(f"unknown thrift type {t}")
+
+    def fields(self) -> Iterator[tuple[int, int]]:
+        """Yield (field_id, type) until STOP; caller reads or skips."""
+        while True:
+            ft = self.u8()
+            if ft == T_STOP:
+                return
+            yield self.i16(), ft
+
+
+def _read_tag(r: _R) -> tuple[str, Any]:
+    key, vtype = "", 0
+    vstr: bytes = b""
+    vdouble, vbool, vlong = 0.0, False, 0
+    vbin: bytes = b""
+    for fid, ft in r.fields():
+        if fid == 1 and ft == T_STRING:
+            key = r.raw().decode("utf-8", "replace")
+        elif fid == 2 and ft == T_I32:
+            vtype = r.i32()
+        elif fid == 3 and ft == T_STRING:
+            vstr = r.raw()
+        elif fid == 4 and ft == T_DOUBLE:
+            vdouble = r.f64()
+        elif fid == 5 and ft == T_BOOL:
+            vbool = r.u8() != 0
+        elif fid == 6 and ft == T_I64:
+            vlong = r.i64()
+        elif fid == 7 and ft == T_STRING:
+            vbin = r.raw()
+        else:
+            r.skip(ft)
+    val: Any
+    if vtype == 0:
+        val = vstr.decode("utf-8", "replace")
+    elif vtype == 1:
+        val = vdouble
+    elif vtype == 2:
+        val = vbool
+    elif vtype == 3:
+        val = vlong
+    else:
+        val = vbin
+    return key, val
+
+
+def _read_tags(r: _R) -> dict[str, Any]:
+    et = r.u8()
+    n = r.count(et)
+    out: dict[str, Any] = {}
+    for _ in range(n):
+        if et == T_STRUCT:
+            k, v = _read_tag(r)
+            out[k] = v
+        else:
+            r.skip(et)
+    return out
+
+
+def _read_span(r: _R) -> dict:
+    """One jaeger.thrift Span → span dict (service/res_attrs patched in by
+    the caller once the Process struct is known)."""
+    tid_lo = tid_hi = sid = psid = 0
+    name = ""
+    start_us = dur_us = 0
+    attrs: dict[str, Any] = {}
+    for fid, ft in r.fields():
+        if fid == 1 and ft == T_I64:
+            tid_lo = r.i64()
+        elif fid == 2 and ft == T_I64:
+            tid_hi = r.i64()
+        elif fid == 3 and ft == T_I64:
+            sid = r.i64()
+        elif fid == 4 and ft == T_I64:
+            psid = r.i64()
+        elif fid == 5 and ft == T_STRING:
+            name = r.raw().decode("utf-8", "replace")
+        elif fid == 8 and ft == T_I64:
+            start_us = r.i64()
+        elif fid == 9 and ft == T_I64:
+            dur_us = r.i64()
+        elif fid == 10 and ft == T_LIST:
+            attrs = _read_tags(r)
+        else:
+            r.skip(ft)
+
+    kind = 0
+    sk = attrs.pop("span.kind", None)
+    if isinstance(sk, str):
+        kind = _KIND_FROM_STR.get(sk.lower(), 0)
+    status_code = 0
+    err = attrs.get("error")
+    if err is True or (isinstance(err, str) and err.lower() == "true"):
+        status_code = 2            # STATUS_CODE_ERROR, like the translator
+    otel_status = attrs.get("otel.status_code")
+    if isinstance(otel_status, str):
+        status_code = {"OK": 1, "ERROR": 2}.get(otel_status.upper(),
+                                                status_code)
+    u64 = lambda v: v & ((1 << 64) - 1)
+    start_ns = start_us * 1000
+    return {
+        "trace_id": struct.pack(">QQ", u64(tid_hi), u64(tid_lo)),
+        "span_id": struct.pack(">Q", u64(sid)),
+        "parent_span_id": struct.pack(">Q", u64(psid)) if psid else b"",
+        "name": name,
+        "service": "",
+        "kind": kind,
+        "status_code": status_code,
+        "start_unix_nano": start_ns,
+        "end_unix_nano": start_ns + dur_us * 1000,
+        "attrs": attrs,
+        "res_attrs": None,
+    }
+
+
+def spans_from_jaeger_thrift(data: bytes) -> list[dict]:
+    """Decode one TBinaryProtocol `jaeger.thrift` Batch into span dicts.
+
+    One pass: spans decode as encountered, and the Process struct
+    (service name + resource tags) patches them afterwards, so a
+    Process-after-spans field order costs nothing extra. Raises ValueError
+    on malformed bytes (the receiver maps it to 400)."""
+    try:
+        r = _R(data)
+        service = ""
+        res_attrs: dict[str, Any] = {}
+        out: list[dict] = []
+        for fid, ft in r.fields():
+            if fid == 1 and ft == T_STRUCT:       # Process
+                for pfid, pft in r.fields():
+                    if pfid == 1 and pft == T_STRING:
+                        service = r.raw().decode("utf-8", "replace")
+                    elif pfid == 2 and pft == T_LIST:
+                        res_attrs = _read_tags(r)
+                    else:
+                        r.skip(pft)
+            elif fid == 2 and ft == T_LIST:       # spans
+                et = r.u8()
+                n = r.count(et)
+                if n and et != T_STRUCT:
+                    raise ValueError("Batch.spans must hold structs")
+                for _ in range(n):
+                    out.append(_read_span(r))
+            else:
+                r.skip(ft)
+        res_attrs = dict(res_attrs)
+        res_attrs.setdefault("service.name", service)
+        for s in out:
+            s["service"] = service
+            s["res_attrs"] = res_attrs
+        return out
+    except (struct.error, IndexError) as e:
+        raise ValueError(f"malformed jaeger thrift payload: {e}") from None
+
+
+__all__ = ["spans_from_jaeger_thrift"]

@@ -96,9 +96,11 @@ class _RingState:
         # tokens) — NOT on heartbeats — so snapshots with an identical
         # fingerprint share them (a heartbeat-only KV update must not
         # re-derive O(total-tokens * rf) walk tables)
-        self.fingerprint = hash(tuple(
+        # the tuple itself, not its hash: equality must be exact — a hash
+        # collision would silently share walk tables across memberships
+        self.fingerprint = tuple(
             (i, instances[i].zone, instances[i].tokens.tobytes())
-            for i in ids))
+            for i in ids)
         # rf -> {ring position -> replication member ids}, built lazily
         # per touched position (health-agnostic)
         self.walk_cache: dict[int, dict[int, list[str]]] = {}

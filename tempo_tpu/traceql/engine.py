@@ -168,8 +168,10 @@ def execute_search(
             first_rows = allrows[bounds[idxs]]
             tkeys = view.trace_idx[first_rows]
             ut, inv = np.unique(tkeys, return_inverse=True)
-            tmin = np.full(len(ut), np.inf)
-            np.minimum.at(tmin, inv, t0s[idxs])
+            # int64 accumulator: float64 would round ns epochs (>2^53) and
+            # could cut a different trace set than the combiner's exact sort
+            tmin = np.full(len(ut), np.iinfo(np.int64).max, np.int64)
+            np.minimum.at(tmin, inv, t0s[idxs].astype(np.int64))
             top = np.argsort(-tmin, kind="stable")[:limit]
             chosen_traces = set(ut[top].tolist())
             spansets = [spansets[i]

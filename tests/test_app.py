@@ -4,6 +4,8 @@ from __future__ import annotations
 
 import json
 import socket
+import urllib.error
+import urllib.parse
 import urllib.request
 
 import pytest
@@ -243,3 +245,109 @@ def test_metrics_summary_without_generator(tmp_path):
     finally:
         srv.shutdown()
         app.shutdown()
+
+
+def _thrift_field(fid: int, ftype: int, payload: bytes) -> bytes:
+    import struct
+    return struct.pack(">bh", ftype, fid) + payload
+
+
+def _thrift_str(s) -> bytes:
+    import struct
+    b = s if isinstance(s, bytes) else s.encode()
+    return struct.pack(">i", len(b)) + b
+
+
+def _thrift_list(etype: int, items: list[bytes]) -> bytes:
+    import struct
+    return struct.pack(">bi", etype, len(items)) + b"".join(items)
+
+
+def _jaeger_tag(key: str, v) -> bytes:
+    import struct
+    out = _thrift_field(1, 11, _thrift_str(key))
+    if isinstance(v, bool):
+        out += _thrift_field(2, 8, struct.pack(">i", 2))
+        out += _thrift_field(5, 2, b"\x01" if v else b"\x00")
+    elif isinstance(v, int):
+        out += _thrift_field(2, 8, struct.pack(">i", 3))
+        out += _thrift_field(6, 10, struct.pack(">q", v))
+    elif isinstance(v, float):
+        out += _thrift_field(2, 8, struct.pack(">i", 1))
+        out += _thrift_field(4, 4, struct.pack(">d", v))
+    else:
+        out += _thrift_field(2, 8, struct.pack(">i", 0))
+        out += _thrift_field(3, 11, _thrift_str(v))
+    return out + b"\x00"
+
+
+def _jaeger_batch(service: str, spans: list[dict]) -> bytes:
+    """Encode a jaeger.thrift Batch with TBinaryProtocol (test-side
+    writer; the product only reads)."""
+    import struct
+    process = (_thrift_field(1, 11, _thrift_str(service)) +
+               _thrift_field(2, 15, _thrift_list(
+                   12, [_jaeger_tag("hostname", "h1")])) + b"\x00")
+    enc_spans = []
+    for s in spans:
+        b = (_thrift_field(1, 10, struct.pack(">q", s["tid_lo"])) +
+             _thrift_field(2, 10, struct.pack(">q", s.get("tid_hi", 0))) +
+             _thrift_field(3, 10, struct.pack(">q", s["sid"])) +
+             _thrift_field(4, 10, struct.pack(">q", s.get("psid", 0))) +
+             _thrift_field(5, 11, _thrift_str(s["name"])) +
+             _thrift_field(7, 8, struct.pack(">i", 1)) +
+             _thrift_field(8, 10, struct.pack(">q", s["start_us"])) +
+             _thrift_field(9, 10, struct.pack(">q", s["dur_us"])))
+        tags = [_jaeger_tag(k, v) for k, v in s.get("tags", {}).items()]
+        if tags:
+            b += _thrift_field(10, 15, _thrift_list(12, tags))
+        enc_spans.append(b + b"\x00")
+    return (_thrift_field(1, 12, process) +
+            _thrift_field(2, 15, _thrift_list(12, enc_spans)) + b"\x00")
+
+
+def test_jaeger_receiver(server):
+    import struct
+    import time
+    app, base = server
+    start_us = int((time.time() - 3) * 1e6)
+    batch = _jaeger_batch("jaeger-svc", [{
+        "tid_lo": 0x0102030405060708, "tid_hi": 0x1112131415161718,
+        "sid": 0x0A0B0C0D0E0F1011, "name": "jg-op",
+        "start_us": start_us, "dur_us": 75_000,
+        "tags": {"span.kind": "server", "http.status_code": 500,
+                 "error": True, "peer.address": "10.0.0.9"},
+    }])
+    req = urllib.request.Request(f"{base}/api/traces", data=batch,
+                                 headers={"Content-Type":
+                                          "application/x-thrift"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 202
+    tid_hex = "1112131415161718" + "0102030405060708"
+    code, tr = _get(f"{base}/api/traces/{tid_hex}")
+    assert code == 200 and tr["spans"][0]["name"] == "jg-op"
+    sp = tr["spans"][0]
+    assert sp["service"] == "jaeger-svc"
+    assert sp["kind"] == 2                      # span.kind=server
+    assert sp["status_code"] == 2               # error=true
+    assert sp["attrs"]["http.status_code"] == 500
+    assert sp["attrs"]["peer.address"] == "10.0.0.9"
+    assert "span.kind" not in sp["attrs"]       # mapped, not duplicated
+    assert sp["res_attrs"]["hostname"] == "h1"
+    assert sp["end_unix_nano"] - sp["start_unix_nano"] == 75_000_000
+    # the generator tee aggregated it (re-encoded OTLP wire path)
+    inst = app.generator.instance("single-tenant")
+    assert inst.spans_received >= 1
+    # search finds it by service
+    code, res = _get(f"{base}/api/search?q=" + urllib.parse.quote(
+        '{ resource.service.name = "jaeger-svc" }'))
+    assert code == 200 and len(res["traces"]) == 1
+    # malformed payload -> 400
+    bad = urllib.request.Request(f"{base}/api/traces", data=b"\x0b\x00\x01",
+                                 headers={"Content-Type":
+                                          "application/x-thrift"})
+    try:
+        urllib.request.urlopen(bad, timeout=10)
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
