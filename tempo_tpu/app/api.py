@@ -20,6 +20,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+import urllib.parse
 from urllib.parse import parse_qs, urlparse
 
 FAKE_TENANT = "single-tenant"
@@ -104,9 +105,17 @@ class Handler(BaseHTTPRequestHandler):
 
     # -- KV service (cross-process ring state; memberlist analog) ----------
 
+    def _kv_store(self):
+        """The member store served on /kv/*: the hosted store when this
+        process is a KV member, else the in-process store. NOTE: this
+        surface mutates ring membership and is unauthenticated — bind the
+        server to a cluster-internal interface, like memberlist's port."""
+        return getattr(self.app, "kv_host", None) or self.app.kv
+
     def _kv_get(self, key: str) -> None:
         from tempo_tpu.ring.kv import _value_to_json
-        ver, val = self.app.kv.get_versioned(key)
+        key = urllib.parse.unquote(key)    # clients percent-encode
+        ver, val = self._kv_store().get_versioned(key)
         if val is None and ver == 0:
             return self._err(404, f"no key {key}")
         self._reply(200, _json_bytes({"version": ver,
@@ -114,9 +123,10 @@ class Handler(BaseHTTPRequestHandler):
 
     def _kv_cas(self, key: str) -> None:
         from tempo_tpu.ring.kv import _value_from_json
+        key = urllib.parse.unquote(key)
         n = int(self.headers.get("Content-Length", 0))
         d = json.loads(self.rfile.read(n))
-        ok, ver = self.app.kv.cas_versioned(
+        ok, ver = self._kv_store().cas_versioned(
             key, int(d["expect_version"]), _value_from_json(d["value"]))
         if not ok:
             return self._err(409, f"version conflict on {key} (now {ver})")
@@ -288,7 +298,8 @@ class Handler(BaseHTTPRequestHandler):
     def do_DELETE(self) -> None:  # noqa: N802
         path = urlparse(self.path).path
         if path.startswith("/kv/"):
-            self.app.kv.delete(path[len("/kv/"):])
+            self._kv_store().delete(
+                urllib.parse.unquote(path[len("/kv/"):]))
             return self._reply(204)
         self._err(404, f"unknown path {path}")
 

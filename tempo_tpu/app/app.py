@@ -107,12 +107,12 @@ class App:
         self.now = now
         # ring_kv_url: "" = in-process KV + static wiring; "local" = host
         # the shared KV on this process's /kv routes (ring mode); a URL =
-        # consume another process's KV (ring mode)
-        if self.cfg.ring_kv_url and self.cfg.ring_kv_url != "local":
-            from tempo_tpu.ring.kv import RemoteKVStore
-            self.kv = RemoteKVStore(self.cfg.ring_kv_url)
-        else:
-            self.kv = KVStore()
+        # consume another process's KV; a comma list of "local" + peer
+        # URLs = replicated KV (no single point of failure — each listed
+        # member hosts a store; AP: writes land on every reachable member,
+        # reads merge, convergence via heartbeat republish)
+        from tempo_tpu.ring.kv import make_kv
+        self.kv, self.kv_host = make_kv(self.cfg.ring_kv_url)
         self.ready = False
         self._stop = threading.Event()
         # modules (populated by _init_*)

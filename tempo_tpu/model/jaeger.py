@@ -83,7 +83,10 @@ class _R:
             raise ValueError("thrift collection count overruns buffer")
         return n
 
-    def skip(self, t: int) -> None:
+    def skip(self, t: int, depth: int = 0) -> None:
+        if depth > 64:
+            # hostile nesting must be a 400, not a RecursionError/500
+            raise ValueError("thrift nesting too deep")
         if t == T_BOOL or t == T_BYTE:
             self.i += 1
         elif t == T_I16:
@@ -100,19 +103,19 @@ class _R:
                 if ft == T_STOP:
                     break
                 self.i16()
-                self.skip(ft)
+                self.skip(ft, depth + 1)
         elif t in (T_LIST, T_SET):
             et = self.u8()
             for _ in range(self.count(et)):
-                self.skip(et)
+                self.skip(et, depth + 1)
         elif t == T_MAP:
             kt, vt = self.u8(), self.u8()
             n = self.count(kt)
             if n * self._MIN[vt] > len(self.b) - self.i:
                 raise ValueError("thrift map count overruns buffer")
             for _ in range(n):
-                self.skip(kt)
-                self.skip(vt)
+                self.skip(kt, depth + 1)
+                self.skip(vt, depth + 1)
         else:
             raise ValueError(f"unknown thrift type {t}")
 

@@ -131,7 +131,8 @@ class RemoteKVStore:
 
     def __init__(self, base_url: str, poll_interval_s: float = 1.0,
                  timeout_s: float = 5.0) -> None:
-        self.base = base_url.rstrip("/")
+        self._ep = _HttpEndpoint(base_url, timeout_s)
+        self.base = self._ep.base
         self.poll_interval_s = poll_interval_s
         self.timeout = timeout_s
         self._watches: dict[str, list[Callable[[Any], None]]] = {}
@@ -140,18 +141,10 @@ class RemoteKVStore:
         self._stop = threading.Event()
         self._poller: threading.Thread | None = None
 
-    # -- http --------------------------------------------------------------
+    # -- http (shared endpoint plumbing: _HttpEndpoint) --------------------
 
     def _fetch(self, key: str) -> tuple[int, Any]:
-        url = f"{self.base}/kv/{urllib.parse.quote(key)}"
-        try:
-            with urllib.request.urlopen(url, timeout=self.timeout) as r:
-                d = json.loads(r.read())
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                return 0, None
-            raise
-        return d["version"], _value_from_json(d["value"])
+        return self._ep.fetch(key)
 
     def get(self, key: str) -> Any:
         return self._fetch(key)[1]
@@ -163,19 +156,10 @@ class RemoteKVStore:
             new = update(cur)
             if new is None:
                 return cur
-            body = json.dumps({"expect_version": ver,
-                               "value": _value_to_json(new)}).encode()
-            req = urllib.request.Request(
-                f"{self.base}/kv/{urllib.parse.quote(key)}", data=body,
-                headers={"Content-Type": "application/json"})
-            try:
-                with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                    json.loads(r.read())
-            except urllib.error.HTTPError as e:
-                if e.code == 409:
-                    continue            # raced; retry with fresh value
-                raise
-            self._notify(key, new, ver + 1)
+            ok, newver = self._ep.cas_versioned(key, ver, new)
+            if not ok:
+                continue                # raced; retry with fresh value
+            self._notify(key, new, newver)
             return new
         raise RuntimeError(f"CAS contention on {key!r}")
 
@@ -217,6 +201,54 @@ class RemoteKVStore:
                     self._notify(k, val, ver)
 
     def delete(self, key: str) -> None:
+        self._ep.delete(key)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+
+
+# ---------------------------------------------------------------------------
+# Replicated KV: per-member CAS over N hosts (the memberlist de-SPOF)
+# ---------------------------------------------------------------------------
+
+class _HttpEndpoint:
+    """One peer's /kv/* CAS surface."""
+
+    def __init__(self, base_url: str, timeout_s: float = 2.0) -> None:
+        self.base = base_url.rstrip("/")
+        self.timeout = timeout_s
+
+    def __repr__(self) -> str:
+        return f"kv@{self.base}"
+
+    def fetch(self, key: str) -> tuple[int, Any]:
+        url = f"{self.base}/kv/{urllib.parse.quote(key)}"
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout) as r:
+                d = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return 0, None
+            raise
+        return d["version"], _value_from_json(d["value"])
+
+    def cas_versioned(self, key: str, expect_version: int,
+                      value: Any) -> tuple[bool, int]:
+        body = json.dumps({"expect_version": expect_version,
+                           "value": _value_to_json(value)}).encode()
+        req = urllib.request.Request(
+            f"{self.base}/kv/{urllib.parse.quote(key)}", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                d = json.loads(r.read())
+            return True, int(d.get("version", expect_version + 1))
+        except urllib.error.HTTPError as e:
+            if e.code == 409:
+                return False, -1
+            raise
+
+    def delete(self, key: str) -> None:
         req = urllib.request.Request(
             f"{self.base}/kv/{urllib.parse.quote(key)}", method="DELETE")
         try:
@@ -224,5 +256,218 @@ class RemoteKVStore:
         except urllib.error.HTTPError:
             pass
 
+
+class _LocalEndpoint:
+    """The member store this process hosts (also served on its /kv/*)."""
+
+    def __init__(self, store: KVStore) -> None:
+        self.store = store
+
+    def __repr__(self) -> str:
+        return "kv@local"
+
+    def fetch(self, key: str) -> tuple[int, Any]:
+        return self.store.get_versioned(key)
+
+    def cas_versioned(self, key: str, expect_version: int,
+                      value: Any) -> tuple[bool, int]:
+        return self.store.cas_versioned(key, expect_version, value)
+
+    def delete(self, key: str) -> None:
+        self.store.delete(key)
+
+
+def _merge_values(vals: list[Any]) -> Any:
+    """Merge the reachable members' views of one key.
+
+    Ring desc maps merge entry-wise with the freshest heartbeat winning —
+    the convergence rule of gossip: a member that missed a write catches
+    up at the next publish, and a cleanly-left instance lingers only on
+    members that missed the removal (where staleness marks it unhealthy,
+    as with memberlist tombstones). Non-ring values: first non-None view
+    (callers needing linearizable semantics should not fan out)."""
+    from tempo_tpu.ring.ring import InstanceDesc
+
+    ring_maps = [v for v in vals if isinstance(v, dict) and v
+                 and all(isinstance(x, InstanceDesc) for x in v.values())]
+    if ring_maps:
+        out: dict[str, InstanceDesc] = {}
+        for m in ring_maps:
+            for iid, d in m.items():
+                cur = out.get(iid)
+                if cur is None or d.heartbeat_ts > cur.heartbeat_ts:
+                    out[iid] = d
+        return out
+    for v in vals:
+        if v is not None:
+            return v
+    return None
+
+
+class ReplicatedKVStore:
+    """Client-side replication over N KV members: per-member CAS loops;
+    reads and polled watches merge all reachable views. AP like the
+    memberlist gossip it stands in for (`modules.go:593-625`): a write
+    succeeds when ANY member accepts (a cluster must be able to bootstrap
+    from its first member, and a partitioned member re-converges through
+    merge-on-read plus the heartbeat republish cycle); it fails only when
+    no member is reachable. De-SPOFs hosting ring state in one process —
+    any minority of members can die with writes and reads still green."""
+
+    def __init__(self, endpoints: list, poll_interval_s: float = 1.0) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.endpoints = endpoints
+        self.poll_interval_s = poll_interval_s
+        # members are contacted CONCURRENTLY: one hung (not dead) member
+        # must cost the cluster max(latency), not sum — a serial loop
+        # would stall every heartbeat and watch poll by its timeout
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(len(endpoints), 1),
+            thread_name_prefix="kv-member")
+        self._watches: dict[str, list[Callable[[Any], None]]] = {}
+        self._last: dict[str, str] = {}      # key -> merged-content marker
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._poller: threading.Thread | None = None
+
+    def _fan_out(self, fn) -> list:
+        """Run fn(endpoint) on every member concurrently; returns the
+        per-member results with exceptions captured in place."""
+        futs = [self._pool.submit(fn, ep) for ep in self.endpoints]
+        out = []
+        for f in futs:
+            try:
+                out.append(f.result())
+            except Exception as e:
+                out.append(e)
+        return out
+
+    # -- reads ---------------------------------------------------------------
+
+    def _fetch_merged(self, key: str) -> Any:
+        got = self._fan_out(lambda ep: ep.fetch(key)[1])
+        return _merge_values([v for v in got
+                              if not isinstance(v, Exception)])
+
+    def get(self, key: str) -> Any:
+        return self._fetch_merged(key)
+
+    # -- writes --------------------------------------------------------------
+
+    def cas(self, key: str, update: Callable[[Any], Any],
+            retries: int = 10) -> Any:
+        """Apply `update` on every reachable member via its own CAS loop;
+        succeed when any member accepted (AP, see class docstring). Each
+        member converges from ITS current value, so a member that missed
+        earlier writes still ends up consistent for merge-friendly state
+        (ring maps); last-write-wins for everything else. NOTE: `update`
+        runs once per member, concurrently — it must be a pure function
+        of its argument."""
+        def member_cas(ep):
+            for _ in range(retries):
+                ver, cur = ep.fetch(key)
+                new = update(cur)
+                if new is None:
+                    return ("noop", cur)
+                accepted, _v = ep.cas_versioned(key, ver, new)
+                if accepted:
+                    return ("ok", new)
+            raise RuntimeError(f"CAS contention on {ep!r}")
+
+        got = self._fan_out(member_cas)
+        result: Any = None
+        ok = 0
+        errs = [g for g in got if isinstance(g, Exception)]
+        for g in got:
+            if isinstance(g, Exception):
+                continue
+            ok += 1
+            status, val = g
+            if status == "ok" or result is None:
+                result = val
+        if ok == 0:
+            raise RuntimeError(
+                f"KV write failed on {key!r}: 0/{len(self.endpoints)} "
+                f"members accepted (first error: {errs[0] if errs else 'n/a'})")
+        self._notify(key, result)
+        return result
+
+    def delete(self, key: str) -> None:
+        self._fan_out(lambda ep: ep.delete(key))
+
+    # -- watches (polling + merge) -------------------------------------------
+
+    def watch_key(self, key: str, cb: Callable[[Any], None]) -> None:
+        with self._lock:
+            self._watches.setdefault(key, []).append(cb)
+            if self._poller is None:
+                self._poller = threading.Thread(target=self._poll_loop,
+                                                daemon=True)
+                self._poller.start()
+
+    def _marker(self, value: Any) -> str:
+        try:
+            return json.dumps(_value_to_json(value), sort_keys=True,
+                              default=str)
+        except Exception:
+            return repr(value)
+
+    def _notify(self, key: str, value: Any) -> None:
+        if value is None:
+            return
+        mark = self._marker(value)
+        with self._lock:
+            if self._last.get(key) == mark:
+                return
+            self._last[key] = mark
+            watchers = list(self._watches.get(key, ()))
+        for w in watchers:
+            try:
+                w(value)
+            except Exception:
+                pass
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            with self._lock:
+                keys = list(self._watches)
+            for k in keys:
+                try:
+                    val = self._fetch_merged(k)
+                except Exception:
+                    continue
+                if val is not None:
+                    self._notify(k, val)
+
     def shutdown(self) -> None:
         self._stop.set()
+        self._pool.shutdown(wait=False)
+
+
+def make_kv(spec: str) -> tuple[Any, KVStore | None]:
+    """Build the KV client for a `ring_kv_url` spec.
+
+    Returns (kv, hosted_store): "local" → one in-process store (this
+    process hosts the shared KV on its /kv routes); a single URL → remote
+    client of that host; a comma list mixing "local" and peer URLs →
+    replicated KV (each listed member hosts its own store)."""
+    parts = [p.strip() for p in (spec or "").split(",") if p.strip()]
+    if not parts:
+        kv = KVStore()
+        return kv, None
+    if len(parts) == 1:
+        if parts[0] == "local":
+            kv = KVStore()
+            return kv, kv
+        return RemoteKVStore(parts[0]), None
+    host: KVStore | None = None
+    eps: list = []
+    for p in parts:
+        if p == "local":
+            if host is None:
+                host = KVStore()
+            eps.append(_LocalEndpoint(host))
+        else:
+            eps.append(_HttpEndpoint(p))
+    return ReplicatedKVStore(eps), host

@@ -249,3 +249,118 @@ def test_ring_kv_cluster_survives_ingester_death(tmp_path):
             s.shutdown()
         for a in apps.values():
             a.shutdown()
+
+
+def test_replicated_kv_survives_kv_host_death(tmp_path):
+    """The ring KV itself is replicated across the 3 ingester processes
+    (per-member CAS, merged reads — the memberlist de-SPOF, VERDICT r2 #6).
+    The KV member that dies is also a data member; writes, reads, ring
+    convergence, and a brand-new instance JOINING all still work."""
+    store = str(tmp_path / "store")
+    apps, servers = {}, {}
+
+    ports = [_port() for _ in range(3)]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    kv_all = ",".join(urls)
+
+    def boot(name, cfg, kv_url, port=None):
+        cfg.server.http_listen_port = port or _port()
+        cfg.ring_kv_url = kv_url
+        cfg.heartbeat_interval_s = 0.2
+        cfg.heartbeat_timeout_s = 1.5
+        app = App(cfg)
+        app.overrides.set_tenant_patch("single-tenant", {
+            "generator": {"processors": ["span-metrics"]}})
+        app.start_loops()
+        apps[name] = app
+        servers[name] = serve(app, block=False)
+
+    def ing_cfg(i):
+        cfg = Config(target="ingester")
+        cfg.storage.backend = "local"
+        cfg.storage.local_path = store
+        cfg.storage.wal_path = str(tmp_path / f"ing{i}" / "wal")
+        cfg.ingester.instance.trace_idle_s = 0.1
+        return cfg
+
+    # each ingester hosts a KV member: "local" replaces its own URL
+    for i in range(3):
+        members = ["local" if j == i else urls[j] for j in range(3)]
+        boot(f"ing{i}", ing_cfg(i), ",".join(members), port=ports[i])
+
+    d_cfg = Config(target="distributor")
+    d_cfg.distributor.rf = 3
+    boot("dist", d_cfg, kv_all)
+    q_cfg = Config(target="query-frontend")
+    q_cfg.storage.backend = "local"
+    q_cfg.storage.local_path = store
+    q_cfg.querier.rf = 3
+    boot("query", q_cfg, kv_all)
+
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if len(apps["dist"].distributor.ingester_ring) >= 3:
+                break
+            time.sleep(0.1)
+        assert len(apps["dist"].distributor.ingester_ring) == 3
+
+        url = {k: f"http://127.0.0.1:{a.cfg.server.http_listen_port}"
+               for k, a in apps.items()}
+        t0 = int((time.time() - 5) * 1e9)
+
+        def push(tid_hex: str) -> int:
+            otlp = {"resourceSpans": [{"resource": {"attributes": [
+                {"key": "service.name", "value": {"stringValue": "rkv"}}]},
+                "scopeSpans": [{"spans": [{
+                    "traceId": tid_hex, "spanId": "ab" * 8, "name": "rkv-op",
+                    "kind": 2, "startTimeUnixNano": str(t0),
+                    "endTimeUnixNano": str(t0 + 10_000_000)}]}]}]}
+            code, _ = _post(url["dist"] + "/v1/traces",
+                            json.dumps(otlp).encode())
+            return code
+
+        assert push("31" * 16) == 200
+        held = sum(1 for i in range(3)
+                   if apps[f"ing{i}"].ingester.find_trace_by_id(
+                       "single-tenant", b"\x31" * 16))
+        assert held == 3
+
+        # --- kill ingester 1: a KV MEMBER and a data replica, abruptly ---
+        victim = apps.pop("ing1")
+        servers.pop("ing1").shutdown()
+        victim._stop.set()
+
+        # KV writes (heartbeats) keep landing on the 2 surviving members,
+        # so the membership view stays writable: pushes/reads work NOW
+        assert push("32" * 16) == 200
+        held = sum(1 for i in (0, 2)
+                   if apps[f"ing{i}"].ingester.find_trace_by_id(
+                       "single-tenant", b"\x32" * 16))
+        assert held == 2
+        code, tr = _get(url["query"] + f"/api/traces/{'32' * 16}")
+        assert code == 200 and tr["spans"][0]["name"] == "rkv-op"
+
+        # ring convergence continues without the dead KV member
+        time.sleep(2.0)
+        healthy = {i.id for i in
+                   apps["query"].querier.ring.healthy_instances()}
+        assert len(healthy) == 2
+
+        # a brand-new instance can still JOIN through the surviving members
+        # (its member list still names the dead host)
+        boot("ing3", ing_cfg(3), kv_all)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            healthy = {i.id for i in
+                       apps["query"].querier.ring.healthy_instances()}
+            if len(healthy) >= 3:
+                break
+            time.sleep(0.1)
+        assert len(healthy) == 3
+        assert push("33" * 16) == 200
+    finally:
+        for s in servers.values():
+            s.shutdown()
+        for a in apps.values():
+            a.shutdown()
