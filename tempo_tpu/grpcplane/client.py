@@ -55,28 +55,27 @@ class GrpcIngesterClient(_BaseGrpcClient):
 
     def push(self, tenant: str,
              traces: Sequence[tuple[bytes, list[dict]]]) -> list[str | None]:
-        res = _jload(self._call("/tempopb.Pusher/PushBytesV2",
-                                _one_record(traces), tenant))
-        return res.get("errors", [None] * len(traces))
+        from tempo_tpu.model import tempopb
+
+        body = self._call("/tempopb.Pusher/PushBytesV2",
+                          _one_record(traces), tenant)
+        return tempopb.dec_push_response(body, len(traces))
 
     def find_trace_by_id(self, tenant: str, trace_id: bytes):
-        from tempo_tpu.rpc import _json_to_spans
+        from tempo_tpu.model import tempopb
 
-        res = _jload(self._call("/tempopb.Querier/FindTraceByID",
-                                _jdump({"tid": trace_id.hex()}), tenant))
-        spans = res.get("spans")
-        return _json_to_spans(spans) if spans else None
+        body = self._call("/tempopb.Querier/FindTraceByID",
+                          tempopb.enc_trace_by_id_request(trace_id), tenant)
+        return tempopb.dec_trace_by_id_response(body)
 
     def search(self, tenant: str, query: str, limit: int = 20,
                start_s: float = 0, end_s: float = 0):
-        from tempo_tpu.traceql.engine import TraceSearchMetadata
+        from tempo_tpu.model import tempopb
 
-        res = _jload(self._call(
+        body = self._call(
             "/tempopb.Querier/SearchRecent",
-            _jdump({"q": query, "limit": limit,
-                    "start": start_s, "end": end_s}), tenant))
-        return [TraceSearchMetadata.from_json(t)
-                for t in res.get("traces", [])]
+            tempopb.enc_search_request(query, limit, start_s, end_s), tenant)
+        return tempopb.dec_search_response(body)[0]
 
     def tag_names(self, tenant: str) -> dict[str, list[str]]:
         res = _jload(self._call("/tempopb.Querier/SearchTags", b"{}", tenant))
@@ -105,18 +104,14 @@ class GrpcGeneratorClient(_BaseGrpcClient):
         return int(res.get("spans", 0))
 
     def query_range(self, tenant: str, req, clip_start_ns: int | None = None):
-        import numpy as np
+        from tempo_tpu.model import tempopb
 
-        from tempo_tpu.traceql.engine_metrics import TimeSeries
-
-        res = _jload(self._call(
+        body = self._call(
             "/tempopb.MetricsGenerator/QueryRange",
             _jdump({"query": req.query, "start_ns": req.start_ns,
                     "end_ns": req.end_ns, "step_ns": req.step_ns,
-                    "clip_start_ns": clip_start_ns}), tenant))
-        return [TimeSeries(labels=tuple((k, v) for k, v in s["labels"]),
-                           samples=np.asarray(s["samples"], np.float64))
-                for s in res.get("series", [])]
+                    "clip_start_ns": clip_start_ns}), tenant)
+        return tempopb.dec_query_range_response(body)
 
     def get_metrics(self, tenant: str, query: str, group_by) -> dict:
         return _jload(self._call(
@@ -138,13 +133,12 @@ def streaming_search(target: str, tenant: str, query: str, *,
             body["start"] = start_s
         if end_s is not None:
             body["end"] = end_s
+        from tempo_tpu.model import tempopb
+
         for msg in fn(_jdump(body), timeout=timeout_s,
                       metadata=(("x-scope-orgid", tenant),)):
-            d = _jload(msg)
-            from tempo_tpu.traceql.engine import TraceSearchMetadata
-
-            yield [TraceSearchMetadata.from_json(t)
-                   for t in d.get("traces", [])], d.get("final", False)
+            mds, final, _inspected = tempopb.dec_search_response(msg)
+            yield mds, final
 
 
 class FrontendWorker:

@@ -92,10 +92,11 @@ class _Services:
 
     def push_bytes_v2(self, request: bytes, context) -> bytes:
         tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
+        from tempo_tpu.model import tempopb
         from tempo_tpu.rpc import decode_push_body
 
         errs = self.app.ingester.push(tenant, decode_push_body(request))
-        return _jdump({"errors": errs})
+        return tempopb.enc_push_response(errs or ())
 
     # -- MetricsGenerator ---------------------------------------------------
 
@@ -120,7 +121,10 @@ class _Services:
         return _jdump({"spans": n})
 
     def generator_query_range(self, request: bytes, context) -> bytes:
+        """JSON request (tiny), protobuf TimeSeries response (the heavy
+        side; `tempo.proto` QueryRangeResponse)."""
         tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
+        from tempo_tpu.model import tempopb
         from tempo_tpu.traceql.engine_metrics import QueryRangeRequest
 
         d = _jload(request)
@@ -128,9 +132,7 @@ class _Services:
                                 end_ns=d["end_ns"], step_ns=d["step_ns"])
         series = self.app.generator.query_range(
             tenant, req, clip_start_ns=d.get("clip_start_ns"))
-        return _jdump({"series": [
-            {"labels": list(s.labels), "samples": list(map(float, s.samples))}
-            for s in series]})
+        return tempopb.enc_query_range_response(series)
 
     def generator_get_metrics(self, request: bytes, context) -> bytes:
         tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
@@ -143,21 +145,24 @@ class _Services:
     # -- Querier (ingester-side query surface) ------------------------------
 
     def find_trace_by_id(self, request: bytes, context) -> bytes:
+        """Protobuf both ways: TraceByIDRequest in, OTLP trace bytes out
+        (`tempopb.Trace` is OTLP-shaped ResourceSpans)."""
         tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
-        from tempo_tpu.rpc import spans_to_json
+        from tempo_tpu.model import tempopb
 
-        d = _jload(request)
-        spans = self.app.ingester.find_trace_by_id(
-            tenant, bytes.fromhex(d["tid"]))
-        return _jdump({"spans": spans_to_json(spans) if spans else None})
+        tid = tempopb.dec_trace_by_id_request(request)
+        spans = self.app.ingester.find_trace_by_id(tenant, tid)
+        return tempopb.enc_trace_by_id_response(spans)
 
     def search_recent(self, request: bytes, context) -> bytes:
         tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
-        d = _jload(request)
+        from tempo_tpu.model import tempopb
+
+        d = tempopb.dec_search_request(request)
         res = self.app.ingester.search(
             tenant, d.get("q", "{ }"), int(d.get("limit", 20)),
             float(d.get("start", 0)), float(d.get("end", 0)))
-        return _jdump({"traces": [md.to_json() for md in res]})
+        return tempopb.enc_search_response(res, inspected=len(res))
 
     def search_tags(self, request: bytes, context) -> bytes:
         tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
@@ -201,20 +206,21 @@ class _Services:
                 out["err"] = e
             diffs.put(None)
 
+        from tempo_tpu.model import tempopb
+
         t = threading.Thread(target=run, daemon=True)
         t.start()
         while True:
             batch = diffs.get()
             if batch is None:
                 break
-            yield _jdump({"traces": [md.to_json() for md in batch],
-                          "final": False})
+            yield tempopb.enc_search_response(batch, final=False)
         t.join()
         if "err" in out:
             context.abort(grpc.StatusCode.INTERNAL, str(out["err"]))
         res = out.get("res", [])
-        yield _jdump({"traces": [md.to_json() for md in res], "final": True,
-                      "metrics": {"inspectedTraces": len(res)}})
+        yield tempopb.enc_search_response(res, inspected=len(res),
+                                          final=True)
 
     # -- Frontend worker-pull dispatch --------------------------------------
 

@@ -24,6 +24,11 @@ def _enc_span(s: dict) -> bytes:
     for k in ("trace_id", "span_id", "parent_span_id"):
         if k in d and isinstance(d[k], bytes):
             d[k] = d[k].hex()
+    if d.get("links"):     # link ids are bytes in span dicts too
+        d["links"] = [
+            {**ln, **{k: ln[k].hex() for k in ("trace_id", "span_id")
+                      if isinstance(ln.get(k), bytes)}}
+            for ln in d["links"]]
     return json.dumps(d, separators=(",", ":")).encode()
 
 
@@ -32,6 +37,11 @@ def _dec_span(b: bytes) -> dict:
     for k in ("trace_id", "span_id", "parent_span_id"):
         if k in d:
             d[k] = bytes.fromhex(d[k])
+    if d.get("links"):
+        d["links"] = [
+            {**ln, **{k: bytes.fromhex(ln[k]) for k in ("trace_id", "span_id")
+                      if isinstance(ln.get(k), str)}}
+            for ln in d["links"]]
     return d
 
 

@@ -64,7 +64,7 @@ def spans_from_otlp_json(payload: dict) -> Iterable[dict]:
                 scode = status.get("code", 0)
                 if isinstance(scode, str):
                     scode = _STATUS_NAMES.get(scode, 0)
-                yield {
+                span = {
                     "trace_id": binascii.unhexlify(sp.get("traceId", "")),
                     "span_id": binascii.unhexlify(sp.get("spanId", "")),
                     "parent_span_id": binascii.unhexlify(sp.get("parentSpanId", "") or ""),
@@ -78,6 +78,19 @@ def spans_from_otlp_json(payload: dict) -> Iterable[dict]:
                     "attrs": _json_attrs(sp.get("attributes")),
                     "res_attrs": res_attrs,
                 }
+                if sp.get("events"):
+                    span["events"] = [
+                        {"time_unix_nano": int(e.get("timeUnixNano", 0)),
+                         "name": e.get("name", "")}
+                        for e in sp["events"]]
+                if sp.get("links"):
+                    span["links"] = [
+                        {"trace_id": binascii.unhexlify(
+                            ln.get("traceId", "") or ""),
+                         "span_id": binascii.unhexlify(
+                            ln.get("spanId", "") or "")}
+                        for ln in sp["links"]]
+                yield span
 
 
 def otlp_json_to_batch(payload: dict, builder: SpanBatchBuilder | None = None) -> SpanBatch:
@@ -177,6 +190,23 @@ def spans_from_otlp_proto(data: bytes):
                         span["end_unix_nano"] = v4
                     elif f4 == 9:
                         kvs.append(v4)
+                    elif f4 == 11:  # Event{ time=1 fixed64, name=2 }
+                        ev = {"time_unix_nano": 0, "name": ""}
+                        for f5, _, v5 in pw.iter_fields(bytes(v4)):
+                            if f5 == 1:
+                                ev["time_unix_nano"] = v5
+                            elif f5 == 2:
+                                ev["name"] = bytes(v5).decode("utf-8",
+                                                              "replace")
+                        span.setdefault("events", []).append(ev)
+                    elif f4 == 13:  # Link{ trace_id=1, span_id=2 }
+                        ln = {"trace_id": b"", "span_id": b""}
+                        for f5, _, v5 in pw.iter_fields(bytes(v4)):
+                            if f5 == 1:
+                                ln["trace_id"] = bytes(v5)
+                            elif f5 == 2:
+                                ln["span_id"] = bytes(v5)
+                        span.setdefault("links", []).append(ln)
                     elif f4 == 15:  # Status{ message=2, code=3 }
                         for f5, _, v5 in pw.iter_fields(bytes(v4)):
                             if f5 == 2:
@@ -201,6 +231,14 @@ def _enc_anyvalue(v: Any) -> bytes:
         return pw.enc_field_double(4, v)
     if isinstance(v, bytes):
         return pw.enc_field_bytes(7, v)
+    if isinstance(v, (list, tuple)):      # ArrayValue{ values = 1 }
+        return pw.enc_field_msg(5, b"".join(
+            pw.enc_field_msg(1, _enc_anyvalue(x)) for x in v))
+    if isinstance(v, dict):               # KeyValueList{ values = 1 }
+        return pw.enc_field_msg(6, b"".join(
+            pw.enc_field_msg(1, pw.enc_field_str(1, k) +
+                             pw.enc_field_msg(2, _enc_anyvalue(x)))
+            for k, x in v.items()))
     return pw.enc_field_str(1, str(v))
 
 
@@ -253,6 +291,14 @@ def encode_spans_otlp(spans: Iterable[dict]) -> bytes:
             b += (pw.enc_field_fixed64(7, int(s.get("start_unix_nano", 0))) +
                   pw.enc_field_fixed64(8, int(s.get("end_unix_nano", 0))) +
                   _enc_attrs(9, s.get("attrs")))
+            for ev in s.get("events") or ():
+                b += pw.enc_field_msg(11, pw.enc_field_fixed64(
+                    1, int(ev.get("time_unix_nano", 0))) +
+                    pw.enc_field_str(2, ev.get("name", "")))
+            for ln in s.get("links") or ():
+                b += pw.enc_field_msg(13, pw.enc_field_bytes(
+                    1, ln.get("trace_id", b"")) +
+                    pw.enc_field_bytes(2, ln.get("span_id", b"")))
             if status:
                 b += pw.enc_field_msg(15, status)
             span_bufs.append(pw.enc_field_msg(2, b))

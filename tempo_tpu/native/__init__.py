@@ -122,6 +122,24 @@ STAGE_RES_DTYPE = np.dtype([
 ])
 assert STAGE_RES_DTYPE.itemsize == 16
 
+EV_REC_DTYPE = np.dtype([
+    ("name_off", np.int64),
+    ("time_ns", np.uint64),
+    ("name_len", np.int32),
+    ("span_idx", np.int32),
+])
+assert EV_REC_DTYPE.itemsize == 24
+
+LINK_REC_DTYPE = np.dtype([
+    ("trace_id", np.uint8, 16),
+    ("span_id", np.uint8, 8),
+    ("span_idx", np.int32),
+    ("tid_len", np.int32),
+    ("sid_len", np.int32),
+    ("_pad", np.int32),
+])
+assert LINK_REC_DTYPE.itemsize == 40
+
 
 def _build() -> str | None:
     try:
@@ -205,6 +223,10 @@ def _load():
             lib.rowtable_remove.restype = None
             lib.rowtable_size.argtypes = [c.c_void_p]
             lib.rowtable_size.restype = c.c_int64
+            lib.otlp_events.argtypes = [
+                u8p, c.c_int64, c.c_void_p, c.c_int64,
+                c.c_void_p, c.c_int64, i64p]
+            lib.otlp_events.restype = c.c_int32
             # full staging
             lib.otlp_stage.argtypes = [
                 c.c_void_p, u8p, c.c_int64,
@@ -439,6 +461,31 @@ def otlp_stage(interner: "NativeInterner", data: bytes,
         rcap, rescap = max(rcap, nr), max(rescap, nres)
 
 
+def otlp_events(data: bytes, ev_hint: int = 256, link_hint: int = 64
+                ) -> tuple[np.ndarray, np.ndarray] | None:
+    """Span events + links keyed by span index (EvRec/LinkRec arrays);
+    None when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, np.uint8)
+    bp = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    ecap, lcap = max(ev_hint, 16), max(link_hint, 16)
+    while True:
+        evs = np.zeros(ecap, EV_REC_DTYPE)
+        links = np.zeros(lcap, LINK_REC_DTYPE)
+        n_out = np.zeros(2, np.int64)
+        rc = lib.otlp_events(
+            bp, len(data), evs.ctypes.data, ecap, links.ctypes.data, lcap,
+            n_out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if rc != 0:
+            raise ValueError("malformed OTLP protobuf payload")
+        ne, nl = int(n_out[0]), int(n_out[1])
+        if ne <= ecap and nl <= lcap:
+            return evs[:ne], links[:nl]
+        ecap, lcap = max(ecap, ne), max(lcap, nl)
+
+
 def spans_from_otlp_proto_native(data: bytes, return_recs: bool = False):
     """Native scan → flat span dicts (the wire-entry contract of
     `model.otlp.spans_from_otlp_proto`). The C pass extracts every fixed
@@ -528,4 +575,25 @@ def spans_from_otlp_proto_native(data: bytes, return_recs: bool = False):
             v = _pb_anyvalue(data[a_sval_off[j]: a_sval_off[j] + a_sval_len[j]]) \
                 if a_sval_off[j] >= 0 else None
         out[a_span[j]]["attrs"][k] = v
+
+    # events/links (separate native pass; same span traversal order —
+    # keeps the output contract aligned with the python decoder)
+    got_ev = otlp_events(data)
+    if got_ev is not None:
+        evs, links = got_ev
+        e_off = evs["name_off"].tolist(); e_len = evs["name_len"].tolist()
+        e_t = evs["time_ns"].tolist(); e_s = evs["span_idx"].tolist()
+        for j in range(len(evs)):
+            o = e_off[j]
+            out[e_s[j]].setdefault("events", []).append({
+                "time_unix_nano": e_t[j],
+                "name": data[o:o + e_len[j]].decode("utf-8", "replace")
+                if o >= 0 else ""})
+        l_tid = links["trace_id"].tobytes(); l_sid = links["span_id"].tobytes()
+        l_tl = links["tid_len"].tolist(); l_sl = links["sid_len"].tolist()
+        l_s = links["span_idx"].tolist()
+        for j in range(len(links)):
+            out[l_s[j]].setdefault("links", []).append({
+                "trace_id": l_tid[j * 16: j * 16 + min(l_tl[j], 16)],
+                "span_id": l_sid[j * 8: j * 8 + min(l_sl[j], 8)]})
     return (out, recs) if return_recs else out

@@ -259,6 +259,92 @@ int64_t otlp_scan(const uint8_t* buf, int64_t buflen,
     return otlp_scan2(buf, buflen, out, cap, nullptr, 0, &n_attrs);
 }
 
+// --- span events / links ----------------------------------------------------
+// Separate pass extracting Span.events (field 11) and Span.links (field 13)
+// keyed by span index (same traversal order as otlp_scan2), so the common
+// eventless payload pays nothing on the main scan.
+
+struct EvRec {
+    int64_t name_off;
+    uint64_t time_ns;
+    int32_t name_len;
+    int32_t span_idx;
+};
+
+struct LinkRec {
+    uint8_t trace_id[16];
+    uint8_t span_id[8];
+    int32_t span_idx;
+    int32_t tid_len, sid_len, _pad;
+};
+
+// Returns 0 ok / -1 malformed. Counts written to n_out[0]=events,
+// n_out[1]=links (may exceed caps; caller re-calls with bigger buffers).
+int32_t otlp_events(const uint8_t* buf, int64_t buflen,
+                    EvRec* evs, int64_t ecap,
+                    LinkRec* links, int64_t lcap, int64_t* n_out) {
+    Cursor top{buf, buf + buflen, true};
+    uint32_t f, w; uint64_t v, len; const uint8_t* start;
+    int64_t span_idx = -1, ne = 0, nl = 0;
+    while (read_field(top, f, w, v, start, len)) {
+        if (f != 1 || w != 2) continue;            // ResourceSpans
+        Cursor rs{start, start + len, true};
+        uint32_t f2, w2; uint64_t v2, l2; const uint8_t* s2;
+        while (read_field(rs, f2, w2, v2, s2, l2)) {
+            if (f2 != 2 || w2 != 2) continue;      // ScopeSpans
+            Cursor ss{s2, s2 + l2, true};
+            uint32_t f3, w3; uint64_t v3, l3; const uint8_t* s3;
+            while (read_field(ss, f3, w3, v3, s3, l3)) {
+                if (f3 != 2 || w3 != 2) continue;  // Span
+                span_idx++;
+                Cursor sp{s3, s3 + l3, true};
+                uint32_t f4, w4; uint64_t v4, l4; const uint8_t* s4;
+                while (read_field(sp, f4, w4, v4, s4, l4)) {
+                    if (f4 == 11 && w4 == 2) {     // Event
+                        EvRec e{-1, 0, 0, (int32_t)span_idx};
+                        Cursor ev{s4, s4 + l4, true};
+                        uint32_t f5, w5; uint64_t v5, l5; const uint8_t* s5;
+                        while (read_field(ev, f5, w5, v5, s5, l5)) {
+                            if (f5 == 1 && w5 != 2) e.time_ns = v5;
+                            else if (f5 == 2 && w5 == 2) {
+                                e.name_off = s5 - buf;
+                                e.name_len = (int32_t)l5;
+                            }
+                        }
+                        if (!ev.ok) return -1;
+                        if (ne < ecap) evs[ne] = e;
+                        ne++;
+                    } else if (f4 == 13 && w4 == 2) {   // Link
+                        LinkRec lk;
+                        memset(&lk, 0, sizeof(lk));
+                        lk.span_idx = (int32_t)span_idx;
+                        Cursor ln{s4, s4 + l4, true};
+                        uint32_t f5, w5; uint64_t v5, l5; const uint8_t* s5;
+                        while (read_field(ln, f5, w5, v5, s5, l5)) {
+                            if (f5 == 1 && w5 == 2) {
+                                lk.tid_len = (int32_t)l5;
+                                if (l5 <= 16) memcpy(lk.trace_id, s5, l5);
+                            } else if (f5 == 2 && w5 == 2) {
+                                lk.sid_len = (int32_t)l5;
+                                if (l5 <= 8) memcpy(lk.span_id, s5, l5);
+                            }
+                        }
+                        if (!ln.ok) return -1;
+                        if (nl < lcap) links[nl] = lk;
+                        nl++;
+                    }
+                }
+                if (!sp.ok) return -1;
+            }
+            if (!ss.ok) return -1;
+        }
+        if (!rs.ok) return -1;
+    }
+    if (!top.ok) return -1;
+    n_out[0] = ne; n_out[1] = nl;
+    return 0;
+}
+
 }  // extern "C"
 
 // --- persistent string interner --------------------------------------------
