@@ -307,18 +307,35 @@ class App:
 
     def _init_frontend(self) -> None:
         gen_qr = self.generator.query_range if self.generator else None
-        if self.cfg.peers.generators or \
-                (self.cfg.ring_kv_url and self.generator is None):
+        if self.cfg.peers.generators or self.cfg.ring_kv_url:
+            # Fan out over the WHOLE generator ring even when this process
+            # hosts a generator: in a horizontally scaled deployment the
+            # distributor spreads spans across every ring member, so a
+            # local-only read silently returns partial metrics (ADVICE r2
+            # #2). The local generator is served in-process and
+            # UNCONDITIONALLY — it is trivially reachable, so a stale KV
+            # view must not drop its data; the health filter gates only
+            # remote members. The local-id skip applies only in ring-KV
+            # mode, where _iid() and ring member ids share a namespace.
             if self.cfg.peers.generators:
                 clients, gring = self._peer_clients("generators")
+                local_iid = None
             else:
                 gring = self._shared_ring("generator", 1)
                 clients = RingClientPool(gring, "generators")
+                local_iid = self._iid("generator") if self.generator else None
+            local_qr = self.generator.query_range if self.generator else None
 
             def gen_qr(tenant, req, clip_start_ns=None,
-                       _clients=clients, _ring=gring):
+                       _clients=clients, _ring=gring, _local=local_iid,
+                       _local_qr=local_qr):
                 out = []
+                if _local_qr is not None:
+                    out.extend(_local_qr(tenant, req,
+                                         clip_start_ns=clip_start_ns))
                 for inst in _ring.healthy_instances():
+                    if _local is not None and inst.id == _local:
+                        continue       # already served in-process
                     client = _clients.get(inst.id)
                     if client is not None:
                         out.extend(client.query_range(

@@ -274,19 +274,23 @@ class _Services:
                 batch = fe.queue.dequeue_batch(fe.cfg.max_batch_size,
                                                timeout_s=0.2)
                 jobs = []
+                local_jobs = []
                 with plock:
                     for wj in batch:
-                        if wj.spec is None:     # not remotable: run local
-                            wj.run()
-                            continue
-                        if not wj.try_claim():  # issuer already ran it
-                            continue
+                        if wj.spec is None:     # not remotable: runs local,
+                            local_jobs.append(wj)   # AFTER the yield and
+                            continue            # outside plock — neither
+                        if not wj.try_claim():  # the worker nor the result
+                            continue            # reader should wait on it
                         jid = next_id[0]
                         next_id[0] += 1
                         pending[jid] = wj
                         jobs.append({"job_id": jid, "spec": wj.spec})
                 if jobs:
                     yield _jdump({"type": "jobs", "jobs": jobs})
+                for wj in local_jobs:
+                    wj.run()
+                if jobs:
                     # one batch in flight per worker stream: wait for this
                     # batch's results before pulling more so concurrent
                     # workers share the queue (the reference's

@@ -364,3 +364,77 @@ def test_replicated_kv_survives_kv_host_death(tmp_path):
             s.shutdown()
         for a in apps.values():
             a.shutdown()
+
+
+def test_scaled_monolith_generator_fanout(tmp_path):
+    """Two target=all processes share the ring KV: the distributor spreads
+    generator spans across BOTH ring members, so the frontend must fan out
+    over the whole generator ring — a local-only read would silently
+    return partial metrics (ADVICE r2 #2)."""
+    store = str(tmp_path / "store")
+    apps, servers = {}, {}
+
+    def boot(name, kv_url):
+        cfg = Config(target="all")
+        cfg.storage.backend = "local"
+        cfg.storage.local_path = store
+        cfg.storage.wal_path = str(tmp_path / name / "wal")
+        cfg.generator.localblocks.data_dir = str(tmp_path / name / "lb")
+        cfg.server.http_listen_port = _port()
+        cfg.ring_kv_url = kv_url
+        cfg.heartbeat_interval_s = 0.2
+        cfg.heartbeat_timeout_s = 5.0
+        app = App(cfg)
+        app.overrides.set_tenant_patch("single-tenant", {
+            "generator": {"processors": ["span-metrics", "local-blocks"]}})
+        app.start_loops()
+        apps[name] = app
+        servers[name] = serve(app, block=False)
+        return f"http://127.0.0.1:{cfg.server.http_listen_port}"
+
+    kv_url = boot("a", "local")
+    boot("b", kv_url)
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if len(apps["a"].distributor.generator_ring) >= 2 and \
+                    len(apps["b"].distributor.generator_ring) >= 2:
+                break
+            time.sleep(0.1)
+        assert len(apps["a"].distributor.generator_ring) == 2
+
+        url_a = f"http://127.0.0.1:{apps['a'].cfg.server.http_listen_port}"
+        t0 = int((time.time() - 5) * 1e9)
+        spans = []
+        for i in range(1, 41):
+            spans.append({"traceId": ("%02x" % i) * 16, "spanId": "ab" * 8,
+                          "name": "fan-op", "kind": 2,
+                          "startTimeUnixNano": str(t0),
+                          "endTimeUnixNano": str(t0 + 10_000_000)})
+        otlp = {"resourceSpans": [{"resource": {"attributes": [
+            {"key": "service.name", "value": {"stringValue": "fan"}}]},
+            "scopeSpans": [{"spans": spans}]}]}
+        code, _ = _post(url_a + "/v1/traces", json.dumps(otlp).encode())
+        assert code == 200
+
+        # spans really spread across BOTH processes' generators
+        got = [apps[n].generator.instance("single-tenant").spans_received
+               for n in ("a", "b")]
+        assert sum(got) == 40 and all(g > 0 for g in got), got
+
+        # metrics through EITHER frontend must see the FULL count
+        now = time.time()
+        for n in ("a", "b"):
+            base = f"http://127.0.0.1:{apps[n].cfg.server.http_listen_port}"
+            code, qr = _get(base + "/api/metrics/query_range?q=" +
+                            urllib.parse.quote("{ } | count_over_time()") +
+                            f"&start={now - 300}&end={now}&step=300")
+            assert code == 200
+            total = sum(d["value"] for s in qr["series"]
+                        for d in s.get("samples", []) if d["value"] == d["value"])
+            assert total == 40, (n, total, qr["series"])
+    finally:
+        for s in servers.values():
+            s.shutdown()
+        for a in apps.values():
+            a.shutdown()
