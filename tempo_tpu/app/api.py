@@ -418,6 +418,16 @@ class Handler(BaseHTTPRequestHandler):
             for r, v in d.discarded.items():
                 lines.append(
                     f'tempo_discarded_spans_total{{reason="{esc(r)}"}} {v}')
+            for (tenant, reason), v in d.dataquality.snapshot().items():
+                if v:
+                    lines.append(
+                        f'tempo_warnings_total{{tenant="{esc(tenant)}",'
+                        f'reason="{esc(reason)}"}} {v}')
+        ur = getattr(self.app, "usage_reporter", None)
+        if ur is not None:
+            lines.append(
+                f"tempo_usage_stats_reports_written_total "
+                f"{ur.reports_written}")
         fe = self.app.frontend
         if fe is not None:
             for (op, tenant), v in fe.slos.total.items():

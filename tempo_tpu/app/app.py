@@ -127,6 +127,7 @@ class App:
         self.grpc_server = None
         self.grpc_port: int = 0
         self.frontend_worker = None
+        self.usage_reporter = None
         self._lifecyclers: list[Lifecycler] = []
         # warm the native layer at startup so the first proto push never
         # pays the g++ compile inside a request handler
@@ -376,6 +377,14 @@ class App:
             self.db.enable_polling(self.cfg.storage.poll_interval_s)
             if self.cfg.target in (ALL, COMPACTOR):
                 self.db.enable_compaction(self.cfg.compaction_interval_s)
+        if self.cfg.usage_stats_enabled and self.backend is not None:
+            from tempo_tpu.utils.usagestats import UsageReporter
+            self.usage_reporter = UsageReporter(
+                self.kv, self.backend,
+                instance_id=self.cfg.instance_id or self._iid("report"),
+                interval_s=self.cfg.usage_stats_interval_s, now=self.now)
+            self.usage_reporter.set_stat("target", self.cfg.target)
+            self.usage_reporter.start()
         def heartbeat():
             while not self._stop.wait(self.cfg.heartbeat_interval_s):
                 for lc in self._lifecyclers:
@@ -392,6 +401,8 @@ class App:
     def shutdown(self) -> None:
         self.ready = False
         self._stop.set()
+        if getattr(self, "usage_reporter", None) is not None:
+            self.usage_reporter.shutdown()
         if self.frontend_worker:
             self.frontend_worker.shutdown()
         if self.grpc_server:

@@ -93,6 +93,11 @@ class Config:
     overrides_defaults: Limits = dataclasses.field(default_factory=Limits)
     per_tenant_override_config: str = ""   # runtime-config file path
     compaction_interval_s: float = 30.0
+    # anonymized usage reporting (pkg/usagestats): leader-elected via the
+    # shared KV, report written to the backend under usage-stats/ — never
+    # sent anywhere (inspectable stand-in for the reference's reporter)
+    usage_stats_enabled: bool = True
+    usage_stats_interval_s: float = 3600.0
 
     def check(self) -> list[str]:
         """Config sanity warnings (`config.go:145-236` CheckConfig)."""

@@ -81,30 +81,32 @@ def cmd_compaction_summary(args) -> int:
     return 0
 
 
-def cmd_analyse_block(args) -> int:
-    """Attribute stats → dedicated-column candidates (`cmd-analyse-block.go`)."""
-    db = _db(args)
-    from tempo_tpu.backend.meta import read_block_meta
-    m = read_block_meta(db.r, args.block, args.tenant)
-    b = db.backend_block(m)
-    pf = b.parquet_file()
-    stats: dict[tuple, int] = {}
+def _accumulate_attr_bytes(pf, totals: dict) -> None:
+    """Sum per-(scope, key) value bytes over a block's attr list columns
+    (shared by `analyse block` and `analyse blocks`)."""
     for rg in range(pf.num_row_groups):
         tbl = pf.read_row_group(rg, columns=[
             c for c in pf.schema_arrow.names if "attr" in c])
         for col in tbl.schema.names:
-            scope = "span" if col.startswith("s") else "resource"
             if not col.endswith("_keys"):
                 continue
             vals_col = col.replace("_keys", "_vals")
             if vals_col not in tbl.schema.names:
                 continue
-            keys = tbl.column(col).combine_chunks()
-            vals = tbl.column(vals_col).combine_chunks()
-            kf = keys.values.to_pylist()
-            vf = vals.values.to_pylist()
+            scope = "span" if col.startswith("s") else "resource"
+            kf = tbl.column(col).combine_chunks().values.to_pylist()
+            vf = tbl.column(vals_col).combine_chunks().values.to_pylist()
             for k, v in zip(kf, vf):
-                stats[(scope, k)] = stats.get((scope, k), 0) + len(str(v))
+                totals[(scope, k)] = totals.get((scope, k), 0) + len(str(v))
+
+
+def cmd_analyse_block(args) -> int:
+    """Attribute stats → dedicated-column candidates (`cmd-analyse-block.go`)."""
+    db = _db(args)
+    from tempo_tpu.backend.meta import read_block_meta
+    m = read_block_meta(db.r, args.block, args.tenant)
+    stats: dict[tuple, int] = {}
+    _accumulate_attr_bytes(db.backend_block(m).parquet_file(), stats)
     top = sorted(stats.items(), key=lambda kv: -kv[1])[: args.top]
     print(f"{'SCOPE':>9} {'ATTRIBUTE':40} {'BYTES':>12}")
     for (scope, k), sz in top:
@@ -223,6 +225,104 @@ def cmd_migrate_tenant(args) -> int:
     return 0
 
 
+def cmd_analyse_blocks(args) -> int:
+    """Cross-block rollup of `analyse block` (`cmd-analyse-blocks.go`)."""
+    db = _db(args)
+    metas = sorted(db.blocklist.metas(args.tenant),
+                   key=lambda m: -m.size_bytes)[: args.max_blocks]
+    if not metas:
+        print("no blocks", file=sys.stderr)
+        return 1
+    totals: dict[tuple, int] = {}
+    for m in metas:
+        _accumulate_attr_bytes(db.backend_block(m).parquet_file(), totals)
+    top = sorted(totals.items(), key=lambda kv: -kv[1])[: args.top]
+    print(f"analysed {len(metas)} block(s)")
+    print(f"{'SCOPE':>9} {'ATTRIBUTE':40} {'BYTES':>12}")
+    for (scope, k), sz in top:
+        print(f"{scope:>9} {k:40} {sz:>12}")
+    return 0
+
+
+def cmd_list_index(args) -> int:
+    """Tenant index contents (`cmd-list-index.go`)."""
+    from tempo_tpu.backend import meta as bm
+    db = _db(args)
+    try:
+        idx = bm.read_tenant_index(db.r, args.tenant)
+    except Exception as e:
+        print(f"no tenant index: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps({
+        "created_at": idx.created_at,
+        "meta": [m.to_json() for m in idx.metas],
+        "compacted": [c.to_json() for c in idx.compacted],
+    }, indent=2))
+    return 0
+
+
+def cmd_view_schema(args) -> int:
+    """Parquet schema of a block's data file (`cmd-view-pq-schema.go`)."""
+    db = _db(args)
+    from tempo_tpu.backend.meta import read_block_meta
+    m = read_block_meta(db.r, args.block, args.tenant)
+    pf = db.backend_block(m).parquet_file()
+    print(pf.schema_arrow)
+    print(f"\nrow groups: {pf.num_row_groups}  rows: {pf.metadata.num_rows}"
+          f"  size: {m.size_bytes}B")
+    return 0
+
+
+def cmd_query_metrics(args) -> int:
+    """TraceQL metrics over backend blocks (the query-range path the
+    metrics queriers run; `tempo-cli query api metrics` analog)."""
+    import time as _t
+
+    from tempo_tpu.traceql.engine_metrics import QueryRangeRequest
+    db = _db(args)
+    end = args.end or _t.time()
+    start = args.start or end - 3600
+    req = QueryRangeRequest(query=args.query, start_ns=int(start * 1e9),
+                            end_ns=int(end * 1e9),
+                            step_ns=int(args.step * 1e9))
+    for s in db.query_range(args.tenant, req):
+        print(json.dumps({"labels": list(s.labels),
+                          "samples": [float(v) for v in s.samples]}))
+    return 0
+
+
+def cmd_query_tags(args) -> int:
+    """Distinct attr keys straight off the blocks' key-list columns."""
+    from tempo_tpu.block.fetch import block_tag_names
+    db = _db(args)
+    out: dict[str, set] = {"span": set(), "resource": set()}
+    for m in db.blocklist.metas(args.tenant):
+        got = block_tag_names(db.backend_block(m), limit=args.limit)
+        out["span"] |= got["span"]
+        out["resource"] |= got["resource"]
+    print(json.dumps({k: sorted(v) for k, v in out.items()}, indent=2))
+    return 0
+
+
+def cmd_usage_stats(args) -> int:
+    """Print the persisted anonymized usage report (pkg/usagestats)."""
+    from tempo_tpu.backend.raw import KeyPath
+    from tempo_tpu.utils.usagestats import REPORT_NAME
+    r, _w = _open_backend(args)
+    try:
+        print(r.read(REPORT_NAME, KeyPath(("usage-stats",))).decode())
+    except Exception as e:
+        print(f"no usage report: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_version(_args) -> int:
+    from tempo_tpu import __version__
+    print(f"tempo_tpu {__version__}")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser("tempo_tpu.cli")
     ap.add_argument("--backend", default="local")
@@ -234,17 +334,35 @@ def main(argv: list[str] | None = None) -> int:
     q = ls.add_parser("blocks"); q.add_argument("tenant"); q.set_defaults(fn=cmd_list_blocks)
     q = ls.add_parser("block"); q.add_argument("tenant"); q.add_argument("block"); q.set_defaults(fn=cmd_list_block)
     q = ls.add_parser("compaction-summary"); q.add_argument("tenant"); q.set_defaults(fn=cmd_compaction_summary)
+    q = ls.add_parser("index"); q.add_argument("tenant"); q.set_defaults(fn=cmd_list_index)
 
     p = sub.add_parser("analyse")
     an = p.add_subparsers(dest="what", required=True)
     q = an.add_parser("block"); q.add_argument("tenant"); q.add_argument("block")
     q.add_argument("--top", type=int, default=20); q.set_defaults(fn=cmd_analyse_block)
+    q = an.add_parser("blocks"); q.add_argument("tenant")
+    q.add_argument("--top", type=int, default=20)
+    q.add_argument("--max-blocks", type=int, default=10)
+    q.set_defaults(fn=cmd_analyse_blocks)
+
+    p = sub.add_parser("view")
+    vw = p.add_subparsers(dest="what", required=True)
+    q = vw.add_parser("pq-schema"); q.add_argument("tenant"); q.add_argument("block")
+    q.set_defaults(fn=cmd_view_schema)
 
     p = sub.add_parser("query")
     qs = p.add_subparsers(dest="what", required=True)
     q = qs.add_parser("trace"); q.add_argument("tenant"); q.add_argument("trace_id"); q.set_defaults(fn=cmd_query_trace)
     q = qs.add_parser("search"); q.add_argument("tenant"); q.add_argument("query")
     q.add_argument("--limit", type=int, default=20); q.set_defaults(fn=cmd_query_search)
+    q = qs.add_parser("metrics"); q.add_argument("tenant"); q.add_argument("query")
+    q.add_argument("--start", type=float, default=0.0)
+    q.add_argument("--end", type=float, default=0.0)
+    q.add_argument("--step", type=float, default=60.0)
+    q.set_defaults(fn=cmd_query_metrics)
+    q = qs.add_parser("tags"); q.add_argument("tenant")
+    q.add_argument("--limit", type=int, default=1000)
+    q.set_defaults(fn=cmd_query_tags)
     for what in ("trace", "search", "tags"):
         q = qs.add_parser(f"api-{what}")
         q.add_argument("url"); q.add_argument("tenant")
@@ -267,6 +385,9 @@ def main(argv: list[str] | None = None) -> int:
     mg = p.add_subparsers(dest="what", required=True)
     q = mg.add_parser("tenant"); q.add_argument("src"); q.add_argument("dst")
     q.set_defaults(fn=cmd_migrate_tenant)
+
+    q = sub.add_parser("usage-stats"); q.set_defaults(fn=cmd_usage_stats)
+    q = sub.add_parser("version"); q.set_defaults(fn=cmd_version)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -81,8 +81,10 @@ class Distributor:
             ForwarderConfig,
             ForwarderManager,
         )
+        from tempo_tpu.utils.dataquality import DataQuality
         from tempo_tpu.utils.usage import UsageTracker
         self.usage = UsageTracker()
+        self.dataquality = DataQuality(now=now)
         self.forwarders = ForwarderManager()
         for tenant, fwd_cfgs in (self.cfg.forwarders or {}).items():
             for fc in fwd_cfgs:
@@ -125,6 +127,7 @@ class Distributor:
         self.metrics["spans_received_total"] += len(spans)
         self.metrics["bytes_received_total"] += sz
         self.usage.observe(tenant, spans, sz)
+        self.dataquality.observe_spans(tenant, spans)
 
         orig_spans = spans
         if lim.ingestion.max_attribute_bytes:

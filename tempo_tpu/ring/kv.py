@@ -393,6 +393,38 @@ class ReplicatedKVStore:
         self._notify(key, result)
         return result
 
+    def cas_primary(self, key: str, update: Callable[[Any], Any],
+                    retries: int = 10) -> Any:
+        """CAS against the FIRST reachable member only (deterministic
+        endpoint order). Election-style state (leases, cluster seeds)
+        must not run the update once per member — per-member CAS can
+        hand two contenders different winners. Merged reads prefer the
+        first reachable member's view, so this is consistent while that
+        member is up; a partition can still elect twice (at-least-once
+        semantics, like gossip-backed election in the reference)."""
+        errs: list[Exception] = []
+        for ep in self.endpoints:
+            contended = False
+            try:
+                for _ in range(retries):
+                    ver, cur = ep.fetch(key)
+                    new = update(cur)
+                    if new is None:
+                        return cur
+                    ok, _v = ep.cas_versioned(key, ver, new)
+                    if ok:
+                        self._notify(key, new)
+                        return new
+                contended = True       # reachable but raced out: surface,
+            except Exception as e:     # don't fail over to another member
+                errs.append(e)
+                continue
+            if contended:
+                raise RuntimeError(f"CAS contention on {key!r} via {ep!r}")
+        raise RuntimeError(
+            f"KV cas_primary failed on {key!r}: no member reachable "
+            f"(first error: {errs[0] if errs else 'n/a'})")
+
     def delete(self, key: str) -> None:
         self._fan_out(lambda ep: ep.delete(key))
 

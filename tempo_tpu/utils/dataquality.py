@@ -1,0 +1,60 @@
+"""Data-quality warning metrics (ref `pkg/dataquality/dataquality.go`).
+
+The reference counts spans whose timestamps are disagreeably far in the
+future or past (`tempo_warnings_total{reason=...}`) so operators can spot
+misbehaving SDK clocks before they skew blocks and metrics. Same idea
+here, vectorized: one pass over a batch's start times."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Sequence
+
+REASON_OUTSIDE_INGESTION_SLACK = "outside_ingestion_time_slack"
+REASON_BLOCK_OUTSIDE_SLACK = "blocks_outside_ingestion_time_slack"
+REASON_FUTURE = "disparate_future_time"
+REASON_PAST = "disparate_past_time"
+
+_FUTURE_S = 2 * 3600.0          # dataquality.go thresholds
+_PAST_S = 14 * 24 * 3600.0
+
+
+class DataQuality:
+    """Per-tenant warning counters, exposed on /metrics as
+    tempo_warnings_total{tenant,reason}."""
+
+    def __init__(self, now: Callable[[], float] = time.time) -> None:
+        self.now = now
+        self._lock = threading.Lock()
+        self.warnings: dict[tuple[str, str], int] = {}
+
+    def warn(self, tenant: str, reason: str, n: int = 1) -> None:
+        if n <= 0:
+            return
+        with self._lock:
+            k = (tenant, reason)
+            self.warnings[k] = self.warnings.get(k, 0) + int(n)
+
+    def observe_spans(self, tenant: str, spans: Sequence[dict]) -> None:
+        """Count spans with clocks far off now (one pass, no copies)."""
+        now_ns = self.now() * 1e9
+        fut = now_ns + _FUTURE_S * 1e9
+        past = now_ns - _PAST_S * 1e9
+        n_future = n_past = 0
+        for s in spans:
+            st = s.get("start_unix_nano", 0)
+            if st > fut:
+                n_future += 1
+            elif st and st < past:
+                n_past += 1
+        self.warn(tenant, REASON_FUTURE, n_future)
+        self.warn(tenant, REASON_PAST, n_past)
+
+    def snapshot(self) -> dict[tuple[str, str], int]:
+        with self._lock:
+            return dict(self.warnings)
+
+
+__all__ = ["DataQuality", "REASON_FUTURE", "REASON_PAST",
+           "REASON_OUTSIDE_INGESTION_SLACK", "REASON_BLOCK_OUTSIDE_SLACK"]

@@ -138,3 +138,130 @@ def test_caching_reader_roles():
     # page ranges cached under page role
     assert r.read_range("data.parquet", kp, 2, 3) == b"234"
     assert r.read_range("data.parquet", kp, 2, 3) == b"234"
+
+
+# -- usage stats (pkg/usagestats analog) ------------------------------------
+
+def test_usage_reporter_leader_election_and_report():
+    import json
+
+    from tempo_tpu.backend.mem import MemBackend
+    from tempo_tpu.ring.kv import KVStore
+    from tempo_tpu.backend.raw import KeyPath
+    from tempo_tpu.utils.usagestats import REPORT_NAME, UsageReporter
+
+    clock = [1000.0]
+    now = lambda: clock[0]
+    kv = KVStore()
+    be = MemBackend()
+    a = UsageReporter(kv, be, instance_id="a", lease_s=90, now=now)
+    b = UsageReporter(kv, be, instance_id="b", lease_s=90, now=now)
+
+    # one leader; the seed is cluster-wide stable
+    assert a.try_acquire_leadership()
+    assert not b.try_acquire_leadership()
+    seed1, seed2 = a.get_or_create_seed(), b.get_or_create_seed()
+    assert seed1 == seed2
+
+    a.inc_stat("spans", 41)
+    a.inc_stat("spans")
+    a.set_stat("target", "all")
+    assert a.report_once()
+    rep = json.loads(be.read(REPORT_NAME, KeyPath(("usage-stats",))))
+    assert rep["clusterID"] == seed1
+    assert rep["metrics"]["spans"] == 42
+    assert rep["target"] == "all"
+    assert not b.report_once()          # not leader: no write
+
+    # lease lapses -> the other member takes over
+    clock[0] += 200
+    assert b.try_acquire_leadership()
+    assert not a.try_acquire_leadership()
+    assert b.report_once()
+
+
+def test_usage_reporter_over_replicated_kv():
+    """Leader election against the replicated KV routes through ONE
+    member (cas_primary): two contenders racing the same empty lease get
+    exactly one winner, and the cluster seed is minted once."""
+    from tempo_tpu.backend.mem import MemBackend
+    from tempo_tpu.ring.kv import KVStore, ReplicatedKVStore, _LocalEndpoint
+    from tempo_tpu.utils.usagestats import UsageReporter
+
+    stores = [KVStore() for _ in range(3)]
+    clock = [50.0]
+    now = lambda: clock[0]
+
+    def client():
+        return ReplicatedKVStore([_LocalEndpoint(s) for s in stores])
+
+    a = UsageReporter(client(), MemBackend(), instance_id="a", now=now)
+    b = UsageReporter(client(), MemBackend(), instance_id="b", now=now)
+    # concurrent contention for the same empty lease: exactly one winner
+    import threading
+    wins = {}
+    barrier = threading.Barrier(2)
+    def contend(r, key):
+        barrier.wait()
+        wins[key] = r.try_acquire_leadership()
+    ts = [threading.Thread(target=contend, args=(r, k))
+          for r, k in ((a, "a"), (b, "b"))]
+    [t.start() for t in ts]; [t.join() for t in ts]
+    assert sorted(wins.values()) == [False, True], wins
+    # renewal keeps it with the winner
+    clock[0] += 30
+    winner, loser = (a, b) if wins["a"] else (b, a)
+    assert winner.try_acquire_leadership()
+    assert not loser.try_acquire_leadership()
+    # the seed is minted once, cluster-wide
+    assert a.get_or_create_seed() == b.get_or_create_seed()
+
+
+# -- data quality warnings (pkg/dataquality analog) -------------------------
+
+def test_dataquality_warnings():
+    from tempo_tpu.utils.dataquality import (REASON_FUTURE, REASON_PAST,
+                                             DataQuality)
+
+    now = lambda: 1_000_000_000.0
+    dq = DataQuality(now=now)
+    ns = lambda s: int(s * 1e9)
+    spans = [
+        {"start_unix_nano": ns(1_000_000_000)},          # fine
+        {"start_unix_nano": ns(1_000_000_000 + 3 * 3600)},   # future
+        {"start_unix_nano": ns(1_000_000_000 - 15 * 86400)}, # way past
+        {"start_unix_nano": 0},                          # absent: ignored
+    ]
+    dq.observe_spans("t1", spans)
+    snap = dq.snapshot()
+    assert snap[("t1", REASON_FUTURE)] == 1
+    assert snap[("t1", REASON_PAST)] == 1
+
+
+def test_dataquality_exposed_on_metrics(tmp_path):
+    import urllib.request
+
+    from tempo_tpu.app import App
+    from tempo_tpu.app.api import serve
+    from tempo_tpu.app.config import Config
+    from tempo_tpu.utils.dataquality import REASON_FUTURE
+
+    import socket
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]; s.close()
+    cfg = Config(target="all")
+    cfg.storage.backend = "mem"
+    cfg.storage.wal_path = str(tmp_path / "wal")
+    cfg.generator.localblocks.data_dir = str(tmp_path / "lb")
+    cfg.server.http_listen_port = port
+    app = App(cfg)
+    srv = serve(app, block=False)
+    try:
+        app.distributor.dataquality.warn("single-tenant", REASON_FUTURE, 3)
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        assert 'tempo_warnings_total{tenant="single-tenant",' \
+               f'reason="{REASON_FUTURE}"}} 3' in body
+    finally:
+        srv.shutdown()
+        app.shutdown()

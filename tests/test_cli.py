@@ -115,3 +115,41 @@ def test_vulture_against_live_server(tmp_path):
     finally:
         srv.shutdown()
         app.shutdown()
+
+
+def test_cli_new_commands(block_dir, capsys):
+    path, meta = block_dir
+    # analyse blocks (rollup)
+    assert cli_main(["--path", path, "analyse", "blocks", "t1"]) == 0
+    assert "http.path" in capsys.readouterr().out
+    # view pq-schema
+    assert cli_main(["--path", path, "view", "pq-schema", "t1",
+                     meta.block_id]) == 0
+    out = capsys.readouterr().out
+    assert "trace_id" in out and "row groups" in out
+    # query metrics over the backend block
+    assert cli_main(["--path", path, "query", "metrics", "t1",
+                     "{ } | count_over_time()",
+                     "--start", str(T0), "--end", str(T0 + 60),
+                     "--step", "60"]) == 0
+    out = capsys.readouterr().out
+    assert '"samples"' in out
+    # query tags
+    assert cli_main(["--path", path, "query", "tags", "t1"]) == 0
+    out = capsys.readouterr().out
+    assert "http.path" in out        # span-scope key from the block
+    # list index (poller wrote the tenant index during poll_now)
+    assert cli_main(["--path", path, "list", "index", "t1"]) == 0
+    assert meta.block_id in capsys.readouterr().out
+    # version
+    assert cli_main(["--path", path, "version"]) == 0
+    assert "tempo_tpu" in capsys.readouterr().out
+    # usage-stats: none written yet -> rc 1; after a report -> rc 0
+    assert cli_main(["--path", path, "usage-stats"]) == 1
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.ring.kv import KVStore
+    from tempo_tpu.utils.usagestats import UsageReporter
+    rep = UsageReporter(KVStore(), LocalBackend(path), instance_id="cli")
+    assert rep.report_once()
+    assert cli_main(["--path", path, "usage-stats"]) == 0
+    assert "clusterID" in capsys.readouterr().out
