@@ -82,6 +82,14 @@ class Handler(BaseHTTPRequestHandler):
     # -- ingest ------------------------------------------------------------
 
     def do_POST(self) -> None:  # noqa: N802
+        from tempo_tpu.utils import tracing
+
+        # join the caller's W3C trace context (receiver half of the
+        # propagation install, main.go:252-258)
+        with tracing.adopted(self.headers.get("traceparent")):
+            self._do_post()
+
+    def _do_post(self) -> None:
         path = urlparse(self.path).path
         tenant = self._tenant()
         if not tenant:
@@ -264,6 +272,10 @@ class Handler(BaseHTTPRequestHandler):
                 return self._status(path)
             if path == "/metrics":
                 return self._self_metrics()
+            if path == "/debug/threads":
+                return self._debug_threads()
+            if path == "/debug/profile":
+                return self._debug_profile(q)
             if path.startswith("/kv/"):
                 return self._kv_get(path[len("/kv/"):])
             if path == "/usage_metrics":
@@ -406,6 +418,49 @@ class Handler(BaseHTTPRequestHandler):
                         if getattr(self.app, m) is not None],
         }
         self._reply(200, _json_bytes(body))
+
+    def _debug_threads(self) -> None:
+        """All thread stacks — the pprof goroutine-dump analog (the
+        reference leans on dskit's admin server + Go pprof)."""
+        import sys
+        import traceback
+
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = []
+        for tid, frame in sys._current_frames().items():
+            out.append(f"--- thread {names.get(tid, '?')} ({tid}) ---")
+            out.extend(line.rstrip() for line in
+                       traceback.format_stack(frame))
+        self._reply(200, "\n".join(out).encode() + b"\n", "text/plain")
+
+    def _debug_profile(self, q: dict) -> None:
+        """Sampling wall-clock profile over ?seconds=N (capped): stacks of
+        every thread sampled at ~100Hz, aggregated by frame — the CPU
+        pprof analog without native profiler support."""
+        import sys
+        import time as _t
+
+        seconds = min(float(q.get("seconds", 2)), 30.0)
+        hits: dict[str, int] = {}
+        samples = 0
+        deadline = _t.time() + seconds
+        me = threading.get_ident()
+        while _t.time() < deadline:
+            for tid, frame in sys._current_frames().items():
+                if tid == me:
+                    continue
+                f = frame
+                while f is not None:
+                    co = f.f_code
+                    key = f"{co.co_filename}:{f.f_lineno} {co.co_name}"
+                    hits[key] = hits.get(key, 0) + 1
+                    f = f.f_back
+            samples += 1
+            _t.sleep(0.01)
+        top = sorted(hits.items(), key=lambda kv: -kv[1])[:100]
+        lines = [f"samples: {samples} over {seconds}s", ""]
+        lines += [f"{n:>8} {k}" for k, n in top]
+        self._reply(200, "\n".join(lines).encode() + b"\n", "text/plain")
 
     def _self_metrics(self) -> None:
         """Prometheus text exposition of service self-metrics."""

@@ -377,6 +377,12 @@ class App:
             self.db.enable_polling(self.cfg.storage.poll_interval_s)
             if self.cfg.target in (ALL, COMPACTOR):
                 self.db.enable_compaction(self.cfg.compaction_interval_s)
+        if self.cfg.self_tracing_endpoint:
+            from tempo_tpu.utils import tracing
+            tracing.install(tracing.SelfTracer(
+                self.cfg.self_tracing_endpoint,
+                service_name=f"tempo-tpu-{self.cfg.target}",
+                tenant=self.cfg.self_tracing_tenant, now=self.now))
         if self.cfg.usage_stats_enabled and self.backend is not None:
             from tempo_tpu.utils.usagestats import UsageReporter
             self.usage_reporter = UsageReporter(
@@ -403,6 +409,10 @@ class App:
         self._stop.set()
         if getattr(self, "usage_reporter", None) is not None:
             self.usage_reporter.shutdown()
+        if self.cfg.self_tracing_endpoint:     # only the installer may
+            from tempo_tpu.utils import tracing   # clobber the global
+            tracing.tracer().shutdown()
+            tracing.install(tracing.NoopTracer())
         if self.frontend_worker:
             self.frontend_worker.shutdown()
         if self.grpc_server:

@@ -98,6 +98,11 @@ class Config:
     # sent anywhere (inspectable stand-in for the reference's reporter)
     usage_stats_enabled: bool = True
     usage_stats_interval_s: float = 3600.0
+    # self-tracing (cmd/tempo/main.go:227-281): OTLP/HTTP endpoint that
+    # receives this process's own spans — another cluster, or this very
+    # process's listen address (dogfood mode). Empty = disabled.
+    self_tracing_endpoint: str = ""
+    self_tracing_tenant: str = "tempo-self"
 
     def check(self) -> list[str]:
         """Config sanity warnings (`config.go:145-236` CheckConfig)."""

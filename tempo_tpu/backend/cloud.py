@@ -1,35 +1,17 @@
 """Cloud object-store backend factory: S3 / GCS / Azure.
 
-The reference ships full impls (`tempodb/backend/{s3,gcs,azure}/`). Here:
+The reference ships full impls (`tempodb/backend/{s3,gcs,azure}/`). Here,
+all SDK-free:
 
-- **s3**: a real, SDK-free SigV4 client (`backend/s3.py`) that works
-  against any S3-compatible endpoint (AWS, MinIO, Ceph RGW, the test mock).
-- **gcs**: served through the same client via GCS's S3-interoperability XML
-  API (`storage.googleapis.com` + HMAC keys) — the supported SDK-free path.
-- **azure**: gated adapter; Azure Blob's SharedKey auth has no
-  S3-compatible mode and no SDK exists in this environment, so construction
-  raises with a clear pointer at the working backends.
+- **s3**: SigV4 client (`backend/s3.py`) against any S3-compatible
+  endpoint (AWS, MinIO, Ceph RGW, the test mock).
+- **gcs**: the same client via GCS's S3-interoperability XML API
+  (`storage.googleapis.com` + HMAC keys).
+- **azure**: SharedKey Blob client (`backend/azure.py`) against Azure or
+  Azurite, signature-verified by the test mock.
 """
 
 from __future__ import annotations
-
-
-class AzureBackend:
-    """`tempodb/backend/azure/` analog — gated: requires the azure SDK,
-    which this environment does not ship."""
-
-    def __init__(self, **config: object) -> None:
-        try:
-            __import__("azure.storage.blob")
-        except ImportError as e:
-            raise RuntimeError(
-                "azure backend requires the 'azure.storage.blob' SDK, which "
-                "is not available in this environment; use the 's3' backend "
-                "(any S3-compatible endpoint) or 'local' instead"
-            ) from e
-        raise NotImplementedError(
-            "azure backend: SDK present but adapter not wired; "
-            "see tempo_tpu/backend/s3.py for the implementation shape")
 
 
 def open_backend(kind: str, **config: object):
@@ -52,5 +34,7 @@ def open_backend(kind: str, **config: object):
         config.setdefault("endpoint", "storage.googleapis.com")
         return S3Backend(**config)
     if kind == "azure":
+        from tempo_tpu.backend.azure import AzureBackend
+
         return AzureBackend(**config)
     raise ValueError(f"unknown backend {kind!r} (want local|mem|s3|gcs|azure)")

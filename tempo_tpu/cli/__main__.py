@@ -323,6 +323,44 @@ def cmd_version(_args) -> int:
     return 0
 
 
+def cmd_gen_docs(_args) -> int:
+    """Config manifest from the dataclasses (`pkg/docsgen`
+    generate_manifest.go analog): every key, type, and default."""
+    import dataclasses
+
+    from tempo_tpu.app.config import Config
+
+    print("# Configuration manifest\n")
+    print("Generated from the config dataclasses "
+          "(`python -m tempo_tpu.cli gen docs`).\n")
+
+    def walk(cls, prefix: str) -> None:
+        rows = []
+        subs = []
+        for f in dataclasses.fields(cls):
+            default = f.default
+            if default is dataclasses.MISSING and \
+                    f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
+                default = f.default_factory()                       # type: ignore[misc]
+            if dataclasses.is_dataclass(default):
+                subs.append((f.name, type(default)))
+                continue
+            t = getattr(f.type, "__name__", None) or str(f.type)
+            rows.append((f.name, t, default))
+        if rows:
+            print(f"## {prefix or '(root)'}\n")
+            print("| key | type | default |")
+            print("|---|---|---|")
+            for name, t, d in rows:
+                print(f"| `{prefix}{name}` | {t} | `{d!r}` |")
+            print()
+        for name, sub in subs:
+            walk(sub, f"{prefix}{name}.")
+
+    walk(Config, "")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser("tempo_tpu.cli")
     ap.add_argument("--backend", default="local")
@@ -375,6 +413,7 @@ def main(argv: list[str] | None = None) -> int:
     for what in ("bloom", "index"):
         q = g.add_parser(what); q.add_argument("tenant"); q.add_argument("block")
         q.set_defaults(fn=cmd_gen, what=what)
+    q = g.add_parser("docs"); q.set_defaults(fn=cmd_gen_docs)
 
     p = sub.add_parser("rewrite")
     rw = p.add_subparsers(dest="what", required=True)

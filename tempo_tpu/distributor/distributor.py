@@ -114,6 +114,14 @@ class Distributor:
         dicts). `spans` must be in payload scan order in that case;
         `raw_recs` is the receiver's native SpanRec scan of the same bytes
         (passed along so the tee does not scan twice)."""
+        from tempo_tpu.utils import tracing
+        with tracing.span_for_tenant("distributor.PushSpans", tenant,
+                                     n_spans=len(spans)):
+            return self._push_spans(tenant, spans, size_bytes, raw_otlp,
+                                    raw_recs)
+
+    def _push_spans(self, tenant, spans, size_bytes, raw_otlp,
+                    raw_recs) -> dict[str, int]:
         lim = self.overrides.for_tenant(tenant)
         sz = size_bytes if size_bytes is not None else _approx_bytes(spans)
         rate = effective_rate(lim.ingestion.rate_strategy,

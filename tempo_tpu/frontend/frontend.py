@@ -194,6 +194,14 @@ class Frontend:
         """on_partial (optional) receives the combiner's current results
         after each fold — the hook the streaming gRPC endpoint uses to
         emit diff responses (`combiner/search.go`)."""
+        from tempo_tpu.utils import tracing
+        with tracing.span_for_tenant("frontend.Search", tenant, query=query):
+            return self._search(tenant, query, limit=limit, start_s=start_s,
+                                end_s=end_s, on_partial=on_partial)
+
+    def _search(self, tenant: str, query: str, *, limit: int = 20,
+                start_s: float | None = None, end_s: float | None = None,
+                on_partial: Callable[[list], None] | None = None) -> list:
         t0 = self.now()
         end_s = end_s if end_s is not None else self.now()
         start_s = start_s if start_s is not None else end_s - 3600.0
@@ -250,6 +258,15 @@ class Frontend:
         blocks), older from backend jobs; job series merge via
         SeriesCombiner then final quantile/rate pass
         (`metrics_query_range_sharder.go` + `combiner/metrics_query_range.go`)."""
+        from tempo_tpu.utils import tracing
+        with tracing.span_for_tenant("frontend.QueryRange", tenant,
+                                     query=query):
+            return self._query_range(tenant, query, start_s=start_s,
+                                     end_s=end_s, step_s=step_s)
+
+    def _query_range(self, tenant: str, query: str, *,
+                     start_s: float, end_s: float, step_s: float = 60.0
+                     ) -> list[TimeSeries]:
         t0 = self.now()
         req = QueryRangeRequest(query=query,
                                 start_ns=int(start_s * 1e9),

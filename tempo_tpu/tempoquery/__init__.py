@@ -1,0 +1,3 @@
+from tempo_tpu.tempoquery.plugin import build_tempo_query_server
+
+__all__ = ["build_tempo_query_server"]

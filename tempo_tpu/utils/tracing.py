@@ -1,0 +1,216 @@
+"""Self-tracing: the framework traces its own hot entry points.
+
+The reference installs an OTel tracer at startup (`cmd/tempo/main.go:
+227-281`) and wraps hot entries in spans (`distributor.PushBytes`
+`distributor.go:401`, `traceql.Engine.ExecuteSearch` `engine.go:50`) with
+W3C traceparent propagation. This is a from-scratch minimal tracer with
+the same surface: `span()` context managers produce real OTLP spans,
+batched and exported over OTLP/HTTP to a configured endpoint — which can
+be another tempo_tpu cluster, or this very process (dogfood mode).
+
+No global mutable state beyond one module-level tracer the app installs;
+disabled (zero overhead beyond a None check) until configured.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import threading
+import time
+import urllib.request
+from typing import Callable
+
+_current_span = contextvars.ContextVar("tempo_self_span", default=None)
+
+
+class _Span:
+    __slots__ = ("trace_id", "span_id", "parent_span_id", "name",
+                 "start_ns", "end_ns", "attrs", "status_code")
+
+    def __init__(self, trace_id: bytes, span_id: bytes,
+                 parent_span_id: bytes, name: str, start_ns: int):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_span_id = parent_span_id
+        self.name = name
+        self.start_ns = start_ns
+        self.end_ns = 0
+        self.attrs: dict = {}
+        self.status_code = 0
+
+
+class SelfTracer:
+    """Minimal tracer: span stack via contextvars, bounded buffer, batch
+    export thread. Spans export as OTLP (the codec this framework already
+    speaks) so any OTLP endpoint — including this process — can ingest
+    its own traces."""
+
+    def __init__(self, endpoint: str, *, service_name: str = "tempo-tpu",
+                 tenant: str = "tempo-self", flush_interval_s: float = 2.0,
+                 max_buffer: int = 4096,
+                 now: Callable[[], float] = time.time) -> None:
+        self.endpoint = endpoint.rstrip("/")
+        self.service_name = service_name
+        self.tenant = tenant
+        self.now = now
+        self.max_buffer = max_buffer
+        self._buf: list[_Span] = []
+        self._dropped = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.exported = 0
+        self._thread = threading.Thread(
+            target=self._loop, args=(flush_interval_s,), daemon=True)
+        self._thread.start()
+
+    # -- span API ----------------------------------------------------------
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        parent: _Span | None = _current_span.get()
+        tid = parent.trace_id if parent is not None else os.urandom(16)
+        psid = parent.span_id if parent is not None else b""
+        s = _Span(tid, os.urandom(8), psid, name, int(self.now() * 1e9))
+        s.attrs.update(attrs)
+        token = _current_span.set(s)
+        try:
+            yield s
+        except Exception as e:
+            s.status_code = 2
+            s.attrs["error.message"] = str(e)[:200]
+            raise
+        finally:
+            _current_span.reset(token)
+            s.end_ns = int(self.now() * 1e9)
+            with self._lock:
+                if len(self._buf) < self.max_buffer:
+                    self._buf.append(s)
+                else:
+                    self._dropped += 1
+
+    def traceparent(self) -> str | None:
+        """W3C traceparent for outgoing RPCs (`main.go:252-258`)."""
+        s = _current_span.get()
+        if s is None:
+            return None
+        return f"00-{s.trace_id.hex()}-{s.span_id.hex()}-01"
+
+    def adopt(self, traceparent: str | None):
+        """Continue an incoming W3C trace context; returns a context
+        manager token holder or None when the header is absent/bad."""
+        if not traceparent:
+            return None
+        parts = traceparent.split("-")
+        if len(parts) < 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+            return None
+        remote = _Span(bytes.fromhex(parts[1]), bytes.fromhex(parts[2]),
+                       b"", "remote-parent", 0)
+        return _current_span.set(remote)
+
+    # -- export ------------------------------------------------------------
+
+    def _drain(self) -> list[_Span]:
+        with self._lock:
+            out, self._buf = self._buf, []
+        return out
+
+    def flush(self) -> int:
+        """Export buffered spans now; returns how many went out."""
+        spans = self._drain()
+        if not spans:
+            return 0
+        from tempo_tpu.model.otlp import encode_spans_otlp
+
+        payload = encode_spans_otlp([{
+            "trace_id": s.trace_id, "span_id": s.span_id,
+            "parent_span_id": s.parent_span_id, "name": s.name,
+            "service": self.service_name, "kind": 1,   # INTERNAL
+            "status_code": s.status_code,
+            "start_unix_nano": s.start_ns, "end_unix_nano": s.end_ns,
+            "attrs": {k: v for k, v in s.attrs.items()},
+            "res_attrs": {"service.name": self.service_name},
+        } for s in spans])
+        req = urllib.request.Request(
+            self.endpoint + "/v1/traces", data=payload,
+            headers={"Content-Type": "application/x-protobuf",
+                     "X-Scope-OrgID": self.tenant})
+        try:
+            urllib.request.urlopen(req, timeout=5).close()
+            self.exported += len(spans)
+            return len(spans)
+        except Exception:
+            return 0      # self-tracing must never hurt the service
+
+    def _loop(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            self.flush()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+        self.flush()
+
+
+class NoopTracer:
+    """Disabled tracer: the default; `span()` costs one None check."""
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        yield None
+
+    def traceparent(self) -> None:
+        return None
+
+    def adopt(self, traceparent):
+        return None
+
+    def flush(self) -> int:
+        return 0
+
+    def shutdown(self) -> None:
+        pass
+
+
+_tracer: "SelfTracer | NoopTracer" = NoopTracer()
+
+
+def install(tracer: "SelfTracer | NoopTracer") -> None:
+    global _tracer
+    _tracer = tracer
+
+
+def tracer() -> "SelfTracer | NoopTracer":
+    return _tracer
+
+
+def span(name: str, **attrs):
+    """Module-level convenience: `with tracing.span("distributor.push"):`"""
+    return _tracer.span(name, **attrs)
+
+
+def span_for_tenant(name: str, tenant: str, **attrs):
+    """Like span(), but a NO-OP for the self-tracing tenant: in dogfood
+    mode (exporting into this very process) tracing the ingestion of our
+    own spans would emit a new span per flush, forever."""
+    if getattr(_tracer, "tenant", None) == tenant:
+        return contextlib.nullcontext()
+    return _tracer.span(name, tenant=tenant, **attrs)
+
+
+@contextlib.contextmanager
+def adopted(traceparent: str | None):
+    """Continue an incoming W3C trace context for the duration of a
+    request handler; resets cleanly afterwards (receiver-side half of
+    `main.go:252-258` propagation)."""
+    token = _tracer.adopt(traceparent)
+    try:
+        yield
+    finally:
+        if token is not None:
+            _current_span.reset(token)
+
+
+__all__ = ["SelfTracer", "NoopTracer", "install", "tracer", "span",
+           "span_for_tenant", "adopted"]

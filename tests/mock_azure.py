@@ -1,0 +1,197 @@
+"""In-process mock Azure Blob server (the Azurite analog).
+
+Speaks the Blob REST subset `backend/azure.py` uses — Put/Get/Delete/HEAD
+Blob, Range reads, List Blobs with prefix/delimiter/marker — and VERIFIES
+the SharedKey signature on every request by rebuilding the canonicalized
+string independently of the client's signer, so canonicalization bugs
+fail here the way they would against real Azure.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+ACCOUNT = "mockaccount"
+ACCOUNT_KEY = base64.b64encode(b"mock-azure-shared-key-0123456789").decode()
+CONTAINER = "test-container"
+
+
+class MockAzureHandler(BaseHTTPRequestHandler):
+    store: dict[str, bytes] = {}
+    lock = threading.Lock()
+
+    def log_message(self, fmt, *args):  # noqa: A003
+        pass
+
+    # -- shared-key verification (independent of the client) ----------------
+
+    def _verify_sig(self, content_length: int) -> str | None:
+        auth = self.headers.get("Authorization", "")
+        want_prefix = f"SharedKey {ACCOUNT}:"
+        if not auth.startswith(want_prefix):
+            return "missing SharedKey authorization"
+        got_sig = auth[len(want_prefix):]
+        parsed = urllib.parse.urlsplit(self.path)
+        h = {k.lower(): v for k, v in self.headers.items()}
+        canon_headers = "".join(
+            f"{k}:{h[k]}\n" for k in sorted(k for k in h
+                                            if k.startswith("x-ms-")))
+        canon_resource = f"/{ACCOUNT}{parsed.path}"
+        if parsed.query:
+            q = urllib.parse.parse_qs(parsed.query, keep_blank_values=True)
+            for k in sorted(q):
+                canon_resource += f"\n{k.lower()}:{','.join(q[k])}"
+        string_to_sign = "\n".join([
+            self.command,
+            h.get("content-encoding", ""),
+            h.get("content-language", ""),
+            str(content_length) if content_length else "",
+            h.get("content-md5", ""),
+            h.get("content-type", ""),
+            "",
+            h.get("if-modified-since", ""),
+            h.get("if-match", ""),
+            h.get("if-none-match", ""),
+            h.get("if-unmodified-since", ""),
+            h.get("range", ""),
+        ]) + "\n" + canon_headers + canon_resource
+        want = base64.b64encode(hmac.new(
+            base64.b64decode(ACCOUNT_KEY), string_to_sign.encode(),
+            hashlib.sha256).digest()).decode()
+        if got_sig != want:
+            return f"signature mismatch (want {want}, got {got_sig})"
+        return None
+
+    # -- helpers ------------------------------------------------------------
+
+    def _blob(self) -> str | None:
+        path = urllib.parse.urlsplit(self.path).path
+        parts = path.lstrip("/").split("/", 1)
+        if parts[0] != CONTAINER:
+            return None
+        return urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+
+    def _reply(self, code: int, body: bytes = b"",
+               headers: dict | None = None) -> None:
+        self.send_response(code)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _deny(self, msg: str) -> None:
+        self._reply(403, msg.encode())
+
+    # -- verbs --------------------------------------------------------------
+
+    def do_PUT(self) -> None:  # noqa: N802
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        err = self._verify_sig(n)
+        if err:
+            return self._deny(err)
+        if self.headers.get("x-ms-blob-type") != "BlockBlob":
+            return self._reply(400, b"missing x-ms-blob-type")
+        key = self._blob()
+        if not key:
+            return self._reply(400, b"no blob name")
+        with self.lock:
+            self.store[key] = body
+        self._reply(201)
+
+    def do_GET(self) -> None:  # noqa: N802
+        err = self._verify_sig(0)
+        if err:
+            return self._deny(err)
+        q = {k: v[0] for k, v in urllib.parse.parse_qs(
+            urllib.parse.urlsplit(self.path).query).items()}
+        if q.get("comp") == "list":
+            return self._list(q)
+        key = self._blob()
+        with self.lock:
+            data = self.store.get(key)
+        if data is None:
+            return self._reply(404)
+        rng = self.headers.get("Range", "")
+        if rng.startswith("bytes="):
+            lo, hi = rng[len("bytes="):].split("-")
+            lo, hi = int(lo), int(hi)
+            if lo >= len(data):
+                return self._reply(416)
+            part = data[lo:hi + 1]
+            return self._reply(206, part)
+        self._reply(200, data)
+
+    def do_HEAD(self) -> None:  # noqa: N802
+        err = self._verify_sig(0)
+        if err:
+            return self._deny(err)
+        key = self._blob()
+        with self.lock:
+            data = self.store.get(key)
+        if data is None:
+            return self._reply(404)
+        # HEAD: Content-Length advertises the blob size, no body follows
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.send_header("x-ms-blob-type", "BlockBlob")
+        self.end_headers()
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        err = self._verify_sig(0)
+        if err:
+            return self._deny(err)
+        key = self._blob()
+        with self.lock:
+            existed = self.store.pop(key, None) is not None
+        self._reply(202 if existed else 404)
+
+    def _list(self, q: dict) -> None:
+        prefix = q.get("prefix", "")
+        delimiter = q.get("delimiter", "")
+        marker = q.get("marker", "")
+        max_results = int(q.get("maxresults", 1000))
+        with self.lock:
+            all_names = sorted(k for k in self.store if k.startswith(prefix))
+        if marker:
+            all_names = [k for k in all_names if k > marker]
+        blobs: list[str] = []
+        prefixes: list[str] = []
+        for k in all_names:
+            if delimiter:
+                rest = k[len(prefix):]
+                if delimiter in rest:
+                    p = prefix + rest.split(delimiter)[0] + delimiter
+                    if p not in prefixes:
+                        prefixes.append(p)
+                    continue
+            blobs.append(k)
+            if len(blobs) >= max_results:
+                break
+        truncated = bool(blobs) and blobs[-1] != (all_names[-1]
+                                                  if all_names else "")
+        parts = ['<?xml version="1.0"?><EnumerationResults><Blobs>']
+        for k in blobs:
+            parts.append(f"<Blob><Name>{k}</Name></Blob>")
+        for p in prefixes:
+            parts.append(f"<BlobPrefix><Name>{p}</Name></BlobPrefix>")
+        parts.append("</Blobs>")
+        if truncated and blobs:
+            parts.append(f"<NextMarker>{blobs[-1]}</NextMarker>")
+        parts.append("</EnumerationResults>")
+        self._reply(200, "".join(parts).encode())
+
+
+def start_mock_azure() -> tuple[ThreadingHTTPServer, int, type]:
+    cls = type("BoundMockAzure", (MockAzureHandler,),
+               {"store": {}, "lock": threading.Lock()})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), cls)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, srv.server_address[1], cls

@@ -265,3 +265,99 @@ def test_dataquality_exposed_on_metrics(tmp_path):
     finally:
         srv.shutdown()
         app.shutdown()
+
+
+# -- self-tracing (cmd/tempo/main.go:227-281 analog) ------------------------
+
+def test_self_tracing_dogfood(tmp_path):
+    """The app traces itself INTO ITSELF: spans from a push/search land as
+    real traces under the self-tenant, queryable like any other tenant."""
+    import socket
+    import time
+    import urllib.request
+
+    from tempo_tpu.app import App
+    from tempo_tpu.app.api import serve
+    from tempo_tpu.app.config import Config
+    from tempo_tpu.utils import tracing
+
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]; s.close()
+    cfg = Config(target="all")
+    cfg.storage.backend = "mem"
+    cfg.storage.wal_path = str(tmp_path / "wal")
+    cfg.generator.localblocks.data_dir = str(tmp_path / "lb")
+    cfg.server.http_listen_port = port
+    cfg.self_tracing_endpoint = f"http://127.0.0.1:{port}"
+    app = App(cfg)
+    app.start_loops()
+    srv = serve(app, block=False)
+    try:
+        assert not isinstance(tracing.tracer(), tracing.NoopTracer)
+        # trigger traced entry points
+        t0 = int((time.time() - 3) * 1e9)
+        otlp = {"resourceSpans": [{"scopeSpans": [{"spans": [{
+            "traceId": "ab" * 16, "spanId": "cd" * 8, "name": "user-op",
+            "startTimeUnixNano": str(t0),
+            "endTimeUnixNano": str(t0 + 1_000_000)}]}]}]}
+        import json as _json
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/traces",
+            data=_json.dumps(otlp).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=10).close()
+        app.frontend.search("single-tenant", "{ }", limit=5)
+        # flush self-spans into this very process
+        assert tracing.tracer().flush() > 0
+        # nested child spans share the parent's trace
+        with tracing.span("outer") as outer:
+            with tracing.span("inner") as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.parent_span_id == outer.span_id
+        tracing.tracer().flush()
+        # the self-tenant now holds framework spans, queryable
+        names = set()
+        inst = app.ingester.instance("tempo-self")
+        for _tid, lt in inst.live.traces.items():
+            for sp in lt.spans:
+                names.add(sp["name"])
+        assert "distributor.PushSpans" in names, names
+        assert "frontend.Search" in names, names
+        # traceparent propagation surface
+        with tracing.span("rpc-client"):
+            tp = tracing.tracer().traceparent()
+            assert tp and tp.startswith("00-")
+    finally:
+        srv.shutdown()
+        app.shutdown()
+
+
+def test_debug_profile_endpoints(tmp_path):
+    import socket
+    import urllib.request
+
+    from tempo_tpu.app import App
+    from tempo_tpu.app.api import serve
+    from tempo_tpu.app.config import Config
+
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]; s.close()
+    cfg = Config(target="all")
+    cfg.storage.backend = "mem"
+    cfg.storage.wal_path = str(tmp_path / "wal")
+    cfg.generator.localblocks.data_dir = str(tmp_path / "lb")
+    cfg.server.http_listen_port = port
+    app = App(cfg)
+    srv = serve(app, block=False)
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/threads", timeout=10
+        ).read().decode()
+        assert "--- thread" in body and "serve_forever" in body
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/profile?seconds=0.3",
+            timeout=10).read().decode()
+        assert body.startswith("samples:")
+    finally:
+        srv.shutdown()
+        app.shutdown()

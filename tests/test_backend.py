@@ -27,7 +27,7 @@ from tempo_tpu.backend import (
 from tempo_tpu.backend.cloud import open_backend
 
 
-@pytest.fixture(params=["mem", "local", "s3"])
+@pytest.fixture(params=["mem", "local", "s3", "azure"])
 def backend(request, tmp_path):
     if request.param == "mem":
         return MemBackend()
@@ -41,6 +41,16 @@ def backend(request, tmp_path):
             region=REGION, access_key=ACCESS_KEY, secret_key=SECRET_KEY,
             insecure=True)
         return b
+    if request.param == "azure":
+        from tests.mock_azure import (ACCOUNT, ACCOUNT_KEY, CONTAINER,
+                                      start_mock_azure)
+
+        srv, port, _cls = start_mock_azure()
+        request.addfinalizer(srv.shutdown)
+        return open_backend(
+            "azure", container_name=CONTAINER,
+            storage_account_name=ACCOUNT, storage_account_key=ACCOUNT_KEY,
+            endpoint=f"http://127.0.0.1:{port}")
     return LocalBackend(str(tmp_path / "store"))
 
 
@@ -145,8 +155,12 @@ def test_open_backend_factory(tmp_path):
     assert "storage.googleapis.com" in gcs.base
     with pytest.raises((ValueError, TypeError)):
         open_backend("s3")   # bucket required
-    with pytest.raises((RuntimeError, NotImplementedError)):
-        open_backend("azure")
+    from tempo_tpu.backend.azure import AzureBackend
+    az = open_backend("azure", container_name="c", storage_account_name="a",
+                      storage_account_key="")
+    assert isinstance(az, AzureBackend)
+    with pytest.raises((ValueError, TypeError)):
+        open_backend("azure")   # container required
     with pytest.raises(ValueError):
         open_backend("bogus")
 

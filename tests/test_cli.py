@@ -153,3 +153,102 @@ def test_cli_new_commands(block_dir, capsys):
     assert rep.report_once()
     assert cli_main(["--path", path, "usage-stats"]) == 0
     assert "clusterID" in capsys.readouterr().out
+
+
+def test_tempo_query_jaeger_plugin(tmp_path):
+    """tempo-query bridge: jaeger.storage.v1 gRPC calls against a live
+    tempo_tpu server return api_v2 model spans (cmd/tempo-query analog)."""
+    import json
+    import time
+    import urllib.request
+
+    import grpc
+
+    from tempo_tpu.app import App
+    from tempo_tpu.app.api import serve
+    from tempo_tpu.model import proto_wire as pw
+    from tempo_tpu.tempoquery import build_tempo_query_server
+
+    def free_port():
+        s = socket.socket(); s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]; s.close(); return p
+
+    port = free_port()
+    cfg = Config(target="all")
+    cfg.storage.backend = "mem"
+    cfg.storage.wal_path = str(tmp_path / "wal")
+    cfg.generator.localblocks.data_dir = str(tmp_path / "lb")
+    cfg.server.http_listen_port = port
+    app = App(cfg)
+    srv = serve(app, block=False)
+    qserver = qport = None
+    try:
+        t0 = int((time.time() - 3) * 1e9)
+        otlp = {"resourceSpans": [{"resource": {"attributes": [
+            {"key": "service.name", "value": {"stringValue": "jq-svc"}}]},
+            "scopeSpans": [{"spans": [{
+                "traceId": "fe" * 16, "spanId": "12" * 8, "name": "jq-op",
+                "kind": 2, "startTimeUnixNano": str(t0),
+                "endTimeUnixNano": str(t0 + 5_000_000),
+                "attributes": [{"key": "http.status_code",
+                                "value": {"intValue": "500"}}]}]}]}]}
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/traces",
+            data=json.dumps(otlp).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=10).close()
+
+        qserver, qport = build_tempo_query_server(
+            f"http://127.0.0.1:{port}")
+        ch = grpc.insecure_channel(f"127.0.0.1:{qport}")
+
+        # GetServices
+        body = ch.unary_unary(
+            "/jaeger.storage.v1.SpanReaderPlugin/GetServices")(b"")
+        services = [bytes(v).decode() for v in
+                    pw.decode_fields(body).get(1, [])]
+        assert "jq-svc" in services
+
+        # GetOperations
+        body = ch.unary_unary(
+            "/jaeger.storage.v1.SpanReaderPlugin/GetOperations")(b"")
+        ops = [bytes(v).decode() for v in pw.decode_fields(body).get(1, [])]
+        assert "jq-op" in ops
+
+        # GetTrace -> api_v2 spans with process + tags
+        chunks = list(ch.unary_stream(
+            "/jaeger.storage.v1.SpanReaderPlugin/GetTrace")(
+            pw.enc_field_bytes(1, bytes.fromhex("fe" * 16))))
+        assert len(chunks) == 1
+        spans = pw.decode_fields(chunks[0])[1]
+        sp = pw.decode_fields(bytes(spans[0]))
+        assert bytes(sp[1][0]) == bytes.fromhex("fe" * 16)    # trace_id
+        assert bytes(sp[3][0]).decode() == "jq-op"            # operation
+        proc = pw.decode_fields(bytes(sp[10][0]))
+        assert bytes(proc[1][0]).decode() == "jq-svc"         # service
+        tags = {bytes(pw.decode_fields(bytes(t))[1][0]).decode()
+                for t in sp.get(8, [])}
+        assert "span.kind" in tags and "http.status_code" in tags
+
+        # FindTraces with a service filter
+        query = (pw.enc_field_str(1, "jq-svc") +
+                 pw.enc_field_varint(8, 10))
+        chunks = list(ch.unary_stream(
+            "/jaeger.storage.v1.SpanReaderPlugin/FindTraces")(
+            pw.enc_field_msg(1, query)))
+        assert len(chunks) == 1
+
+        # unknown trace -> NOT_FOUND
+        try:
+            list(ch.unary_stream(
+                "/jaeger.storage.v1.SpanReaderPlugin/GetTrace")(
+                pw.enc_field_bytes(1, b"\x00" * 16)))
+            raise AssertionError("expected NOT_FOUND")
+        except grpc.RpcError as e:
+            assert e.code() == grpc.StatusCode.NOT_FOUND
+        ch.close()
+    finally:
+        if qserver is not None:
+            qserver.stop(0)
+        srv.shutdown()
+        app.shutdown()
