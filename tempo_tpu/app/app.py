@@ -379,10 +379,11 @@ class App:
                 self.db.enable_compaction(self.cfg.compaction_interval_s)
         if self.cfg.self_tracing_endpoint:
             from tempo_tpu.utils import tracing
-            tracing.install(tracing.SelfTracer(
+            self._self_tracer = tracing.SelfTracer(
                 self.cfg.self_tracing_endpoint,
                 service_name=f"tempo-tpu-{self.cfg.target}",
-                tenant=self.cfg.self_tracing_tenant, now=self.now))
+                tenant=self.cfg.self_tracing_tenant, now=self.now)
+            tracing.install(self._self_tracer)
         if self.cfg.usage_stats_enabled and self.backend is not None:
             from tempo_tpu.utils.usagestats import UsageReporter
             self.usage_reporter = UsageReporter(
@@ -409,10 +410,14 @@ class App:
         self._stop.set()
         if getattr(self, "usage_reporter", None) is not None:
             self.usage_reporter.shutdown()
-        if self.cfg.self_tracing_endpoint:     # only the installer may
-            from tempo_tpu.utils import tracing   # clobber the global
-            tracing.tracer().shutdown()
-            tracing.install(tracing.NoopTracer())
+        mine = getattr(self, "_self_tracer", None)
+        if mine is not None:
+            from tempo_tpu.utils import tracing
+            mine.shutdown()
+            # uninstall the global only while it is still OURS — another
+            # App in this process may have installed its own since
+            if tracing.tracer() is mine:
+                tracing.install(tracing.NoopTracer())
         if self.frontend_worker:
             self.frontend_worker.shutdown()
         if self.grpc_server:

@@ -83,8 +83,11 @@ class Generator:
         from tempo_tpu.model.otlp_batch import batch_from_otlp
 
         inst = self.instance(tenant)
+        need_span, need_res = inst.needs_attr_columns()
         sb, sizes = batch_from_otlp(data, inst.registry.interner,
-                                    return_sizes=True)
+                                    return_sizes=True,
+                                    include_span_attrs=need_span,
+                                    include_res_attrs=need_res)
         inst.push_batch(sb, span_sizes=sizes)
         return sb.n
 

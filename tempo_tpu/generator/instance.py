@@ -92,6 +92,20 @@ class GeneratorInstance:
 
     # -- ingest ------------------------------------------------------------
 
+    def needs_attr_columns(self) -> tuple[bool, bool]:
+        """(span_attrs, res_attrs) the enabled processors actually read —
+        staging skips unrequested attr matrices AND the C++ scan skips
+        interning them. Each processor answers for itself; ones without
+        the hook (service-graphs peer attrs, local-blocks persistence)
+        conservatively need everything."""
+        need_span = need_res = False
+        for proc in self.processors.values():
+            fn = getattr(proc, "needs_attr_columns", None)
+            s, r = fn() if fn is not None else (True, True)
+            need_span |= s
+            need_res |= r
+        return need_span, need_res
+
     def push_batch(self, sb: SpanBatch, span_sizes: np.ndarray | None = None) -> None:
         self.spans_received += sb.n
         sb = self._apply_slack(sb)

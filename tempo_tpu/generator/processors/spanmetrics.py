@@ -99,6 +99,15 @@ class SpanMetricsProcessor:
     def name(self) -> str:
         return "span-metrics"
 
+    def needs_attr_columns(self) -> tuple[bool, bool]:
+        """(span_attrs, res_attrs) this processor reads — owned HERE so a
+        future attr-reading feature updates the answer with the code that
+        reads (staging skips unrequested matrices)."""
+        c = self.cfg
+        need = bool(c.dimensions or c.filter_policies
+                    or c.span_multiplier_key)
+        return need, need
+
     # -- staging -----------------------------------------------------------
 
     def _label_rows(self, sb: SpanBatch) -> np.ndarray:

@@ -231,7 +231,8 @@ def _load():
             lib.otlp_stage.argtypes = [
                 c.c_void_p, u8p, c.c_int64,
                 c.c_void_p, c.c_int64, c.c_void_p, c.c_int64,
-                c.c_void_p, c.c_int64, c.c_void_p, c.c_int64, i64p]
+                c.c_void_p, c.c_int64, c.c_void_p, c.c_int64,
+                c.c_int32, i64p]
             lib.otlp_stage.restype = c.c_int32
             _LIB = lib
         except Exception:
@@ -428,19 +429,23 @@ class NativeRowTable:
 
 
 def otlp_stage(interner: "NativeInterner", data: bytes,
-               cap_hint: int = 4096):
+               cap_hint: int = 4096, skip_span_attrs: bool = False):
     """One-pass OTLP bytes → interned columns.
 
     Returns (spans StageRec[], span_attrs StageAttr[], res_attrs
     StageAttr[], resources StageRes[]) or None when the native library is
-    unavailable. Raises ValueError on malformed input."""
+    unavailable. Raises ValueError on malformed input. With
+    `skip_span_attrs` the scan validates span attributes but neither
+    interns nor emits them (intrinsic-dims-only callers)."""
     lib = _load()
     if lib is None:
         return None
     buf = np.frombuffer(data, np.uint8)
     bp = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    flags = 1 if skip_span_attrs else 0
     cap = max(cap_hint, 16)
-    acap, rcap, rescap = cap * 4, 256, 64
+    acap = 16 if skip_span_attrs else cap * 4
+    rcap, rescap = 256, 64
     while True:
         spans = np.zeros(cap, STAGE_REC_DTYPE)
         sattrs = np.zeros(acap, STAGE_ATTR_DTYPE)
@@ -451,7 +456,7 @@ def otlp_stage(interner: "NativeInterner", data: bytes,
             interner._h, bp, len(data),
             spans.ctypes.data, cap, sattrs.ctypes.data, acap,
             rattrs.ctypes.data, rcap, res.ctypes.data, rescap,
-            n_out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+            flags, n_out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
         if rc != 0:
             raise ValueError("malformed OTLP protobuf payload")
         ns, na, nr, nres = (int(x) for x in n_out)

@@ -769,7 +769,8 @@ static bool stage_resource(StageCtx& c, const uint8_t* rm, uint64_t rmlen,
 }
 
 static bool stage_span(StageCtx& c, const uint8_t* sp, uint64_t splen,
-                       int32_t res_idx, int32_t service_id) {
+                       int32_t res_idx, int32_t service_id,
+                       bool skip_attrs) {
     StageRec rec;
     memset(&rec, 0, sizeof(rec));
     rec.name_id = c.empty_id;
@@ -794,6 +795,15 @@ static bool stage_span(StageCtx& c, const uint8_t* sp, uint64_t splen,
             case 7: if (w != 2) rec.start_ns = v; break;
             case 8: if (w != 2) rec.end_ns = v; break;
             case 9: {
+                if (skip_attrs) {
+                    // caller's processors never read span attrs: validate
+                    // the bytes (decoder contract) without interning or
+                    // storing — the offset-based parser does exactly that
+                    AttrRec scratch;
+                    if (!parse_keyvalue(c.buf, s, l, span_idx, scratch))
+                        return false;
+                    break;
+                }
                 StageAttr a;
                 if (!stage_keyvalue(c, s, l, span_idx, a)) return false;
                 if (c.n_sattrs < c.sattr_cap) c.sattrs[c.n_sattrs] = a;
@@ -828,12 +838,15 @@ extern "C" {
 // (which may exceed the caps; caller re-calls with bigger buffers and a
 // FRESH scan) are written to n_out[0..3] = spans, span_attrs, res_attrs,
 // resources. Interning is idempotent so a re-scan is safe.
+// flags bit 0: skip span attrs (validate only — no interning, no output;
+// the dominant per-span cost when the caller's processors read only
+// intrinsic dimensions).
 int32_t otlp_stage(void* interner, const uint8_t* buf, int64_t buflen,
                    StageRec* spans, int64_t span_cap,
                    StageAttr* sattrs, int64_t sattr_cap,
                    StageAttr* rattrs, int64_t rattr_cap,
                    StageRes* res, int64_t res_cap,
-                   int64_t* n_out) {
+                   int32_t flags, int64_t* n_out) {
     Interner* it = (Interner*)interner;
     std::lock_guard<std::mutex> g(it->mu);
     StageCtx c;
@@ -869,7 +882,9 @@ int32_t otlp_stage(void* interner, const uint8_t* buf, int64_t buflen,
             uint32_t f3, w3; uint64_t v3, l3; const uint8_t* s3;
             while (read_field(ss, f3, w3, v3, s3, l3)) {
                 if (f3 != 2 || w3 != 2) continue;  // Span
-                if (!stage_span(c, s3, l3, res_idx, r.service_id)) return -1;
+                if (!stage_span(c, s3, l3, res_idx, r.service_id,
+                                (flags & 1) != 0))
+                    return -1;
             }
             if (!ss.ok) return -1;
         }

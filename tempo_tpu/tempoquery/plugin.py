@@ -176,11 +176,15 @@ class _Plugin:
         if op and bytes(op[0]):
             conds.append(
                 "name = " + _tql_str(bytes(op[0]).decode("utf-8", "replace")))
+        import re as _re
+
         for tag in q.get(3, ()):       # map<string,string> entries
             td = pw.decode_fields(bytes(tag))
             k = bytes(td.get(1, [b""])[0]).decode("utf-8", "replace")
             v = bytes(td.get(2, [b""])[0]).decode("utf-8", "replace")
-            if k:
+            # the KEY is interpolated bare: restrict it to attribute-name
+            # characters so UI input cannot alter the query structure
+            if k and _re.fullmatch(r"[\w.\-/:]+", k):
                 conds.append(f"span.{k} = " + _tql_str(v))
         if 6 in q:                     # duration_min (Duration msg)
             conds.append(f"duration >= {_dur_ns(bytes(q[6][0]))}ns")

@@ -105,8 +105,11 @@ class SelfTracer:
         parts = traceparent.split("-")
         if len(parts) < 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
             return None
-        remote = _Span(bytes.fromhex(parts[1]), bytes.fromhex(parts[2]),
-                       b"", "remote-parent", 0)
+        try:
+            tid, sid = bytes.fromhex(parts[1]), bytes.fromhex(parts[2])
+        except ValueError:
+            return None      # W3C: invalid traceparent values are ignored
+        remote = _Span(tid, sid, b"", "remote-parent", 0)
         return _current_span.set(remote)
 
     # -- export ------------------------------------------------------------

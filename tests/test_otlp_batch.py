@@ -130,7 +130,7 @@ def test_fallback_without_native(monkeypatch):
     from tempo_tpu import native as nat
 
     monkeypatch.setattr(nat, "otlp_stage",
-                        lambda interner, data, cap_hint=4096: None)
+                        lambda interner, data, **kw: None)
     data = _payload()
     it = StringInterner()
     sb = batch_from_otlp(data, it)
