@@ -150,6 +150,12 @@ class Handler(BaseHTTPRequestHandler):
             traces = decode_push_body(body)
             errs = self.app.ingester.push(tenant, traces)
             return self._reply(200, _json_bytes({"errors": errs}))
+        if path == "/internal/ingester/push_otlp":
+            try:
+                errs2 = self.app.ingester.push_otlp(tenant, body)
+            except (ValueError, KeyError, TypeError) as e:
+                return self._err(400, f"malformed otlp payload: {e}")
+            return self._reply(200, _json_bytes({"errors": errs2}))
         if path == "/internal/generator/push":
             traces = decode_push_body(body)
             spans = [s for _tid, group in traces for s in group]
@@ -207,22 +213,29 @@ class Handler(BaseHTTPRequestHandler):
         if body is None:
             return
         ctype = self.headers.get("Content-Type", "")
-        from tempo_tpu.model.otlp import spans_from_otlp_json, spans_from_otlp_proto
-        raw, recs = None, None
-        try:
-            if "json" in ctype:
+        from tempo_tpu.distributor.distributor import (MalformedPayload,
+                                                       RateLimited)
+        if "json" in ctype:
+            from tempo_tpu.model.otlp import spans_from_otlp_json
+            try:
                 spans = list(spans_from_otlp_json(json.loads(body)))
-            else:
-                from tempo_tpu import native
-                spans, recs = native.spans_from_otlp_proto_native(
-                    body, return_recs=True)
-                if spans is None:  # native layer unavailable
-                    spans = list(spans_from_otlp_proto(body))
-                raw = body    # scan order == spans order: tee can slice it
-        except (ValueError, KeyError, TypeError) as e:
-            # malformed payload is the client's fault (OTLP spec: 400)
+            except (ValueError, KeyError, TypeError) as e:
+                return self._err(400, f"malformed otlp payload: {e}")
+            return self._push_decoded(tenant, spans, 200)
+        # proto: the columnar path — span dicts only materialize if a
+        # configured feature forces the fallback inside push_otlp. ONLY
+        # decode-phase errors are the client's fault (OTLP spec: 400);
+        # pipeline faults bubble to the 500 handler.
+        try:
+            errs = self.app.distributor.push_otlp(tenant, body)
+        except MalformedPayload as e:
             return self._err(400, f"malformed otlp payload: {e}")
-        self._push_decoded(tenant, spans, 200, raw_otlp=raw, raw_recs=recs)
+        except RateLimited:
+            self.send_response(429)
+            self.send_header("Retry-After", "1")
+            self.end_headers()
+            return
+        self._reply(200, _json_bytes({"errors": errs} if errs else {}))
 
     def _push_jaeger(self, tenant: str) -> None:
         """Jaeger collector endpoint (`/api/traces`, TBinaryProtocol Batch)

@@ -56,6 +56,12 @@ class RateLimited(RuntimeError):
         self.tenant = tenant
 
 
+class MalformedPayload(ValueError):
+    """Decode-phase failure of a wire payload: the CLIENT's fault (HTTP
+    400 / gRPC INVALID_ARGUMENT). Distinct from internal pipeline errors,
+    which must surface as server faults, not as payload blame."""
+
+
 class Distributor:
     def __init__(self,
                  ingester_ring: Ring,
@@ -119,6 +125,180 @@ class Distributor:
                                      n_spans=len(spans)):
             return self._push_spans(tenant, spans, size_bytes, raw_otlp,
                                     raw_recs)
+
+    def push_otlp(self, tenant: str, raw: bytes,
+                  recs: "np.ndarray | None" = None) -> dict[str, int]:
+        """The COLUMNAR PushTraces path: raw OTLP wire bytes in, no span
+        dicts anywhere in the distributor. The native scan's fixed columns
+        drive vectorized validation, data-quality, usage attribution,
+        trace grouping, and token hashing; replicas and the generator tee
+        receive raw wire slices and unmarshal at THEIR end, exactly as the
+        reference's ingesters unmarshal PushBytesV2 bodies. Falls back to
+        the dict path whenever a feature needs per-span dicts (no native
+        layer, attr truncation configured, non-service usage dimensions,
+        or the ingest bus)."""
+        from tempo_tpu import native
+        from tempo_tpu.utils import tracing
+
+        lim = self.overrides.for_tenant(tenant)
+        # config gates first: a fallback tenant must pay ONE decode, not
+        # a columnar scan plus a dict decode
+        needs_dicts = (lim.ingestion.max_attribute_bytes
+                       or self.bus is not None
+                       or not self.forwarders.empty
+                       or set(self.usage.cfg.dimensions) - {"service"})
+        if not needs_dicts:
+            if recs is None:
+                try:
+                    recs = native.otlp_scan(raw)
+                except ValueError as e:
+                    raise MalformedPayload(str(e)) from None
+            if recs is not None:
+                with tracing.span_for_tenant("distributor.PushSpans",
+                                             tenant, n_spans=len(recs)):
+                    return self._push_otlp_columnar(tenant, raw, recs, lim)
+        try:
+            got = native.spans_from_otlp_proto_native(raw, return_recs=True)
+            if got[0] is None:
+                from tempo_tpu.model.otlp import spans_from_otlp_proto
+                got = (list(spans_from_otlp_proto(raw)), None)
+        except ValueError as e:
+            raise MalformedPayload(str(e)) from None
+        spans, recs2 = got
+        return self.push_spans(tenant, spans, size_bytes=len(raw),
+                               raw_otlp=raw, raw_recs=recs2)
+
+    def _push_otlp_columnar(self, tenant: str, raw: bytes,
+                            recs: np.ndarray, lim) -> dict[str, int]:
+        n = len(recs)
+        sz = len(raw)
+        rate = effective_rate(lim.ingestion.rate_strategy,
+                              lim.ingestion.rate_limit_bytes,
+                              self.n_distributors())
+        if not self.limiter.allow(tenant, sz, rate,
+                                  lim.ingestion.burst_size_bytes):
+            self._discard(REASON_RATE_LIMITED, n)
+            raise RateLimited(tenant, sz)
+        self.metrics["spans_received_total"] += n
+        self.metrics["bytes_received_total"] += sz
+        self.dataquality.observe_start_ns(tenant, recs["start_ns"])
+
+        # usage attribution by service: parse each UNIQUE Resource once
+        res_pairs = np.stack([recs["res_off"].astype(np.int64),
+                              recs["res_len"].astype(np.int64)], axis=1)
+        uniq_res, inv_res = np.unique(res_pairs, axis=0, return_inverse=True)
+        services = [_resource_service(raw, int(o), int(ln))
+                    for o, ln in uniq_res]
+        if self.usage.cfg.dimensions == ("service",):
+            # even split of the wire size, matching observe(size_bytes=..)
+            # so path choice cannot shift a tenant's attributed bytes
+            counts = np.bincount(inv_res, minlength=len(uniq_res))
+            per_span = sz / max(n, 1)
+            self.usage.observe_grouped(tenant, [
+                ((services[i],), int(counts[i]),
+                 float(counts[i]) * per_span)
+                for i in range(len(uniq_res)) if counts[i]])
+
+        # validation: vectorized trace-id check (pkg/validation)
+        errs: dict[str, int] = {}
+        valid = (recs["tid_len"] > 0) & (recs["tid_len"] <= 16)
+        n_bad = int(n - valid.sum())
+        if n_bad:
+            errs[REASON_INVALID_TRACE_ID] = n_bad
+            self._discard(REASON_INVALID_TRACE_ID, n_bad)
+        if not valid.any():
+            return errs
+
+        # regroup by trace: unique over (padded 16-byte id, wire length) —
+        # the length disambiguates a short id from the 16-byte id that
+        # shares its zero-padded form (the dict path keys on exact bytes)
+        tids = np.ascontiguousarray(recs["trace_id"])
+        vrows = np.flatnonzero(valid)
+        keys = np.concatenate(
+            [tids[vrows], recs["tid_len"][vrows, None].astype(np.uint8)],
+            axis=1)
+        void = np.ascontiguousarray(keys).view([("v", "V17")]).ravel()
+        uniq_tid, first, inverse = np.unique(void, return_index=True,
+                                             return_inverse=True)
+        uniq_mat = tids[vrows[first]]
+        uniq_len = recs["tid_len"][vrows[first]]
+        tokens = token_for(tenant, uniq_mat)
+        n_traces = len(uniq_tid)
+
+        from tempo_tpu.model.otlp import slice_otlp_payload
+
+        def payload_for(items: list[int]) -> bytes:
+            sel = np.isin(inverse, np.asarray(items, np.int64))
+            wis = vrows[sel]
+            if len(wis) == len(recs):
+                return raw
+            return slice_otlp_payload(raw, recs, wis.tolist())
+
+        # replicate to ingesters (RF quorum, per-trace reason dedupe)
+        ring = self.ingester_ring
+        if lim.ingestion.tenant_shard_size:
+            ring = ring.shuffle_shard(tenant, lim.ingestion.tenant_shard_size)
+        item_reason: dict[int, str] = {}
+        # keyed by (padded hex, wire length): replicas reply with exact
+        # wire bytes, scan records pad — normalize without merging ids
+        # that differ only in trailing-zero padding
+        tid_to_item = {(uniq_mat[i].tobytes().hex(), int(uniq_len[i])): i
+                       for i in range(n_traces)}
+
+        def _item_of(tid_hex: str) -> "int | None":
+            return tid_to_item.get((tid_hex.ljust(32, "0"),
+                                    len(tid_hex) // 2))
+
+        def send_ing(inst: InstanceDesc, items: list[int]) -> None:
+            client = self.ingester_clients[inst.id]
+            fn = getattr(client, "push_otlp", None)
+            if fn is not None:
+                for tid_hex, reason in (fn(tenant, payload_for(items))
+                                        or {}).items():
+                    i = _item_of(tid_hex)
+                    if i is not None and reason:
+                        item_reason.setdefault(i, reason)
+                return
+            # client without the OTLP seam: decode just its slice
+            from tempo_tpu.model.otlp import spans_from_otlp_proto
+            spans = list(spans_from_otlp_proto(payload_for(items)))
+            groups: dict[bytes, list] = {}
+            for s in spans:
+                groups.setdefault(s["trace_id"], []).append(s)
+            res = client.push(tenant, list(groups.items()))
+            for (tid, _g), reason in zip(groups.items(), res or ()):
+                if reason:
+                    i = _item_of(tid.hex())
+                    if i is not None:
+                        item_reason.setdefault(i, reason)
+
+        try:
+            do_batch(ring, tokens, list(range(n_traces)), send_ing,
+                     rf=self.cfg.rf)
+            self.metrics["traces_pushed_total"] += n_traces
+        except RuntimeError:
+            self.metrics["push_failures_total"] += 1
+            nv = int(valid.sum())
+            self._discard(REASON_INTERNAL, nv)
+            errs[REASON_INTERNAL] = errs.get(REASON_INTERNAL, 0) + nv
+        for reason in item_reason.values():
+            errs[reason] = errs.get(reason, 0) + 1
+            self._discard(reason, 1)
+
+        # generator tee (RF1, best-effort, raw slices)
+        if self.generator_ring is not None and self.generator_clients \
+                and lim.generator.processors:
+            def send_gen(inst: InstanceDesc, items: list[int]) -> None:
+                self.generator_clients[inst.id].push_otlp(
+                    tenant, payload_for(items))
+
+            try:
+                do_batch(self.generator_ring, tokens,
+                         list(range(n_traces)), send_gen,
+                         rf=self.cfg.generator_rf)
+            except RuntimeError:
+                self.metrics["push_failures_total"] += 1
+        return errs
 
     def _push_spans(self, tenant, spans, size_bytes, raw_otlp,
                     raw_recs) -> dict[str, int]:
@@ -288,6 +468,21 @@ class Distributor:
 
 
 # -- helpers ---------------------------------------------------------------
+
+def _resource_service(raw: bytes, off: int, ln: int) -> str:
+    """service.name of one Resource message region (columnar usage path)."""
+    if off < 0 or ln <= 0:
+        return ""
+    from tempo_tpu.model import proto_wire as pw
+    from tempo_tpu.model.otlp import _pb_attrs
+
+    ra = _pb_attrs([v for f, _, v in pw.iter_fields(raw[off:off + ln])
+                    if f == 1])
+    v = ra.get("service.name")
+    # dict-path parity: absent service attributes label as "" (the span
+    # dict carries service="" there), not usage.MISSING
+    return str(v) if v is not None else ""
+
 
 def _group_by_trace(spans: Sequence[dict]
                     ) -> tuple[list[tuple[bytes, list[dict]]], np.ndarray]:

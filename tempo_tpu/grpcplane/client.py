@@ -61,6 +61,12 @@ class GrpcIngesterClient(_BaseGrpcClient):
                           _one_record(traces), tenant)
         return tempopb.dec_push_response(body, len(traces))
 
+    def push_otlp(self, tenant: str, payload: bytes) -> dict[str, str]:
+        import json as _json
+
+        body = self._call("/tempopb.Pusher/PushOTLP", payload, tenant)
+        return _json.loads(body or b"{}").get("errors", {})
+
     def find_trace_by_id(self, tenant: str, trace_id: bytes):
         from tempo_tpu.model import tempopb
 

@@ -66,22 +66,14 @@ class _Services:
 
     def otlp_export(self, request: bytes, context) -> bytes:
         tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
-        from tempo_tpu import native
-        from tempo_tpu.model.otlp import spans_from_otlp_proto
+        from tempo_tpu.distributor.distributor import (MalformedPayload,
+                                                       RateLimited)
 
         try:
-            spans, recs = native.spans_from_otlp_proto_native(
-                request, return_recs=True)
-            if spans is None:
-                spans = list(spans_from_otlp_proto(request))
-        except (ValueError, KeyError, TypeError) as e:
+            self.app.distributor.push_otlp(tenant, request)
+        except MalformedPayload as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                           f"malformed otlp payload: {e}")
-        from tempo_tpu.distributor.distributor import RateLimited
-
-        try:
-            self.app.distributor.push_spans(tenant, spans,
-                                            raw_otlp=request, raw_recs=recs)
         except RateLimited as e:
             # the reference translates rate limits to ResourceExhausted with
             # RetryInfo so SDK exporters back off (shim.go RetryableError)
@@ -97,6 +89,17 @@ class _Services:
 
         errs = self.app.ingester.push(tenant, decode_push_body(request))
         return tempopb.enc_push_response(errs or ())
+
+    def push_otlp_traces(self, request: bytes, context) -> bytes:
+        """Raw OTLP wire-slice push from the columnar distributor path;
+        sparse per-trace rejection map back."""
+        tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
+        try:
+            errs = self.app.ingester.push_otlp(tenant, request)
+        except (ValueError, KeyError, TypeError) as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"malformed otlp payload: {e}")
+        return _jdump({"errors": errs})
 
     # -- MetricsGenerator ---------------------------------------------------
 
@@ -341,7 +344,9 @@ def build_grpc_server(app, address: str = "127.0.0.1:0",
             {"Export": unary(svc.otlp_export)}),))
     if app.ingester is not None:
         server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
-            "tempopb.Pusher", {"PushBytesV2": unary(svc.push_bytes_v2)}),))
+            "tempopb.Pusher",
+            {"PushBytesV2": unary(svc.push_bytes_v2),
+             "PushOTLP": unary(svc.push_otlp_traces)}),))
         server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
             "tempopb.Querier",
             {"FindTraceByID": unary(svc.find_trace_by_id),

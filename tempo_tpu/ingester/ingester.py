@@ -92,6 +92,28 @@ class Ingester:
         inst = self.instance(tenant)
         return [inst.push_trace(tid, spans) for tid, spans in traces]
 
+    def push_otlp(self, tenant: str, payload: bytes) -> dict[str, str]:
+        """OTLP wire-slice push (the columnar distributor's PushBytesV2
+        shape: raw proto per replica, unmarshalled HERE — as the reference
+        ingester unmarshals trace bytes). Returns {trace_id_hex: reason}
+        for rejected traces only."""
+        from tempo_tpu import native
+        from tempo_tpu.model.otlp import spans_from_otlp_proto
+
+        spans = native.spans_from_otlp_proto_native(payload)
+        if spans is None:
+            spans = list(spans_from_otlp_proto(payload))
+        by_tid: dict[bytes, list[dict]] = {}
+        for s in spans:
+            by_tid.setdefault(s["trace_id"], []).append(s)
+        inst = self.instance(tenant)
+        out: dict[str, str] = {}
+        for tid, group in by_tid.items():
+            reason = inst.push_trace(tid, group)
+            if reason:
+                out[tid.hex()] = reason
+        return out
+
     # -- cut/flush machinery ----------------------------------------------
 
     def sweep_instance(self, tenant: str, immediate: bool = False) -> None:

@@ -60,6 +60,11 @@ class RemoteIngesterClient(_BaseClient):
         res = self._post("/internal/ingester/push", body, tenant)
         return res.get("errors", [None] * len(traces))
 
+    def push_otlp(self, tenant: str, payload: bytes) -> dict[str, str]:
+        res = self._post("/internal/ingester/push_otlp", payload, tenant,
+                         ctype="application/x-protobuf")
+        return res.get("errors", {})
+
     def find_trace_by_id(self, tenant: str, trace_id: bytes) -> list[dict] | None:
         res = self._get("/internal/ingester/trace", tenant,
                         {"tid": trace_id.hex()})

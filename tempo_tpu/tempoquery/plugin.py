@@ -183,9 +183,16 @@ class _Plugin:
             k = bytes(td.get(1, [b""])[0]).decode("utf-8", "replace")
             v = bytes(td.get(2, [b""])[0]).decode("utf-8", "replace")
             # the KEY is interpolated bare: restrict it to attribute-name
-            # characters so UI input cannot alter the query structure
-            if k and _re.fullmatch(r"[\w.\-/:]+", k):
-                conds.append(f"span.{k} = " + _tql_str(v))
+            # characters so UI input cannot alter the query structure.
+            # Unsupported keys REJECT the request — silently dropping a
+            # filter would return unfiltered results as if they matched.
+            if not k:
+                continue
+            if not _re.fullmatch(r"[\w.\-/:]+", k):
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"unsupported characters in tag key {k!r}")
+            conds.append(f"span.{k} = " + _tql_str(v))
         if 6 in q:                     # duration_min (Duration msg)
             conds.append(f"duration >= {_dur_ns(bytes(q[6][0]))}ns")
         if 7 in q:

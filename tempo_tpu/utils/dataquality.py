@@ -51,6 +51,18 @@ class DataQuality:
         self.warn(tenant, REASON_FUTURE, n_future)
         self.warn(tenant, REASON_PAST, n_past)
 
+    def observe_start_ns(self, tenant: str, start_ns) -> None:
+        """Vectorized variant over a [n] start-time column (the columnar
+        distributor path)."""
+        import numpy as np
+
+        st = np.asarray(start_ns, np.float64)
+        now_ns = self.now() * 1e9
+        self.warn(tenant, REASON_FUTURE,
+                  int((st > now_ns + _FUTURE_S * 1e9).sum()))
+        self.warn(tenant, REASON_PAST,
+                  int(((st > 0) & (st < now_ns - _PAST_S * 1e9)).sum()))
+
     def snapshot(self) -> dict[tuple[str, str], int]:
         with self._lock:
             return dict(self.warnings)

@@ -65,6 +65,25 @@ class UsageTracker:
                 ent[0] += sz
                 ent[1] += 1
 
+    def observe_grouped(self, tenant: str,
+                        groups: "Sequence[tuple[tuple, int, float]]") -> None:
+        """Pre-aggregated observation: (dim-value tuple, span count, byte
+        sum) per distinct combo — the columnar distributor path computes
+        these with numpy and crosses into Python once per combo."""
+        with self._lock:
+            tseries = self._series.setdefault(tenant, {})
+            ndims = len(self.cfg.dimensions)
+            for key, n, nbytes in groups:
+                ent = tseries.get(key)
+                if ent is None:
+                    if len(tseries) >= self.cfg.max_cardinality:
+                        key = (OVERFLOW,) * ndims
+                        ent = tseries.setdefault(key, [0, 0])
+                    else:
+                        ent = tseries[key] = [0, 0]
+                ent[0] += nbytes
+                ent[1] += n
+
     def prometheus_text(self) -> str:
         """`/usage_metrics` exposition."""
         dims = self.cfg.dimensions

@@ -297,3 +297,61 @@ def test_generator_tee_raw_otlp_slicing(rig):
         assert gs == ws          # full span dict round-trips the slice
     if native.available():
         assert all(len(g.spans) > 0 for g in gens.values())
+
+
+def test_columnar_push_matches_dict_path(rig):
+    """distributor.push_otlp (no span dicts in the distributor) must land
+    the same traces, reasons, and usage as push_spans over the same
+    payload — including RF3 replication content at every ingester."""
+    import numpy as np
+
+    from tempo_tpu import native
+    from tempo_tpu.model.otlp import encode_spans_otlp, spans_from_otlp_proto
+
+    if not native.available():
+        import pytest
+        pytest.skip("native scanner required")
+
+    t, now, backend, ring, ingesters, dist = rig
+    src = []
+    for i in range(1, 16):
+        src.append(mkspan(bytes([i]) * 16, bytes([i]) * 8,
+                          name=f"cp-{i % 3}",
+                          attrs={"http.status_code": 200 + i},
+                          res_attrs={"service.name": f"cs-{i % 2}"}))
+    # two spans of one trace in different resources + an invalid-id span
+    src.append(mkspan(bytes([1]) * 16, b"\xaa" * 8, name="cp-x",
+                      res_attrs={"service.name": "cs-1"}))
+    raw = encode_spans_otlp(src) + encode_spans_otlp(
+        [{**mkspan(b"", b"\x01" * 8), "trace_id": b""}])
+
+    errs = dist.push_otlp("t1", raw)
+    assert errs.get("invalid_trace_id") == 1
+    # every ingester holds every valid trace (RF3, 3 members)
+    for i in range(1, 16):
+        held = sum(1 for ing in ingesters.values()
+                   if ing.find_trace_by_id("t1", bytes([i]) * 16))
+        assert held == 3, (i, held)
+    # the multi-resource trace carries both spans everywhere
+    for ing in ingesters.values():
+        spans = ing.find_trace_by_id("t1", bytes([1]) * 16)
+        assert {s["span_id"] for s in spans} == {bytes([1]) * 8, b"\xaa" * 8}
+    # usage attribution by service matches the dict path's labels
+    snap = dist.usage.prometheus_text()
+    assert 'service="cs-0"' in snap and 'service="cs-1"' in snap
+    # metrics counters moved
+    assert dist.metrics["spans_received_total"] >= 17
+    assert dist.dataquality.snapshot() is not None
+
+    # parity of ingester CONTENT vs the dict path on a fresh rig tenant
+    decoded = list(spans_from_otlp_proto(raw))
+    errs2 = dist.push_spans("t2", decoded)
+    assert errs2.get("invalid_trace_id") == 1
+    for i in range(1, 16):
+        a = next(ing.find_trace_by_id("t1", bytes([i]) * 16)
+                 for ing in ingesters.values())
+        b = next(ing.find_trace_by_id("t2", bytes([i]) * 16)
+                 for ing in ingesters.values())
+        ka = sorted((s["span_id"], s["name"]) for s in a)
+        kb = sorted((s["span_id"], s["name"]) for s in b)
+        assert ka == kb
