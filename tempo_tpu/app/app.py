@@ -31,6 +31,7 @@ from tempo_tpu.ring.ring import _instance_tokens
 STORE, OVERRIDES, DISTRIBUTOR, INGESTER, GENERATOR = (
     "store", "overrides", "distributor", "ingester", "metrics-generator")
 QUERIER, FRONTEND, COMPACTOR = "querier", "query-frontend", "compactor"
+BLOCKBUILDER = "block-builder"
 ALL = "all"
 
 TARGETS = {
@@ -44,6 +45,8 @@ TARGETS = {
     # in-process; scale-out adds more query-tier processes)
     FRONTEND: [OVERRIDES, STORE, QUERIER, FRONTEND],
     COMPACTOR: [OVERRIDES, STORE, COMPACTOR],
+    # kafka-path persister (`modules.go:386-406`, gated on Ingest.Enabled)
+    BLOCKBUILDER: [OVERRIDES, STORE, BLOCKBUILDER],
 }
 
 
@@ -128,6 +131,8 @@ class App:
         self.grpc_port: int = 0
         self.frontend_worker = None
         self.usage_reporter = None
+        self.bus = None
+        self.blockbuilder = None
         self._lifecyclers: list[Lifecycler] = []
         # warm the native layer at startup so the first proto push never
         # pays the g++ compile inside a request handler
@@ -140,6 +145,7 @@ class App:
     def _build(self) -> None:
         mods = TARGETS[self.cfg.target]
         self._init_backend()
+        self._init_bus()
         if OVERRIDES in mods:
             self._init_overrides()
         if STORE in mods:
@@ -154,6 +160,45 @@ class App:
             self._init_querier()
         if FRONTEND in mods:
             self._init_frontend()
+        if BLOCKBUILDER in mods or (self.cfg.target == ALL
+                                    and self.bus is not None):
+            # ALL + ingest.enabled: the bus REPLACES ingester replication
+            # on the write path, so the single binary must also run the
+            # persister or pushes would 200 and silently never store
+            self._init_blockbuilder()
+
+    def _init_bus(self) -> None:
+        """The ingest-storage bus (`cfg.Ingest.Enabled` gate): real Kafka
+        via the wire client when a bootstrap is configured, the in-memory
+        partitioned log otherwise (single-process / tests). Only targets
+        that USE the bus open a broker connection — a shared config file
+        must not make the read path dial (or fail on) Kafka."""
+        self.bus = None
+        if not self.cfg.ingest.enabled:
+            return
+        mods = TARGETS[self.cfg.target]
+        if not ({DISTRIBUTOR, GENERATOR, BLOCKBUILDER} & set(mods)
+                or self.cfg.target == ALL):
+            return
+        ic = self.cfg.ingest
+        if ic.kafka_bootstrap:
+            from tempo_tpu.ingest.kafka import KafkaBus
+            self.bus = KafkaBus(ic.kafka_bootstrap, topic=ic.topic,
+                                n_partitions=ic.n_partitions)
+        else:
+            from tempo_tpu.ingest import Bus
+            self.bus = Bus(n_partitions=ic.n_partitions)
+
+    def _init_blockbuilder(self) -> None:
+        from tempo_tpu.blockbuilder import BlockBuilder, BlockBuilderConfig
+        if self.bus is None:
+            raise ValueError(
+                "target=block-builder requires ingest.enabled: true")
+        parts = tuple(self.cfg.ingest.partitions) or \
+            tuple(range(self.cfg.ingest.n_partitions))
+        self.blockbuilder = BlockBuilder(
+            self.bus, self.backend,
+            BlockBuilderConfig(partitions=parts), now=self.now)
 
     def _init_backend(self) -> None:
         s = self.cfg.storage
@@ -278,7 +323,7 @@ class App:
         self.distributor = Distributor(
             iring, ing_clients, overrides=self.overrides,
             generator_ring=gring, generator_clients=gen_clients,
-            cfg=self.cfg.distributor, now=self.now)
+            cfg=self.cfg.distributor, bus=self.bus, now=self.now)
         if self.cfg.target == ALL and not self.cfg.peers.ingesters \
                 and not self.cfg.ring_kv_url:
             self.distributor.cfg.rf = 1   # one in-process ingester
@@ -384,6 +429,33 @@ class App:
                 service_name=f"tempo-tpu-{self.cfg.target}",
                 tenant=self.cfg.self_tracing_tenant, now=self.now)
             tracing.install(self._self_tracer)
+        if self.bus is not None and (self.blockbuilder is not None
+                                     or self.generator is not None):
+            ic = self.cfg.ingest
+            parts = tuple(ic.partitions) or tuple(range(ic.n_partitions))
+            self.bus_consume_errors = 0
+
+            def consume_loop():
+                import sys
+                last_logged = 0.0
+                while not self._stop.wait(ic.consume_interval_s):
+                    try:
+                        if self.blockbuilder is not None:
+                            self.blockbuilder.consume_cycle()
+                        if self.generator is not None:
+                            self.generator.consume_bus(self.bus, parts)
+                    except Exception as e:
+                        # retried next tick, but NEVER silently: a
+                        # permanently failing consumer must be visible
+                        self.bus_consume_errors += 1
+                        now = self.now()
+                        if now - last_logged > 60:
+                            last_logged = now
+                            print(f"tempo-tpu: bus consume error "
+                                  f"(#{self.bus_consume_errors}): {e!r}",
+                                  file=sys.stderr)
+            t = threading.Thread(target=consume_loop, daemon=True)
+            t.start()
         if self.cfg.usage_stats_enabled and self.backend is not None:
             from tempo_tpu.utils.usagestats import UsageReporter
             self.usage_reporter = UsageReporter(

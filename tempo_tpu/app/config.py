@@ -69,6 +69,23 @@ class PeersConfig:
 
 
 @dataclasses.dataclass
+class IngestConfig:
+    """The ingest-storage path (`cfg.Ingest` gating `modules.go:386-406`):
+    the distributor produces partition-keyed records onto a bus instead
+    of replicating to ingesters; a block-builder target persists them and
+    generators consume the same partitions."""
+
+    enabled: bool = False
+    # "" = in-memory bus (single process / tests); host:port = real Kafka
+    # via the SDK-free wire client (ingest/kafka.py)
+    kafka_bootstrap: str = ""
+    topic: str = "tempo-ingest"
+    n_partitions: int = 2
+    partitions: tuple = ()              # consumed partitions ((): all)
+    consume_interval_s: float = 1.0
+
+
+@dataclasses.dataclass
 class Config:
     target: str = "all"
     multitenancy_enabled: bool = False
@@ -93,6 +110,7 @@ class Config:
     overrides_defaults: Limits = dataclasses.field(default_factory=Limits)
     per_tenant_override_config: str = ""   # runtime-config file path
     compaction_interval_s: float = 30.0
+    ingest: IngestConfig = dataclasses.field(default_factory=IngestConfig)
     # anonymized usage reporting (pkg/usagestats): leader-elected via the
     # shared KV, report written to the backend under usage-stats/ — never
     # sent anywhere (inspectable stand-in for the reference's reporter)

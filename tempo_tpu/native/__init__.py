@@ -192,6 +192,8 @@ def _load():
                 c.c_char_p, c.c_int64, u8p, c.c_int64, c.c_int64,
                 c.POINTER(c.c_uint32)]
             lib.fnv1_tokens.restype = None
+            lib.crc32c.argtypes = [c.c_char_p, c.c_int64]
+            lib.crc32c.restype = c.c_uint32
             lib.otlp_scan.argtypes = [u8p, c.c_int64, c.c_void_p, c.c_int64]
             lib.otlp_scan.restype = c.c_int64
             lib.otlp_scan2.argtypes = [
@@ -242,6 +244,15 @@ def _load():
 
 def available() -> bool:
     return _load() is not None
+
+
+def crc32c(data: bytes) -> "int | None":
+    """Native Castagnoli CRC (kafka record batches); None when the
+    library is unavailable (callers fall back to the python table)."""
+    lib = _load()
+    if lib is None:
+        return None
+    return int(lib.crc32c(data, len(data)))
 
 
 # -- fnv tokens --------------------------------------------------------------

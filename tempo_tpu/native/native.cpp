@@ -23,6 +23,26 @@
 
 extern "C" {
 
+// --- crc32c (Castagnoli; kafka record batches) ------------------------------
+
+static uint32_t kCrcTab[256];
+static bool kCrcInit = [] {
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
+        kCrcTab[i] = c;
+    }
+    return true;
+}();
+
+uint32_t crc32c(const uint8_t* data, int64_t n) {
+    uint32_t crc = 0xFFFFFFFFu;
+    for (int64_t i = 0; i < n; i++)
+        crc = kCrcTab[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+    return crc ^ 0xFFFFFFFFu;
+}
+
 // --- fnv1 32 token hashing -------------------------------------------------
 
 // out[i] = fnv1_32(tenant || tids[i*16..+16])  (hash.go TokenFor semantics)

@@ -1,0 +1,307 @@
+"""In-process mock Kafka broker (the kfake/testkafka analog).
+
+A threaded socket server speaking the Kafka binary-protocol subset
+`ingest/kafka.py` uses — Produce v3, Fetch v4, OffsetCommit v2,
+OffsetFetch v1 — with independent verification of the wire: framing,
+correlation ids, and the v2 RecordBatch layout INCLUDING the CRC32C
+(computed here with its own table), so client-side encoding bugs fail
+the way they would against a real broker.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+
+# independent crc32c table (same Castagnoli polynomial, built separately)
+_TAB = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ 0x82F63B78 if _c & 1 else _c >> 1
+    _TAB.append(_c)
+
+
+def _crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _TAB[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _i16(v):
+    return struct.pack(">h", v)
+
+
+def _i32(v):
+    return struct.pack(">i", v)
+
+
+def _i64(v):
+    return struct.pack(">q", v)
+
+
+class _R:
+    def __init__(self, b):
+        self.b = b
+        self.i = 0
+
+    def take(self, fmt):
+        v = struct.unpack_from(fmt, self.b, self.i)[0]
+        self.i += struct.calcsize(fmt)
+        return v
+
+    def string(self):
+        n = self.take(">h")
+        if n < 0:
+            return None
+        v = self.b[self.i:self.i + n].decode()
+        self.i += n
+        return v
+
+    def bytes_(self):
+        n = self.take(">i")
+        if n < 0:
+            return None
+        v = self.b[self.i:self.i + n]
+        self.i += n
+        return v
+
+    def uvarint(self):
+        out = shift = 0
+        while True:
+            b = self.b[self.i]
+            self.i += 1
+            out |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return out
+            shift += 7
+
+    def varint(self):
+        v = self.uvarint()
+        return (v >> 1) ^ -(v & 1)
+
+
+class MockKafkaBroker:
+    """One broker, N partitions per topic, stores (key, value) records."""
+
+    def __init__(self, n_partitions: int = 2) -> None:
+        self.n_partitions = n_partitions
+        self.logs: dict[tuple[str, int], list[tuple[bytes, bytes]]] = {}
+        self.offsets: dict[tuple[str, str, int], int] = {}
+        self.lock = threading.Lock()
+        self.produce_batches = 0      # verified batches accepted
+
+    # -- record batch verification + decode ---------------------------------
+
+    def _decode_batch(self, buf: bytes) -> list[tuple[bytes, bytes]]:
+        r = _R(buf)
+        out = []
+        while r.i + 61 <= len(buf):
+            r.take(">q")                        # base offset
+            blen = r.take(">i")
+            end = r.i + blen
+            r.take(">i")                        # leader epoch
+            magic = r.take(">b")
+            if magic != 2:
+                raise ValueError(f"bad magic {magic}")
+            crc = r.take(">I")
+            want = _crc32c(buf[r.i:end])
+            if crc != want:
+                raise ValueError(f"crc mismatch {crc:#x} != {want:#x}")
+            r.take(">h"); r.take(">i")
+            r.take(">q"); r.take(">q")
+            r.take(">q"); r.take(">h"); r.take(">i")
+            n = r.take(">i")
+            for _ in range(n):
+                r.varint()
+                r.take(">b")
+                r.varint(); r.varint()
+                klen = r.varint()
+                key = buf[r.i:r.i + max(klen, 0)]; r.i += max(klen, 0)
+                vlen = r.varint()
+                val = buf[r.i:r.i + max(vlen, 0)]; r.i += max(vlen, 0)
+                for _h in range(r.uvarint()):
+                    hk = r.varint(); r.i += max(hk, 0)
+                    hv = r.varint(); r.i += max(hv, 0)
+                out.append((bytes(key), bytes(val)))
+            r.i = end
+        return out
+
+    def _encode_batch(self, base: int, recs: list[tuple[bytes, bytes]]
+                      ) -> bytes:
+        body = bytearray()
+        for i, (k, v) in enumerate(recs):
+            rec = (struct.pack(">b", 0) + _zig(0) + _zig(i) +
+                   _zig(len(k)) + k + _zig(len(v)) + v + b"\x00")
+            body += _zig(len(rec)) + rec
+        after = (_i16(0) + _i32(len(recs) - 1) + _i64(0) + _i64(0) +
+                 _i64(-1) + _i16(-1) + _i32(-1) + _i32(len(recs)) +
+                 bytes(body))
+        crc = _crc32c(after)
+        inner = _i32(0) + struct.pack(">b", 2) + struct.pack(">I", crc) + after
+        return _i64(base) + _i32(len(inner)) + inner
+
+    # -- api handlers --------------------------------------------------------
+
+    def handle(self, api_key: int, api_version: int, body: bytes) -> bytes:
+        if api_key == 0:
+            return self._produce(body)
+        if api_key == 1:
+            return self._fetch(body)
+        if api_key == 8:
+            return self._offset_commit(body)
+        if api_key == 9:
+            return self._offset_fetch(body)
+        raise ValueError(f"unsupported api key {api_key}")
+
+    def _produce(self, body: bytes) -> bytes:
+        r = _R(body)
+        r.string()                              # transactional id
+        r.take(">h")                            # acks
+        r.take(">i")                            # timeout
+        out_topics = []
+        for _t in range(r.take(">i")):
+            topic = r.string()
+            parts = []
+            for _p in range(r.take(">i")):
+                part = r.take(">i")
+                batch = r.bytes_() or b""
+                recs = self._decode_batch(batch)
+                with self.lock:
+                    log = self.logs.setdefault((topic, part), [])
+                    base = len(log)
+                    log.extend(recs)
+                    self.produce_batches += 1
+                parts.append(_i32(part) + _i16(0) + _i64(base) + _i64(-1))
+            out_topics.append(
+                _str(topic) + _i32(len(parts)) + b"".join(parts))
+        return (_i32(len(out_topics)) + b"".join(out_topics) + _i32(0))
+
+    def _fetch(self, body: bytes) -> bytes:
+        r = _R(body)
+        r.take(">i"); r.take(">i"); r.take(">i"); r.take(">i")
+        r.take(">b")                            # isolation
+        out_topics = []
+        for _t in range(r.take(">i")):
+            topic = r.string()
+            parts = []
+            for _p in range(r.take(">i")):
+                part = r.take(">i")
+                offset = r.take(">q")
+                max_bytes = r.take(">i")
+                with self.lock:
+                    log = list(self.logs.get((topic, part), []))
+                hw = len(log)
+                recs = log[offset:]
+                batch = (self._encode_batch(offset, recs)
+                         if recs else b"")
+                batch = batch[:max(max_bytes, 0)] if max_bytes < len(batch) \
+                    else batch
+                parts.append(_i32(part) + _i16(0) + _i64(hw) + _i64(hw) +
+                             _i32(0) +           # aborted txns
+                             _i32(len(batch)) + batch)
+            out_topics.append(
+                _str(topic) + _i32(len(parts)) + b"".join(parts))
+        return _i32(0) + _i32(len(out_topics)) + b"".join(out_topics)
+
+    def _offset_commit(self, body: bytes) -> bytes:
+        r = _R(body)
+        group = r.string()
+        r.take(">i")                            # generation
+        r.string()                              # member id
+        r.take(">q")                            # retention
+        out_topics = []
+        for _t in range(r.take(">i")):
+            topic = r.string()
+            parts = []
+            for _p in range(r.take(">i")):
+                part = r.take(">i")
+                off = r.take(">q")
+                r.string()                      # metadata
+                with self.lock:
+                    self.offsets[(group, topic, part)] = off
+                parts.append(_i32(part) + _i16(0))
+            out_topics.append(
+                _str(topic) + _i32(len(parts)) + b"".join(parts))
+        return _i32(len(out_topics)) + b"".join(out_topics)
+
+    def _offset_fetch(self, body: bytes) -> bytes:
+        r = _R(body)
+        group = r.string()
+        out_topics = []
+        for _t in range(r.take(">i")):
+            topic = r.string()
+            parts = []
+            for _p in range(r.take(">i")):
+                part = r.take(">i")
+                with self.lock:
+                    off = self.offsets.get((group, topic, part), -1)
+                parts.append(_i32(part) + _i64(off) + _str("") + _i16(0))
+            out_topics.append(
+                _str(topic) + _i32(len(parts)) + b"".join(parts))
+        return _i32(len(out_topics)) + b"".join(out_topics)
+
+
+def _str(s: str) -> bytes:
+    b = s.encode()
+    return _i16(len(b)) + b
+
+
+def _zig(v: int) -> bytes:
+    v = (v << 1) ^ (v >> 63)
+    out = bytearray()
+    while True:
+        x = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(x | 0x80)
+        else:
+            out.append(x)
+            return bytes(out)
+
+
+def start_mock_kafka(n_partitions: int = 2):
+    """Returns (server_socket_thread_handle, port, broker). Serves until
+    the returned closer is called."""
+    import socketserver
+
+    broker = MockKafkaBroker(n_partitions)
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            sock = self.request
+            try:
+                while True:
+                    hdr = _readn(sock, 4)
+                    if hdr is None:
+                        return
+                    (n,) = struct.unpack(">i", hdr)
+                    msg = _readn(sock, n)
+                    if msg is None:
+                        return
+                    r = _R(msg)
+                    api_key = r.take(">h")
+                    api_version = r.take(">h")
+                    corr = r.take(">i")
+                    r.string()                  # client id
+                    resp = broker.handle(api_key, api_version, msg[r.i:])
+                    out = _i32(corr) + resp
+                    sock.sendall(_i32(len(out)) + out)
+            except (ConnectionError, ValueError, struct.error):
+                return
+
+    def _readn(sock, n):
+        out = b""
+        while len(out) < n:
+            chunk = sock.recv(n - len(out))
+            if not chunk:
+                return None
+            out += chunk
+        return out
+
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), Handler)
+    srv.daemon_threads = True
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, srv.server_address[1], broker

@@ -210,3 +210,216 @@ def test_compactor_ring_splits_ownership():
     # single instance owns everything
     solo = Compactor(db, None, "solo")
     assert all(solo.owns(k) for k in keys)
+
+
+# -- real Kafka wire protocol (pkg/ingest external client) -------------------
+
+def _kafka_rig():
+    from tests.mock_kafka import start_mock_kafka
+    from tempo_tpu.ingest.kafka import KafkaBus
+
+    srv, port, broker = start_mock_kafka(n_partitions=2)
+    bus = KafkaBus(f"127.0.0.1:{port}", n_partitions=2)
+    return srv, broker, bus
+
+
+def test_kafka_wire_produce_fetch_commit():
+    srv, broker, bus = _kafka_rig()
+    try:
+        assert bus.produce(0, "t1", b"hello") == 0
+        assert bus.produce(0, "t1", b"world") == 1
+        assert bus.produce(1, "t2", b"other") == 0
+        assert broker.produce_batches == 3      # crc32c verified per batch
+
+        recs = bus.fetch(0, 0)
+        assert [(r.offset, r.tenant, r.value) for r in recs] == \
+            [(0, "t1", b"hello"), (1, "t1", b"world")]
+        assert bus.fetch(0, 1)[0].value == b"world"
+        assert bus.fetch(0, 2) == []
+        assert bus.high_watermark(0) == 2 and bus.high_watermark(1) == 1
+
+        assert bus.committed("g", 0) == 0       # no commit yet
+        bus.commit("g", 0, 2)
+        assert bus.committed("g", 0) == 2
+        assert bus.lag("g", 0) == 0 and bus.lag("g", 1) == 1
+    finally:
+        bus.close()
+        srv.shutdown()
+
+
+def test_kafka_wire_crc_rejected():
+    """A corrupted batch must be rejected broker-side AND client-side."""
+    import struct
+
+    from tempo_tpu.ingest.kafka import (decode_record_batches,
+                                        encode_record_batch)
+
+    batch = bytearray(encode_record_batch(0, [(b"t", b"payload")]))
+    batch[-1] ^= 0xFF                           # flip a record byte
+    try:
+        decode_record_batches(bytes(batch))
+        raise AssertionError("expected crc failure")
+    except ValueError as e:
+        assert "crc" in str(e)
+
+    from tests.mock_kafka import MockKafkaBroker
+    try:
+        MockKafkaBroker()._decode_batch(bytes(batch))
+        raise AssertionError("expected broker crc failure")
+    except ValueError as e:
+        assert "crc" in str(e)
+
+
+def test_kafka_bus_feeds_blockbuilder_and_generator():
+    """The product path over the REAL wire: distributor produce →
+    blockbuilder consume (offset-commit-after-flush) + generator consume,
+    unchanged from the in-memory bus."""
+    import time
+
+    from tempo_tpu.backend.mem import MemBackend
+    from tempo_tpu.blockbuilder import BlockBuilder
+    from tempo_tpu.generator.generator import Generator
+    from tempo_tpu.generator.instance import GeneratorConfig
+    from tempo_tpu.blockbuilder.blockbuilder import produce_traces
+    from tempo_tpu.overrides import Overrides
+
+    srv, broker, bus = _kafka_rig()
+    try:
+        t0 = int((time.time() - 3) * 1e9)
+        groups = []
+        import numpy as np
+        for i in range(1, 9):
+            tid = bytes([i]) * 16
+            groups.append((tid, [{"trace_id": tid, "span_id": bytes([i]) * 8,
+                                  "name": f"k-{i % 2}", "service": "ksvc",
+                                  "start_unix_nano": t0,
+                                  "end_unix_nano": t0 + 10**6}]))
+        tokens = np.arange(1, 9, dtype=np.uint32) * 1000
+        produce_traces(bus, "t1", groups, tokens)
+        total_recs = bus.high_watermark(0) + bus.high_watermark(1)
+        assert total_recs >= 2          # records batch traces per partition
+
+        be = MemBackend()
+        from tempo_tpu.blockbuilder import BlockBuilderConfig
+        from tempo_tpu.blockbuilder.blockbuilder import CONSUMER_GROUP
+        bb = BlockBuilder(bus, be, BlockBuilderConfig(partitions=(0, 1)))
+        n = bb.consume_cycle()
+        assert n == total_recs
+        from tempo_tpu.db.tempodb import TempoDB
+        db = TempoDB(be, be)
+        db.poll_now()
+        assert sum(m.total_objects
+                   for m in db.blocklist.metas("t1")) == 8
+        # offsets committed AFTER flush
+        assert bus.committed(CONSUMER_GROUP, 0) == bus.high_watermark(0)
+        assert bus.committed(CONSUMER_GROUP, 1) == bus.high_watermark(1)
+
+        ov = Overrides()
+        ov.set_tenant_patch("t1", {"generator": {"processors": ["span-metrics"]}})
+        gen = Generator(GeneratorConfig(processors=("span-metrics",)),
+                        overrides=ov)
+        got = gen.consume_bus(bus, (0, 1))   # returns RECORD count
+        assert got == total_recs
+        assert gen.instance("t1").spans_received == 8
+    finally:
+        bus.close()
+        srv.shutdown()
+
+
+def test_ingest_storage_deployment_over_kafka(tmp_path):
+    """The full kafka-path deployment shape: a distributor App produces to
+    a real-wire Kafka (mock broker), a block-builder App persists blocks,
+    a generator App aggregates — three processes sharing only the broker
+    and the object store (`modules.go:386-406` + generator_kafka.go)."""
+    import json
+    import socket
+    import time
+    import urllib.request
+
+    from tempo_tpu.app import App
+    from tempo_tpu.app.api import serve
+    from tempo_tpu.app.config import Config
+    from tests.mock_kafka import start_mock_kafka
+
+    srv, kport, broker = start_mock_kafka(n_partitions=2)
+
+    def port():
+        s = socket.socket(); s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]; s.close(); return p
+
+    store = str(tmp_path / "store")
+    apps, servers = {}, {}
+
+    def boot(name, cfg):
+        cfg.server.http_listen_port = port()
+        cfg.ingest.enabled = True
+        cfg.ingest.kafka_bootstrap = f"127.0.0.1:{kport}"
+        cfg.ingest.n_partitions = 2
+        cfg.ingest.consume_interval_s = 0.1
+        app = App(cfg)
+        app.overrides.set_tenant_patch("single-tenant", {
+            "generator": {"processors": ["span-metrics"]}})
+        app.start_loops()
+        apps[name] = app
+        servers[name] = serve(app, block=False)
+
+    d = Config(target="distributor")
+    boot("dist", d)
+    bbc = Config(target="block-builder")
+    bbc.storage.backend = "local"
+    bbc.storage.local_path = store
+    boot("bb", bbc)
+    g = Config(target="metrics-generator")
+    g.storage.backend = "local"
+    g.storage.local_path = store
+    g.generator.localblocks.data_dir = str(tmp_path / "lb")
+    boot("gen", g)
+
+    try:
+        t0 = int((time.time() - 3) * 1e9)
+        spans = [{"traceId": ("%02x" % i) * 16, "spanId": "ab" * 8,
+                  "name": "kf-op", "kind": 2,
+                  "startTimeUnixNano": str(t0),
+                  "endTimeUnixNano": str(t0 + 10_000_000)}
+                 for i in range(1, 13)]
+        otlp = {"resourceSpans": [{"resource": {"attributes": [
+            {"key": "service.name", "value": {"stringValue": "kf"}}]},
+            "scopeSpans": [{"spans": spans}]}]}
+        url = f"http://127.0.0.1:{apps['dist'].cfg.server.http_listen_port}"
+        req = urllib.request.Request(url + "/v1/traces",
+                                     data=json.dumps(otlp).encode(),
+                                     headers={"Content-Type":
+                                              "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+        # records landed on the broker (crc-verified) across partitions
+        assert broker.produce_batches >= 1
+
+        # block-builder persists, generator aggregates — via their loops
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            inst = apps["gen"].generator.instances.get("single-tenant")
+            if inst is not None and inst.spans_received == 12:
+                break
+            time.sleep(0.1)
+        assert apps["gen"].generator.instance(
+            "single-tenant").spans_received == 12
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            apps["bb"].db.poll_now()
+            metas = apps["bb"].db.blocklist.metas("single-tenant")
+            if sum(m.total_objects for m in metas) == 12:
+                break
+            time.sleep(0.1)
+        assert sum(m.total_objects for m in
+                   apps["bb"].db.blocklist.metas("single-tenant")) == 12
+        # and the blocks are queryable
+        spans_back = apps["bb"].db.find_trace_by_id(
+            "single-tenant", bytes.fromhex("05" * 16))
+        assert spans_back and spans_back[0]["name"] == "kf-op"
+    finally:
+        for s in servers.values():
+            s.shutdown()
+        for a in apps.values():
+            a.shutdown()
+        srv.shutdown()
