@@ -337,6 +337,43 @@ def _bench_scan_plane(db) -> dict:
         (np.concatenate(np_masks) == dev_mask).all())
     out["scan_device_spans_per_sec"] = out["scan_spans"] / (
         out["scan_device_ms"] / 1000)
+
+    # the FULL device metrics path over the same resident 1M spans: mask →
+    # step bucket → group scatter, one dispatch (vs the engine's per-view
+    # observe loop measured by query_range_ms on the 100k block)
+    from tempo_tpu.traceql.engine_metrics import MetricsEvaluator
+    from tempo_tpu.traceql.engine_metrics import QueryRangeRequest as QRR
+
+    plane.load_times(scan_views_list)
+    v0 = scan_views_list[0]
+    start_ns = int(v0.col("__startTime").values.min())
+    qr_req = QRR(query="{ } | rate() by (resource.service.name)",
+                 start_ns=start_ns, end_ns=start_ns + int(900e9),
+                 step_ns=int(60e9))
+    plane.query_range_grid([], True, "service", qr_req.start_ns,
+                           qr_req.end_ns, qr_req.step_ns)   # warmup
+    t0 = time.time()
+    got = plane.query_range_grid([], True, "service", qr_req.start_ns,
+                                 qr_req.end_ns, qr_req.step_ns)
+    out["qr_device_grid_1m_ms"] = (time.time() - t0) * 1000
+    ev = MetricsEvaluator(qr_req)
+    t0 = time.time()
+    for v in scan_views_list:
+        ev.observe(v)
+    out["qr_engine_observe_1m_ms"] = (time.time() - t0) * 1000
+    # parity per GROUP ROW, not grand totals — misplaced scatters that
+    # conserve the sum must not read as "equal"
+    eng = {dict(s.labels).get("resource.service.name"):
+           np.nan_to_num(np.asarray(s.samples)) for s in ev.results()}
+    equal = got is not None
+    if got is not None:
+        labels, grid = got
+        for gi, lbl in enumerate(labels):
+            want = eng.get(lbl, np.zeros(grid.shape[1]))
+            if not np.allclose(grid[gi], want, rtol=1e-5, atol=1e-3):
+                equal = False
+                break
+    out["qr_grids_equal"] = equal
     return out
 
 
@@ -447,6 +484,10 @@ def main() -> int:
         "scan_numpy_ms": round(results["scan_numpy_ms"], 1)
         if "scan_numpy_ms" in results else None,
         "scan_spans": results.get("scan_spans"),
+        "qr_device_grid_1m_ms": round(results["qr_device_grid_1m_ms"], 1)
+        if "qr_device_grid_1m_ms" in results else None,
+        "qr_engine_observe_1m_ms": round(results["qr_engine_observe_1m_ms"], 1)
+        if "qr_engine_observe_1m_ms" in results else None,
     }
     if errors:
         extra["errors"] = errors

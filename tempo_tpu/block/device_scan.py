@@ -216,6 +216,8 @@ class BlockScanPlane:
         self.n = int(sum(v.n for v in views))
         self._dev: dict[str, object] = {}
         self._dicts: dict[str, list[str]] = {}
+        self._qr_cache: dict = {}
+        self.time_base_ns = 0.0
         for key, meta_key in (("name", "name_col"), ("service", "service_col")):
             parts = []
             block_ids: dict[str, int] = {}
@@ -279,6 +281,93 @@ class BlockScanPlane:
             sig.append(term[0])
             args.extend((col, jnp.float32(term[1])))
         return (tuple(sig), args) if sig else None
+
+    def load_times(self, views: Sequence) -> None:
+        """Attach rebased start times for the metrics plane: f32 seconds
+        relative to the block's min start (sub-ms resolution over any
+        realistic block span — step buckets are ≥1s). No-op (and the
+        metrics plane stays unavailable) when a view lacks times."""
+        import jax.numpy as jnp
+
+        cols = [v.col("__startTime") for v in views]
+        if not cols or any(c is None for c in cols):
+            return
+        starts = np.concatenate([np.asarray(c.values, np.float64)
+                                 for c in cols])
+        self.time_base_ns = float(starts.min()) if len(starts) else 0.0
+        self._dev["start_rel_s"] = jnp.asarray(
+            ((starts - self.time_base_ns) / 1e9).astype(np.float32))
+
+    def query_range_grid(self, preds: Sequence, all_conditions: bool,
+                         group: str | None, start_ns: int, end_ns: int,
+                         step_ns: int):
+        """The FULL device metrics path: predicate mask → step bucketing →
+        per-group scatter into a [groups, steps] count grid, one fused
+        dispatch over the resident block (`rate()`/`count_over_time()`
+        by name/service — SURVEY §3.4's hot loop with zero host work per
+        span). Returns (group label values, grid ndarray) or None when a
+        shape is unsupported."""
+        import jax
+        import jax.numpy as jnp
+
+        if "start_rel_s" not in self._dev:
+            return None
+        plan = self._plan(list(preds), all_conditions) if preds else ((), [])
+        if plan is None:
+            return None
+        sig, args = plan
+        if group is None:
+            codes = jnp.zeros(self.n, jnp.int32)
+            labels = [None]
+        else:
+            dev = self._dev.get(f"dict:{group}")
+            if dev is None:
+                return None
+            codes = dev
+            labels = self._dicts[group]
+        n_steps = max(int((end_ns - start_ns + step_ns - 1) // step_ns), 1)
+        rel = self._dev["start_rel_s"]
+        n_groups = len(labels)
+
+        # compiled per (plan shape, grid shape); time window and step ride
+        # in as traced scalars so a shifted query reuses the program
+        key = (sig, all_conditions, n_groups, n_steps)
+        fn = self._qr_cache.get(key)
+        if fn is None:
+            if len(self._qr_cache) >= 64:       # bounded like
+                self._qr_cache.pop(next(iter(self._qr_cache)))  # _compiled_mask
+
+            def build(codes, rel, q_steps, frac_s, step_s, win_s,
+                      *mask_args):
+                if sig:
+                    m = _compiled_mask(sig, all_conditions)(*mask_args)
+                else:
+                    m = jnp.ones(rel.shape, bool)
+                # step index split for precision: the whole-step offset
+                # between window start and block base is EXACT int host
+                # math; f32 only covers the sub-step fraction + intra-
+                # block offsets (small however far the window sits)
+                local = rel + frac_s
+                step_idx = q_steps + jnp.floor(local / step_s).astype(jnp.int32)
+                ok = (m & (step_idx >= 0) & (step_idx < n_steps)
+                      & (local < win_s))        # end_ns clip, like the
+                grid = jnp.zeros((n_groups, n_steps), jnp.float32)  # engine
+                return grid.at[
+                    jnp.where(ok, codes, n_groups),
+                    jnp.clip(step_idx, 0, n_steps - 1)
+                ].add(jnp.where(ok, 1.0, 0.0), mode="drop")
+            fn = self._qr_cache[key] = jax.jit(build)
+
+        delta_ns = int(self.time_base_ns) - start_ns
+        q_steps = delta_ns // step_ns            # exact whole steps (host)
+        frac_ns = delta_ns - q_steps * step_ns   # in [0, step_ns)
+        grid = fn(codes, rel,
+                  jnp.int32(q_steps), jnp.float32(frac_ns / 1e9),
+                  jnp.float32(step_ns / 1e9),
+                  jnp.float32((end_ns - int(self.time_base_ns) + frac_ns)
+                              / 1e9),
+                  *args)
+        return labels, np.asarray(grid)
 
     def mask_async(self, preds: Sequence, all_conditions: bool):
         """Launch the fused block mask; returns a device array (or None

@@ -123,3 +123,89 @@ def test_regex_is_anchored(block_db):
     names = np.concatenate([np.asarray(v.col("name").values) for v in views])
     assert mask is not None
     assert set(names[mask]) == {"op-1"}, set(names[mask])
+
+
+def test_device_query_range_grid_matches_engine(block_db):
+    """The full device metrics path — mask → step bucket → group scatter in
+    ONE dispatch over the resident block — must produce the same counts as
+    the engine's query_range for supported shapes."""
+    from tempo_tpu.block.fetch import scan_views
+    from tempo_tpu.block.reader import BackendBlock
+    from tempo_tpu.traceql.engine_metrics import QueryRangeRequest
+
+    meta = block_db.blocklist.metas("t")[0]
+    block = BackendBlock(block_db.r, meta)
+    views = [v for v, _ in scan_views(block, None)]
+    plane = device_scan.BlockScanPlane(views)
+    plane.load_times(views)
+
+    start_ns = int(T0 * 1e9)
+    end_ns = int((T0 + 600) * 1e9)
+    step_ns = int(100 * 1e9)
+
+    cases = [
+        ('{ } | rate() by (name)', "name", []),
+        ('{ } | count_over_time() by (resource.service.name)', "service", []),
+        ('{ duration > 100ms } | rate() by (name)', "name", None),
+        ('{ name = "op-3" } | count_over_time()', None, None),
+    ]
+    for query, group, _ in cases:
+        req = QueryRangeRequest(query=query, start_ns=start_ns,
+                                end_ns=end_ns, step_ns=step_ns)
+        engine_series = block_db.query_range("t", req)
+        # engine returns final-pass series: rate divides by step seconds
+        q = parse(query)
+        preds = [c for c in extract_conditions(q).conditions
+                 if c.op is not None]
+        got = plane.query_range_grid(
+            preds, True, group, start_ns, end_ns, step_ns)
+        assert got is not None, query
+        labels, grid = got
+        # db.query_range returns job-level RAW counts (AggregateModeSum;
+        # the frontend's final pass applies the rate division)
+        eng = {}
+        for s in engine_series:
+            d = dict(s.labels)
+            key = d.get("name") or d.get("resource.service.name") or None
+            eng[key] = np.nan_to_num(np.asarray(s.samples))
+        for gi, label in enumerate(labels):
+            row = grid[gi]
+            if label not in eng:
+                assert row.sum() == 0, (query, label, row)
+                continue
+            np.testing.assert_allclose(row, eng[label], rtol=1e-5,
+                                       err_msg=f"{query} group={label}")
+
+
+def test_device_query_range_unaligned_window(block_db):
+    """Non-step-aligned end: the last bucket must clip at end_ns exactly
+    like the engine (regression: spans past end_ns were counted while the
+    ceil'd last step covered them)."""
+    from tempo_tpu.block.fetch import scan_views
+    from tempo_tpu.block.reader import BackendBlock
+    from tempo_tpu.traceql.engine_metrics import (MetricsEvaluator,
+                                                  QueryRangeRequest)
+
+    meta = block_db.blocklist.metas("t")[0]
+    block = BackendBlock(block_db.r, meta)
+    views = [v for v, _ in scan_views(block, None)]
+    plane = device_scan.BlockScanPlane(views)
+    plane.load_times(views)
+    # 250s window over 100s steps: last bucket covers only 50s of data
+    start_ns = int(T0 * 1e9)
+    end_ns = int((T0 + 250) * 1e9)
+    step_ns = int(100 * 1e9)
+    req = QueryRangeRequest(query="{ } | rate() by (name)",
+                            start_ns=start_ns, end_ns=end_ns,
+                            step_ns=step_ns)
+    ev = MetricsEvaluator(req)
+    for v in views:
+        ev.observe(v)
+    eng = {dict(s.labels)["name"]: np.nan_to_num(np.asarray(s.samples))
+           for s in ev.results()}
+    labels, grid = plane.query_range_grid([], True, "name",
+                                          start_ns, end_ns, step_ns)
+    assert grid.sum() == 250        # spans at T0..T0+249 inclusive
+    for gi, lbl in enumerate(labels):
+        np.testing.assert_allclose(grid[gi], eng[lbl], rtol=1e-5,
+                                   err_msg=lbl)
