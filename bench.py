@@ -488,6 +488,9 @@ def main() -> int:
         if "qr_device_grid_1m_ms" in results else None,
         "qr_engine_observe_1m_ms": round(results["qr_engine_observe_1m_ms"], 1)
         if "qr_engine_observe_1m_ms" in results else None,
+        # device-vs-host parity evidence for the scan + metrics planes
+        "scan_masks_equal": results.get("scan_masks_equal"),
+        "qr_grids_equal": results.get("qr_grids_equal"),
     }
     if errors:
         extra["errors"] = errors
