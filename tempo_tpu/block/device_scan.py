@@ -1,4 +1,4 @@
-"""Device predicate plane for block scans.
+"""Device scan plane for backend blocks.
 
 The storage-level first pass (`condition_mask`) evaluated every pushdown
 predicate as a numpy mask over object-dtype string columns — the hot loop
@@ -13,28 +13,44 @@ touched the chip. Here the dictionary-coded form of the scan does:
   then one device gather. This is the reference's dictionary-page
   predicate pushdown (`predicates.go` `*DictionaryPredicate`) turned into
   a gather instead of a page scan.
-- numeric intrinsics (duration, kind, status, nested-set coords) compare
-  as device vectors against the literal.
+- integer columns (duration, kind, status, nested-set coords, int/bool
+  attributes, timestamps) compare EXACTLY on device: each int64 value is
+  split into two int32 halves (hi = v >> 31, lo = v & 0x7fffffff) and a
+  literal compare becomes a lexicographic (hi, lo) compare — no float32
+  rounding, so the device mask is bit-identical to the float64 numpy
+  plane for every integral column (the whole intrinsic set is integral).
+  Non-integral literals are normalized on host (`duration > 1.5` ⇒
+  `>= 2`); genuinely float-valued attribute columns fall back to host.
 - masks AND/OR-combine on device; one transfer returns the final mask.
 
-Comparisons run in float32 on device (TPU has no f64): a value within
-~6e-8 relative distance of a numeric literal may flip versus the exact
-numpy path. Set TEMPO_TPU_DEVICE_SCAN=0 to force the numpy plane.
+Two planes share this machinery:
 
-Unsupported shapes (attribute-list columns, non-literal operands) return
-None and the caller falls back to the numpy mask loop.
+`device_pred_mask` — per-row-group sync offload for `condition_mask`,
+OPT-IN via TEMPO_TPU_DEVICE_SCAN=1 (each mask pays a device round trip;
+float32 compares). Kept for diagnostics.
+
+`BlockScanPlane` — the PRODUCTION plane: per immutable block, columns are
+adopted lazily (first query referencing a column pays one host factorize
++ upload; blocks are immutable so adoption is permanent), and a query's
+whole first pass — predicates, time clip, row-group shard selection,
+step bucketing, group-by, metric scatter — runs as ONE fused dispatch.
+`db/tempodb.py` routes product search/query_range through it via
+`db/plane_cache.py`.
 """
 
 from __future__ import annotations
 
 import functools
-import os
 import re
+import os
+import threading
 from typing import Optional, Sequence
 
 import numpy as np
 
 from tempo_tpu.traceql import ast as A
+from tempo_tpu.traceql.eval import (BOOL, KIND, NUM, STATUS, STR, Col,
+                                    eval_expr)
 
 _NUM_OPS = {A.Op.EQ, A.Op.NEQ, A.Op.GT, A.Op.GTE, A.Op.LT, A.Op.LTE}
 _STR_OPS = {A.Op.EQ, A.Op.NEQ, A.Op.REGEX, A.Op.NOT_REGEX}
@@ -48,33 +64,56 @@ _NUM_INTRINSICS = {
     A.Intrinsic.NESTED_SET_PARENT: "nestedSetParent",
 }
 
+# static type → column type tag, for the reference's comparability lattice
+# (`enum_statics.go`: status/kind/num are distinct; see eval._comparable)
+_STATIC_T = {
+    A.StaticType.INT: NUM, A.StaticType.FLOAT: NUM,
+    A.StaticType.DURATION: NUM, A.StaticType.STRING: STR,
+    A.StaticType.BOOL: BOOL, A.StaticType.STATUS: STATUS,
+    A.StaticType.KIND: KIND,
+}
+
+_INT_MAX = 1 << 62   # |values| beyond this can't ride the hi/lo split
+
 
 def enabled() -> bool:
     """Per-row-group sync offload policy for `condition_mask` — OPT-IN
-    (TEMPO_TPU_DEVICE_SCAN=1). Two reasons it is not the default: each
-    synchronous mask pays a full device round trip (ruinous through a
-    high-latency accelerator link), and numeric compares run in float32,
-    which can flip values within ~6e-8 relative distance of a literal
-    versus the exact float64 numpy plane. The block-level
-    `BlockScanPlane` (explicit API, one fused dispatch per block) is the
-    production device plane."""
+    (TEMPO_TPU_DEVICE_SCAN=1): each synchronous mask pays a full device
+    round trip and compares in float32. The block-level `BlockScanPlane`
+    (one fused dispatch per block, exact int compares) is the production
+    device plane."""
     return os.environ.get("TEMPO_TPU_DEVICE_SCAN", "") == "1"
 
 
-def _dict_term(op: A.Op, v, dvals: list) :
+# ---------------------------------------------------------------------------
+# shared host-side predicate compilation
+# ---------------------------------------------------------------------------
+
+_STR_ORD = {A.Op.GT: lambda a, b: a > b, A.Op.GTE: lambda a, b: a >= b,
+            A.Op.LT: lambda a, b: a < b, A.Op.LTE: lambda a, b: a <= b}
+
+
+def _dict_term(op: A.Op, v, dvals: list):
     """Compile a string predicate over dictionary values into a (sig
     entry, lut) pair; None when the shape is unsupported. Regexes are
-    ANCHORED (fullmatch), matching `eval.regex_match_col` / pkg/regexp."""
-    if op not in _STR_OPS or not isinstance(v, str):
+    ANCHORED (fullmatch), matching `eval.regex_match_col` / pkg/regexp.
+    Ordered compares are lexicographic like the numpy plane's astype(str)
+    compare."""
+    if not isinstance(v, str):
         return None
     if op in (A.Op.EQ, A.Op.NEQ):
         matched = [i for i, s in enumerate(dvals) if s == v]
-    else:
+    elif op in _STR_ORD:
+        f = _STR_ORD[op]
+        matched = [i for i, s in enumerate(dvals) if f(s, v)]
+    elif op in (A.Op.REGEX, A.Op.NOT_REGEX):
         try:
             rx = re.compile(v)
         except re.error:
             return None
         matched = [i for i, s in enumerate(dvals) if rx.fullmatch(s)]
+    else:
+        return None
     lut = np.zeros(len(dvals), bool)
     if matched:
         lut[np.asarray(matched)] = True
@@ -91,6 +130,183 @@ def _num_term(op: A.Op, v):
         return None
     return ("cmp", op, False), f
 
+
+def _int_literal(op: A.Op, v) -> tuple:
+    """Normalize (op, literal) for the exact integer plane.
+
+    Returns ("const", bool) when the comparison is decidable on host
+    (non-integral EQ, out-of-range literals) or ("icmp", op', int_lit).
+    Non-integral range literals shift to the nearest integer bound:
+    `v > 1.5` over ints ⟺ `v >= 2`; `v < 1.5` ⟺ `v <= 1`.
+    """
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return ("const", False)
+    if f != f:                                   # NaN compares are false
+        return ("const", False)
+    if float(f).is_integer() and abs(f) < _INT_MAX:
+        return ("icmp", op, int(f))
+    if op == A.Op.EQ:
+        return ("const", False)
+    if op == A.Op.NEQ:
+        return ("const", True)
+    if abs(f) >= _INT_MAX:
+        big = f > 0
+        if op in (A.Op.GT, A.Op.GTE):
+            return ("const", not big)
+        return ("const", big)                    # LT / LTE
+    import math
+
+    if op in (A.Op.GT, A.Op.GTE):
+        return ("icmp", A.Op.GTE, int(math.ceil(f)))
+    return ("icmp", A.Op.LTE, int(math.floor(f)))
+
+
+def _split_i64(v: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """int64 → (hi, lo) int32 halves; lexicographic (hi, lo) order equals
+    the int64 order (hi is the arithmetic shift, lo is non-negative)."""
+    v = np.asarray(v, np.int64)
+    return (v >> 31).astype(np.int32), (v & 0x7FFFFFFF).astype(np.int32)
+
+
+def _split_lit(lit: int) -> tuple[int, int]:
+    return int(lit >> 31), int(lit & 0x7FFFFFFF)
+
+
+# ---------------------------------------------------------------------------
+# fused mask kernels
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=64)
+def _compiled_mask(sig: tuple, all_conditions: bool):
+    """One fused jitted kernel per predicate-plan shape: the whole
+    conjunction/disjunction is a single device dispatch per row group.
+    (float32 numeric path — the per-row-group opt-in plane only.)"""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(*args):
+        i = 0
+        mask = None
+        for kind, op, neg in sig:
+            if kind == "lut":
+                codes, lut = args[i], args[i + 1]
+                i += 2
+                m = jnp.take(lut, codes)
+                if neg:
+                    m = ~m
+            else:
+                col, lit = args[i], args[i + 1]
+                i += 2
+                if op == A.Op.EQ:
+                    m = col == lit
+                elif op == A.Op.NEQ:
+                    m = col != lit
+                elif op == A.Op.GT:
+                    m = col > lit
+                elif op == A.Op.GTE:
+                    m = col >= lit
+                elif op == A.Op.LT:
+                    m = col < lit
+                else:
+                    m = col <= lit
+            mask = m if mask is None else (mask & m if all_conditions
+                                           else mask | m)
+        return mask
+
+    return jax.jit(fn)
+
+
+def _icmp(jnp, op: A.Op, hi, lo, lh, ll):
+    """Exact int64 compare from (hi, lo) int32 halves."""
+    if op == A.Op.EQ:
+        return (hi == lh) & (lo == ll)
+    if op == A.Op.NEQ:
+        return (hi != lh) | (lo != ll)
+    if op == A.Op.GT:
+        return (hi > lh) | ((hi == lh) & (lo > ll))
+    if op == A.Op.GTE:
+        return (hi > lh) | ((hi == lh) & (lo >= ll))
+    if op == A.Op.LT:
+        return (hi < lh) | ((hi == lh) & (lo < ll))
+    return (hi < lh) | ((hi == lh) & (lo <= ll))
+
+
+def _term_masks(jnp, sig: tuple, args, n: int):
+    """Evaluate each term of a plan signature → list of bool vectors.
+
+    Term shapes (args consumed left to right):
+      ("lut", neg, has_ex)    codes, lut, [exists]
+      ("icmp", op, has_ex)    hi, lo, lh, ll, [exists]
+      ("nil", want, has_ex)   [exists]   (x = nil / x != nil)
+      ("const", val)          —
+    Missing attributes never match (exists ANDs after negation), matching
+    `Col.bool_mask` in the numpy plane.
+    """
+    out = []
+    i = 0
+    for term in sig:
+        kind = term[0]
+        if kind == "lut":
+            _, neg, has_ex = term
+            codes, lut = args[i], args[i + 1]
+            i += 2
+            m = jnp.take(lut, codes)
+            if neg:
+                m = ~m
+            if has_ex:
+                m = m & args[i]
+                i += 1
+        elif kind == "icmp":
+            _, op, has_ex = term
+            hi, lo, lh, ll = args[i], args[i + 1], args[i + 2], args[i + 3]
+            i += 4
+            m = _icmp(jnp, op, hi, lo, lh, ll)
+            if has_ex:
+                m = m & args[i]
+                i += 1
+        elif kind == "nil":
+            _, want, has_ex = term
+            if has_ex:
+                ex = args[i]
+                i += 1
+                m = ex if want else ~ex
+            else:
+                m = jnp.full((n,), bool(want))
+        else:                                    # ("const", val)
+            m = jnp.full((n,), bool(term[1]))
+        out.append(m)
+    return out, i
+
+
+@functools.lru_cache(maxsize=128)
+def _block_mask_kernel(n: int, pred_sig: tuple, extra_sig: tuple,
+                       all_conditions: bool):
+    """Fused block mask: predicate terms combine per all_conditions;
+    extra terms (time clip, row-group shard) always AND."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(*args):
+        pred_masks, used = _term_masks(jnp, pred_sig, args, n)
+        extra_masks, _ = _term_masks(jnp, extra_sig, args[used:], n)
+        mask = None
+        for m in pred_masks:
+            mask = m if mask is None else (mask & m if all_conditions
+                                           else mask | m)
+        if mask is None:
+            mask = jnp.ones((n,), bool)
+        for m in extra_masks:
+            mask = mask & m
+        return mask
+
+    return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# per-row-group opt-in plane (diagnostic; float32 numerics)
+# ---------------------------------------------------------------------------
 
 def _dict_codes(view, key: str, arrow_col):
     """(codes[int32], dict values) — cached on the view; the arrow column
@@ -145,45 +361,6 @@ def _col_for(view, attr: A.Attribute):
     return None
 
 
-@functools.lru_cache(maxsize=64)
-def _compiled_mask(sig: tuple, all_conditions: bool):
-    """One fused jitted kernel per predicate-plan shape: the whole
-    conjunction/disjunction is a single device dispatch per row group."""
-    import jax
-    import jax.numpy as jnp
-
-    def fn(*args):
-        i = 0
-        mask = None
-        for kind, op, neg in sig:
-            if kind == "lut":
-                codes, lut = args[i], args[i + 1]
-                i += 2
-                m = jnp.take(lut, codes)
-                if neg:
-                    m = ~m
-            else:
-                col, lit = args[i], args[i + 1]
-                i += 2
-                if op == A.Op.EQ:
-                    m = col == lit
-                elif op == A.Op.NEQ:
-                    m = col != lit
-                elif op == A.Op.GT:
-                    m = col > lit
-                elif op == A.Op.GTE:
-                    m = col >= lit
-                elif op == A.Op.LT:
-                    m = col < lit
-                else:
-                    m = col <= lit
-            mask = m if mask is None else (mask & m if all_conditions
-                                           else mask | m)
-        return mask
-
-    return jax.jit(fn)
-
-
 def _dev_array(view, key: str, values: np.ndarray, dtype):
     """Device-resident copy of a scan column, cached on the view so a
     multi-query/multi-pass scan transfers each column once."""
@@ -194,194 +371,6 @@ def _dev_array(view, key: str, values: np.ndarray, dtype):
     if arr is None:
         arr = cache[key] = jnp.asarray(np.asarray(values, dtype))
     return arr
-
-
-class BlockScanPlane:
-    """Device-resident scan cache for one block: dictionary-coded string
-    columns and float32 numeric intrinsics, concatenated across row groups
-    and uploaded ONCE. A query's pushdown conjunction then costs one fused
-    device dispatch for the whole block and one small boolean D2H — the
-    economics that make the device plane win even when the chip sits
-    behind a high-latency link (per-row-group sync offload does not).
-
-    Per-row-group dictionaries unify into one block dictionary on host
-    (O(distinct strings)); codes remap through a small lut before upload.
-    """
-
-    _DICT_KEYS = ("name", "service")
-
-    def __init__(self, views: Sequence) -> None:
-        import jax.numpy as jnp
-
-        self.n = int(sum(v.n for v in views))
-        self._dev: dict[str, object] = {}
-        self._dicts: dict[str, list[str]] = {}
-        self._qr_cache: dict = {}
-        self.time_base_ns = 0.0
-        for key, meta_key in (("name", "name_col"), ("service", "service_col")):
-            parts = []
-            block_ids: dict[str, int] = {}
-            ok = True
-            for v in views:
-                c = v.meta.get(meta_key)
-                if c is None:
-                    ok = False
-                    break
-                codes, dvals = _dict_codes(v, key, c)
-                # per-view dict ids -> block dict ids (nulls are already
-                # the "None" entry inside dvals, see _dict_codes)
-                lut = np.empty(len(dvals), np.int32)
-                for i, s in enumerate(dvals):
-                    lut[i] = block_ids.setdefault(s, len(block_ids))
-                parts.append(lut[codes] if len(dvals) else codes)
-            if ok and parts:
-                self._dev[f"dict:{key}"] = jnp.asarray(
-                    np.concatenate(parts))
-                self._dicts[key] = [s for s, _ in sorted(
-                    block_ids.items(), key=lambda kv: kv[1])]
-        for num_key in set(_NUM_INTRINSICS.values()):
-            cols = [v.col(num_key) for v in views]
-            if all(c is not None for c in cols):
-                self._dev[f"num:{num_key}"] = jnp.asarray(np.concatenate(
-                    [np.asarray(c.values, np.float32) for c in cols]))
-
-    def _plan(self, preds: Sequence, all_conditions: bool):
-        import jax.numpy as jnp
-
-        sig, args = [], []
-        for c in preds:
-            if not c.operands:
-                return None
-            v = c.operands[0].value
-            attr = c.attr
-            dkey = None
-            if attr.intrinsic == A.Intrinsic.NAME:
-                dkey = "name"
-            elif (attr.intrinsic == A.Intrinsic.NONE
-                    and attr.name == "service.name"
-                    and attr.scope in (A.Scope.RESOURCE, A.Scope.NONE)):
-                dkey = "service"
-            if dkey is not None:
-                codes = self._dev.get(f"dict:{dkey}")
-                if codes is None:
-                    return None
-                term = _dict_term(c.op, v, self._dicts[dkey])
-                if term is None:
-                    return None
-                sig.append(term[0])
-                args.extend((codes, jnp.asarray(term[1])))
-                continue
-            nkey = _NUM_INTRINSICS.get(attr.intrinsic)
-            col = self._dev.get(f"num:{nkey}") if nkey else None
-            if col is None:
-                return None
-            term = _num_term(c.op, v)
-            if term is None:
-                return None
-            sig.append(term[0])
-            args.extend((col, jnp.float32(term[1])))
-        return (tuple(sig), args) if sig else None
-
-    def load_times(self, views: Sequence) -> None:
-        """Attach rebased start times for the metrics plane: f32 seconds
-        relative to the block's min start (sub-ms resolution over any
-        realistic block span — step buckets are ≥1s). No-op (and the
-        metrics plane stays unavailable) when a view lacks times."""
-        import jax.numpy as jnp
-
-        cols = [v.col("__startTime") for v in views]
-        if not cols or any(c is None for c in cols):
-            return
-        starts = np.concatenate([np.asarray(c.values, np.float64)
-                                 for c in cols])
-        self.time_base_ns = float(starts.min()) if len(starts) else 0.0
-        self._dev["start_rel_s"] = jnp.asarray(
-            ((starts - self.time_base_ns) / 1e9).astype(np.float32))
-
-    def query_range_grid(self, preds: Sequence, all_conditions: bool,
-                         group: str | None, start_ns: int, end_ns: int,
-                         step_ns: int):
-        """The FULL device metrics path: predicate mask → step bucketing →
-        per-group scatter into a [groups, steps] count grid, one fused
-        dispatch over the resident block (`rate()`/`count_over_time()`
-        by name/service — SURVEY §3.4's hot loop with zero host work per
-        span). Returns (group label values, grid ndarray) or None when a
-        shape is unsupported."""
-        import jax
-        import jax.numpy as jnp
-
-        if "start_rel_s" not in self._dev:
-            return None
-        plan = self._plan(list(preds), all_conditions) if preds else ((), [])
-        if plan is None:
-            return None
-        sig, args = plan
-        if group is None:
-            codes = jnp.zeros(self.n, jnp.int32)
-            labels = [None]
-        else:
-            dev = self._dev.get(f"dict:{group}")
-            if dev is None:
-                return None
-            codes = dev
-            labels = self._dicts[group]
-        n_steps = max(int((end_ns - start_ns + step_ns - 1) // step_ns), 1)
-        rel = self._dev["start_rel_s"]
-        n_groups = len(labels)
-
-        # compiled per (plan shape, grid shape); time window and step ride
-        # in as traced scalars so a shifted query reuses the program
-        key = (sig, all_conditions, n_groups, n_steps)
-        fn = self._qr_cache.get(key)
-        if fn is None:
-            if len(self._qr_cache) >= 64:       # bounded like
-                self._qr_cache.pop(next(iter(self._qr_cache)))  # _compiled_mask
-
-            def build(codes, rel, q_steps, frac_s, step_s, win_s,
-                      *mask_args):
-                if sig:
-                    m = _compiled_mask(sig, all_conditions)(*mask_args)
-                else:
-                    m = jnp.ones(rel.shape, bool)
-                # step index split for precision: the whole-step offset
-                # between window start and block base is EXACT int host
-                # math; f32 only covers the sub-step fraction + intra-
-                # block offsets (small however far the window sits)
-                local = rel + frac_s
-                step_idx = q_steps + jnp.floor(local / step_s).astype(jnp.int32)
-                ok = (m & (step_idx >= 0) & (step_idx < n_steps)
-                      & (local < win_s))        # end_ns clip, like the
-                grid = jnp.zeros((n_groups, n_steps), jnp.float32)  # engine
-                return grid.at[
-                    jnp.where(ok, codes, n_groups),
-                    jnp.clip(step_idx, 0, n_steps - 1)
-                ].add(jnp.where(ok, 1.0, 0.0), mode="drop")
-            fn = self._qr_cache[key] = jax.jit(build)
-
-        delta_ns = int(self.time_base_ns) - start_ns
-        q_steps = delta_ns // step_ns            # exact whole steps (host)
-        frac_ns = delta_ns - q_steps * step_ns   # in [0, step_ns)
-        grid = fn(codes, rel,
-                  jnp.int32(q_steps), jnp.float32(frac_ns / 1e9),
-                  jnp.float32(step_ns / 1e9),
-                  jnp.float32((end_ns - int(self.time_base_ns) + frac_ns)
-                              / 1e9),
-                  *args)
-        return labels, np.asarray(grid)
-
-    def mask_async(self, preds: Sequence, all_conditions: bool):
-        """Launch the fused block mask; returns a device array (or None
-        when a predicate shape is unsupported). No sync, no D2H."""
-        plan = self._plan(preds, all_conditions)
-        if plan is None:
-            return None
-        sig, args = plan
-        return _compiled_mask(sig, all_conditions)(*args)
-
-    def mask(self, preds: Sequence, all_conditions: bool
-             ) -> Optional[np.ndarray]:
-        m = self.mask_async(preds, all_conditions)
-        return None if m is None else np.asarray(m)
 
 
 def device_pred_mask(view, preds: Sequence, all_conditions: bool
@@ -420,3 +409,568 @@ def device_pred_mask(view, preds: Sequence, all_conditions: bool
         return None
     fn = _compiled_mask(tuple(sig), all_conditions)
     return np.asarray(fn(*args))
+
+
+# ---------------------------------------------------------------------------
+# the production block plane
+# ---------------------------------------------------------------------------
+
+def _fmt_group_labels(values: np.ndarray, t: str) -> tuple[np.ndarray, list]:
+    """Factorize a host column into int32 codes + formatted label strings,
+    matching `engine_metrics._group_slots` label semantics exactly (object
+    arrays go through astype("U"): None → "None")."""
+    from tempo_tpu.traceql.engine_metrics import _fmt_label
+
+    if values.dtype == object:
+        values = values.astype("U")
+    u, inv = np.unique(values, return_inverse=True)
+    labels = [_fmt_label(v, t) for v in u]
+    return inv.astype(np.int32), labels
+
+
+class BlockScanPlane:
+    """Device-resident scan cache for one immutable block.
+
+    Columns adopt LAZILY: the first query touching a column pays one host
+    materialization (via the same `eval_expr` path the numpy engine uses,
+    so scoping/parent/intrinsic semantics are identical by construction)
+    plus one upload; every later query reuses the device copy. A query's
+    whole first pass then costs one fused dispatch for the whole block and
+    one small boolean D2H — the economics that make the device plane win
+    even when the chip sits behind a high-latency link.
+
+    Numeric columns ride the exact (hi, lo) int32 split when integral
+    (all intrinsics are); float-valued attribute columns are refused
+    (caller falls back to the float64 host plane) — the exactness story
+    demanded before this became the default path.
+    """
+
+    def __init__(self, views: Sequence) -> None:
+        self.views = list(views)
+        self.sizes = [int(v.n) for v in self.views]
+        self.offsets = np.concatenate(
+            [[0], np.cumsum(self.sizes)]).astype(np.int64)
+        self.n = int(self.offsets[-1])
+        self.time_base_ns = 0
+        self._cols: dict = {}          # (kind, key) → entry | None
+        self._qr_cache: dict = {}
+        self._lock = threading.RLock()
+        self.device_bytes = 0
+        self.host_bytes = 0            # adoption-side host copies (budget)
+
+    # -- adoption ----------------------------------------------------------
+
+    def _up(self, arr: np.ndarray):
+        import jax.numpy as jnp
+
+        d = jnp.asarray(arr)
+        self.device_bytes += int(arr.nbytes)
+        return d
+
+    def _host_col(self, attr: A.Attribute) -> Optional[Col]:
+        with self._lock:
+            key = ("host", attr)
+            if key in self._cols:
+                return self._cols[key]
+            cols = [eval_expr(v, attr) for v in self.views]
+            t = cols[0].t if cols else NUM
+            if not cols or any(c.t != t for c in cols):
+                ent = None
+            else:
+                ent = Col(t, np.concatenate([c.values for c in cols]),
+                          np.concatenate([c.exists for c in cols]))
+                self.host_bytes += int(ent.values.nbytes + ent.exists.nbytes)
+            self._cols[key] = ent
+            return ent
+
+    def _arrow_dict_fast(self, attr: A.Attribute):
+        """(codes[int32], labels) for name/service straight from the
+        on-disk arrow dictionary encoding — an index remap instead of the
+        generic object-array factorize (the hottest two columns)."""
+        if attr.intrinsic == A.Intrinsic.NAME:
+            meta_key, ckey = "name_col", "name"
+        elif (attr.intrinsic == A.Intrinsic.NONE
+                and attr.name == "service.name"
+                and attr.scope in (A.Scope.RESOURCE, A.Scope.NONE)):
+            meta_key, ckey = "service_col", "service"
+        else:
+            return None
+        parts = []
+        block_ids: dict = {}
+        for v in self.views:
+            c = v.meta.get(meta_key)
+            if c is None:
+                return None
+            codes, dvals = _dict_codes(v, ckey, c)
+            lut = np.empty(len(dvals), np.int32)
+            for i, s in enumerate(dvals):
+                lut[i] = block_ids.setdefault(s, len(block_ids))
+            parts.append(lut[codes] if len(dvals) else codes)
+        labels = [s for s, _ in sorted(block_ids.items(),
+                                       key=lambda kv: kv[1])]
+        cat = (np.concatenate(parts) if parts
+               else np.zeros(0, np.int32)).astype(np.int32)
+        return cat, labels
+
+    def _ensure_dict(self, attr: A.Attribute):
+        """("dict", codes_dev, labels, exists_dev|None) for a STR column."""
+        with self._lock:
+            key = ("dict", attr)
+            if key in self._cols:
+                return self._cols[key]
+            ent = None
+            fast = self._arrow_dict_fast(attr)
+            if fast is not None:
+                codes, labels = fast
+                ent = ("dict", self._up(codes), labels, None)
+            else:
+                c = self._host_col(attr)
+                if c is not None and c.t == STR:
+                    codes, labels = _fmt_group_labels(c.values, STR)
+                    ex = None if c.exists.all() else self._up(c.exists)
+                    ent = ("dict", self._up(codes), labels, ex)
+            self._cols[key] = ent
+            return ent
+
+    def _ensure_int(self, attr: A.Attribute):
+        """("int", hi, lo, exists|None, t) — exact integer column."""
+        with self._lock:
+            key = ("int", attr)
+            if key in self._cols:
+                return self._cols[key]
+            c = self._host_col(attr)
+            ent = None
+            if c is not None and c.t in (NUM, STATUS, KIND, BOOL):
+                vals = np.asarray(c.values)
+                if vals.dtype == bool:
+                    iv = vals.astype(np.int64)
+                elif vals.dtype == object:
+                    iv = None
+                else:
+                    v = vals.astype(np.float64)
+                    chk = v[c.exists]
+                    if (np.isfinite(chk).all()
+                            and (np.floor(chk) == chk).all()
+                            and (np.abs(chk) < _INT_MAX).all()):
+                        iv = np.where(c.exists, v, 0.0).astype(np.int64)
+                    else:
+                        iv = None
+                if iv is not None:
+                    hi, lo = _split_i64(iv)
+                    ex = None if c.exists.all() else self._up(c.exists)
+                    ent = ("int", self._up(hi), self._up(lo), ex, c.t)
+            self._cols[key] = ent
+            return ent
+
+    def _ensure_group(self, expr):
+        """("group", codes_dev, labels, exists_dev|None) for any by()-able
+        column type (STR dict, status/kind/num/bool factorized)."""
+        with self._lock:
+            key = ("group", expr)
+            if key in self._cols:
+                return self._cols[key]
+            ent = None
+            if isinstance(expr, A.Attribute):
+                fast = self._arrow_dict_fast(expr)
+                if fast is not None:
+                    codes, labels = fast
+                    ent = ("group", self._up(codes), labels, None)
+                else:
+                    c = self._host_col(expr)
+                    if c is not None and c.t in (STR, NUM, STATUS, KIND,
+                                                 BOOL):
+                        codes, labels = _fmt_group_labels(
+                            np.asarray(c.values), c.t)
+                        ex = None if c.exists.all() else self._up(c.exists)
+                        ent = ("group", self._up(codes), labels, ex)
+            self._cols[key] = ent
+            return ent
+
+    def _ensure_value(self, attr):
+        """("val", f32_dev, bucket_dev, exists|None): the measured column of
+        a metrics aggregate — f32 values (seconds for duration intrinsics,
+        mirroring the engine's ns→s divide) + precomputed log2 buckets
+        (exact: host float64 bucketing at adoption, ref `Log2Bucketize`
+        engine_metrics.go:1392)."""
+        from tempo_tpu.traceql.engine_metrics import (_is_duration_attr,
+                                                      log2_bucket_np)
+
+        with self._lock:
+            key = ("val", attr)
+            if key in self._cols:
+                return self._cols[key]
+            ent = None
+            c = self._host_col(attr) if isinstance(attr, A.Attribute) else None
+            if c is not None and c.t == NUM and c.values.dtype != object:
+                v = np.asarray(c.values, np.float64)
+                buckets = log2_bucket_np(np.where(c.exists, v, 1.0))
+                scaled = v / 1e9 if _is_duration_attr(attr) else v
+                ex = None if c.exists.all() else self._up(c.exists)
+                ent = ("val", self._up(scaled.astype(np.float32)),
+                       self._up(buckets.astype(np.int32)), ex)
+            self._cols[key] = ent
+            return ent
+
+    def _ensure_times(self) -> bool:
+        with self._lock:
+            if ("times",) in self._cols:
+                return self._cols[("times",)] is not None
+            cols = [v.col("__startTime") for v in self.views]
+            if not cols or any(c is None for c in cols):
+                self._cols[("times",)] = None
+                return False
+            starts = np.concatenate([np.asarray(c.values, np.float64)
+                                     for c in cols]).astype(np.int64)
+            self.time_base_ns = int(starts.min()) if len(starts) else 0
+            hi, lo = _split_i64(starts)
+            self._cols[("times",)] = (
+                self._up(((starts - self.time_base_ns) / 1e9
+                          ).astype(np.float32)),
+                self._up(hi), self._up(lo))
+            return True
+
+    def _ensure_rgids(self):
+        with self._lock:
+            if ("rgids",) in self._cols:
+                return self._cols[("rgids",)]
+            ids = np.repeat(np.arange(len(self.sizes), dtype=np.int32),
+                            self.sizes)
+            ent = self._cols[("rgids",)] = self._up(ids)
+            return ent
+
+    def load_times(self, views: Sequence = ()) -> None:
+        """Back-compat shim: time columns now adopt lazily."""
+        self._ensure_times()
+
+    # -- plan compilation ---------------------------------------------------
+
+    def _plan_pred(self, c) -> Optional[tuple]:
+        """One Condition → (sig entry, args list) or None (unsupported)."""
+        import jax.numpy as jnp
+
+        if not c.operands or not isinstance(c.attr, A.Attribute):
+            return None
+        static = c.operands[0]
+        v = static.value
+        # nil comparisons prune on the existence mask alone
+        if getattr(static, "type", None) == A.StaticType.NIL:
+            if c.op not in (A.Op.EQ, A.Op.NEQ):
+                return (("const", False), [])
+            host = self._host_col(c.attr)
+            if host is None:
+                return None
+            want = c.op == A.Op.NEQ
+            if host.exists.all():
+                return (("const", want), [])
+            with self._lock:
+                ex = self._cols.get(("ex", c.attr))
+                if ex is None:
+                    ex = self._cols[("ex", c.attr)] = self._up(host.exists)
+            return (("nil", want, True), [ex])
+        lit_t = _STATIC_T.get(getattr(static, "type", None))
+        if lit_t is None:
+            return None
+        if lit_t == STR:
+            ent = self._ensure_dict(c.attr)
+            if ent is None:
+                # a scalar non-STR column compared to a string is
+                # incomparable → constant false (the type lattice); list
+                # and mixed columns fall back to the host plane
+                host = self._host_col(c.attr)
+                if host is not None and host.t in (NUM, STATUS, KIND, BOOL):
+                    return (("const", False), [])
+                return None
+            term = _dict_term(c.op, v, ent[2])
+            if term is None:
+                return None
+            (kind, _, neg), lut = term
+            has_ex = ent[3] is not None
+            args = [ent[1], jnp.asarray(lut)]
+            if has_ex:
+                args.append(ent[3])
+            return (("lut", neg, has_ex), args)
+        # numeric-family literal
+        if c.op not in _NUM_OPS:
+            return None
+        ent = self._ensure_int(c.attr)
+        if ent is None:
+            host = self._host_col(c.attr)
+            if host is not None and host.t == STR:
+                return (("const", False), [])    # str col vs num literal
+            return None                          # float col → host fallback
+        _, hi, lo, ex, col_t = ent
+        if col_t != lit_t:                       # distinct lattices → false
+            return (("const", False), [])
+        norm = _int_literal(c.op, v if not isinstance(v, bool) else int(v))
+        if norm[0] == "const":
+            return (("const", norm[1]), [])
+        _, op2, lit = norm
+        lh, ll = _split_lit(lit)
+        has_ex = ex is not None
+        args = [hi, lo, jnp.int32(lh), jnp.int32(ll)]
+        if has_ex:
+            args.append(ex)
+        return (("icmp", op2, has_ex), args)
+
+    def _plan(self, preds: Sequence, all_conditions: bool):
+        sig, args = [], []
+        for c in preds:
+            got = self._plan_pred(c)
+            if got is None:
+                return None
+            sig.append(got[0])
+            args.extend(got[1])
+        return tuple(sig), args
+
+    def _extra_terms(self, time_range, row_groups):
+        """Always-AND terms: exact time clip + row-group shard selection."""
+        import jax.numpy as jnp
+
+        sig, args = [], []
+        if time_range is not None and any(time_range):
+            lo_ns, hi_ns = time_range
+            if not self._ensure_times():
+                return None
+            _, thi, tlo = self._cols[("times",)]
+            # the host plane compares float64 start values against the
+            # literal PROMOTED to float64; round the clip bounds the same
+            # way so boundary spans classify identically on both paths
+            if lo_ns:
+                lh, ll = _split_lit(int(np.float64(lo_ns)))
+                sig.append(("icmp", A.Op.GTE, False))
+                args.extend([thi, tlo, jnp.int32(lh), jnp.int32(ll)])
+            if hi_ns:
+                lh, ll = _split_lit(int(np.float64(hi_ns)))
+                sig.append(("icmp", A.Op.LT, False))
+                args.extend([thi, tlo, jnp.int32(lh), jnp.int32(ll)])
+        if row_groups is not None:
+            lut = np.zeros(len(self.sizes), bool)
+            sel = [g for g in row_groups if 0 <= g < len(self.sizes)]
+            if sel:
+                lut[np.asarray(sel)] = True
+            sig.append(("lut", None, False))
+            args.extend([self._ensure_rgids(), jnp.asarray(lut)])
+        return tuple(sig), args
+
+    # -- masks --------------------------------------------------------------
+
+    def mask_async(self, preds: Sequence, all_conditions: bool,
+                   time_range=None, row_groups=None):
+        """Launch the fused block mask; returns a device array (or None
+        when a predicate shape is unsupported). No sync, no D2H."""
+        plan = self._plan(list(preds), all_conditions)
+        if plan is None:
+            return None
+        extra = self._extra_terms(time_range, row_groups)
+        if extra is None:
+            return None
+        sig, args = plan
+        esig, eargs = extra
+        fn = _block_mask_kernel(self.n, sig, esig, all_conditions)
+        return fn(*args, *eargs)
+
+    def mask(self, preds: Sequence, all_conditions: bool,
+             time_range=None, row_groups=None) -> Optional[np.ndarray]:
+        m = self.mask_async(preds, all_conditions, time_range, row_groups)
+        return None if m is None else np.asarray(m)
+
+    def split_mask(self, mask: np.ndarray) -> list[np.ndarray]:
+        """Block-level mask → per-row-group candidate row arrays."""
+        return [np.flatnonzero(mask[self.offsets[i]:self.offsets[i + 1]])
+                for i in range(len(self.sizes))]
+
+    # -- fused metrics grid -------------------------------------------------
+
+    def metrics_grid(self, m, preds: Sequence, all_conditions: bool,
+                     start_ns: int, end_ns: int, step_ns: int,
+                     clip_start_ns: int | None = None,
+                     clip_end_ns: int | None = None,
+                     row_groups=None, max_groups: int = 65536):
+        """The FULL device metrics path: predicate mask → exact time clip →
+        step bucketing → per-group scatter into device grids, one fused
+        dispatch over the resident block (SURVEY §3.4's hot loop with zero
+        host work per span). Covers every `*_over_time` kind including the
+        log2-bucket histogram axis behind `quantile_over_time` /
+        `histogram_over_time` (ref `Log2Bucketize` engine_metrics.go:1392).
+
+        `m` is the A.MetricsAggregate. Returns None when any shape is
+        unsupported (caller falls back to the host engine), else
+        (group_label_list, main_grid, obs_count_grid, value_count_grid):
+          count/rate       main [G, steps] counts
+          min/max/sum/avg  main [G, steps]
+          quantile/hist    main [G, steps, 64] bucket counts
+        obs counts gate series emission (group matched the filter);
+        value counts back avg's companion `__meta: count` series.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        kind_tag = {
+            A.MetricsKind.RATE: "count",
+            A.MetricsKind.COUNT_OVER_TIME: "count",
+            A.MetricsKind.MIN_OVER_TIME: "min",
+            A.MetricsKind.MAX_OVER_TIME: "max",
+            A.MetricsKind.SUM_OVER_TIME: "sum",
+            A.MetricsKind.AVG_OVER_TIME: "avg",
+            A.MetricsKind.QUANTILE_OVER_TIME: "hist",
+            A.MetricsKind.HISTOGRAM_OVER_TIME: "hist",
+        }.get(m.kind)
+        if kind_tag is None or step_ns <= 0 or end_ns <= start_ns:
+            return None
+        if len(m.by) > 1:
+            return None
+        if not self._ensure_times():
+            return None
+
+        plan = self._plan(list(preds), all_conditions)
+        if plan is None:
+            return None
+        clip_lo = max(start_ns, clip_start_ns or start_ns)
+        clip_hi = min(end_ns, clip_end_ns or end_ns)
+        extra = self._extra_terms((clip_lo, clip_hi), row_groups)
+        if extra is None:
+            return None
+        sig, args = plan
+        esig, eargs = extra
+
+        if m.by:
+            gent = self._ensure_group(m.by[0])
+            if gent is None or len(gent[2]) > max_groups:
+                return None
+            _, gcodes, glabels, gex = gent
+        else:
+            gcodes, glabels, gex = None, [None], None
+
+        needs_value = kind_tag in ("min", "max", "sum", "avg", "hist")
+        vargs = []
+        if needs_value:
+            if m.attr is None:
+                return None
+            vent = self._ensure_value(m.attr)
+            if vent is None:
+                return None
+            _, vvals, vbuckets, vex = vent
+            vargs = [vbuckets if kind_tag == "hist" else vvals]
+            if vex is not None:
+                vargs.append(vex)
+            v_has_ex = vex is not None
+        else:
+            v_has_ex = False
+
+        n_steps = max(int(-(-(end_ns - start_ns) // step_ns)), 1)
+        n_groups = len(glabels)
+        if n_groups * n_steps * (64 if kind_tag == "hist" else 1) * 4 \
+                > 1 << 28:
+            return None
+        delta_ns = self.time_base_ns - start_ns
+        q_steps = delta_ns // step_ns              # exact whole steps (host)
+        frac_ns = delta_ns - q_steps * step_ns     # in [0, step_ns)
+        if abs(q_steps) > 1 << 30:
+            return None
+
+        key = (sig, esig, all_conditions, kind_tag, n_groups, n_steps,
+               gcodes is not None, gex is not None, v_has_ex)
+        fn = self._qr_cache.get(key)
+        if fn is None:
+            if len(self._qr_cache) >= 64:
+                self._qr_cache.pop(next(iter(self._qr_cache)))
+            n = self.n
+
+            def build(rel, q_steps, frac_s, step_s, gcodes, gex, vcol, vex,
+                      *margs):
+                pred_masks, used = _term_masks(jnp, sig, margs, n)
+                extra_masks, _ = _term_masks(jnp, esig, margs[used:], n)
+                mask = None
+                for pm in pred_masks:
+                    mask = pm if mask is None else (
+                        mask & pm if all_conditions else mask | pm)
+                if mask is None:
+                    mask = jnp.ones((n,), bool)
+                for em in extra_masks:
+                    mask = mask & em
+                # step index split for precision: the whole-step offset
+                # between window start and block base is EXACT int host
+                # math; f32 only covers the sub-step fraction + intra-
+                # block offsets (small however far the window sits). The
+                # end/start clips are exact int compares in extra_masks.
+                local = rel + frac_s
+                step_idx = q_steps + jnp.floor(local / step_s
+                                               ).astype(jnp.int32)
+                ok = mask & (step_idx >= 0) & (step_idx < n_steps)
+                if gcodes is not None:
+                    slots = gcodes
+                    if gex is not None:
+                        ok = ok & gex
+                else:
+                    slots = jnp.zeros((n,), jnp.int32)
+                steps = jnp.clip(step_idx, 0, n_steps - 1)
+                # obs counts IGNORE the value-exists mask: the host engine
+                # registers a group's series when any span matches the
+                # filter, even if the measured attribute is missing on all
+                # of them (zero/inf series) — emission must agree
+                obs_slots = jnp.where(ok, slots, n_groups)
+                cnt = jnp.zeros((n_groups, n_steps), jnp.float32
+                                ).at[obs_slots, steps].add(
+                    jnp.where(ok, 1.0, 0.0), mode="drop")
+                if kind_tag == "count":
+                    return cnt, cnt, cnt
+                okv = ok & vex if vex is not None else ok
+                slots = jnp.where(okv, slots, n_groups)
+                ones = jnp.where(okv, 1.0, 0.0)
+                if kind_tag == "hist":
+                    grid = jnp.zeros((n_groups, n_steps, 64), jnp.float32)
+                    grid = grid.at[slots, steps, vcol].add(ones, mode="drop")
+                    return grid, cnt, cnt
+                vals = vcol
+                if kind_tag == "min":
+                    grid = jnp.full((n_groups, n_steps), jnp.inf,
+                                    jnp.float32)
+                    grid = grid.at[slots, steps].min(
+                        jnp.where(okv, vals, jnp.inf), mode="drop")
+                    return grid, cnt, cnt
+                if kind_tag == "max":
+                    grid = jnp.full((n_groups, n_steps), -jnp.inf,
+                                    jnp.float32)
+                    grid = grid.at[slots, steps].max(
+                        jnp.where(okv, vals, -jnp.inf), mode="drop")
+                    return grid, cnt, cnt
+                grid = jnp.zeros((n_groups, n_steps), jnp.float32
+                                 ).at[slots, steps].add(
+                    jnp.where(okv, vals, 0.0), mode="drop")
+                if kind_tag == "avg":
+                    # avg's companion count series counts VALUED spans only
+                    vcnt = jnp.zeros((n_groups, n_steps), jnp.float32
+                                     ).at[slots, steps].add(ones,
+                                                            mode="drop")
+                    return grid, cnt, vcnt
+                return grid, cnt, cnt
+
+            fn = self._qr_cache[key] = jax.jit(build)
+
+        main, cnt, vcnt = fn(self._cols[("times",)][0],
+                             jnp.int32(q_steps), jnp.float32(frac_ns / 1e9),
+                             jnp.float32(step_ns / 1e9),
+                             gcodes, gex, vargs[0] if vargs else None,
+                             vargs[1] if len(vargs) > 1 else None,
+                             *args, *eargs)
+        return glabels, np.asarray(main), np.asarray(cnt), np.asarray(vcnt)
+
+    # -- back-compat wrapper (bench/tests from round 3) ---------------------
+
+    def query_range_grid(self, preds: Sequence, all_conditions: bool,
+                         group: str | None, start_ns: int, end_ns: int,
+                         step_ns: int):
+        """rate/count grid keyed by the legacy "name"/"service" group
+        names; returns (labels, grid ndarray) or None."""
+        by = ()
+        if group == "name":
+            by = (A.Attribute.intrinsic_of(A.Intrinsic.NAME),)
+        elif group == "service":
+            by = (A.Attribute("service.name", A.Scope.RESOURCE),)
+        m = A.MetricsAggregate(kind=A.MetricsKind.COUNT_OVER_TIME, by=by)
+        got = self.metrics_grid(m, preds, all_conditions, start_ns, end_ns,
+                                step_ns)
+        if got is None:
+            return None
+        labels, main = got[0], got[1]
+        return labels, main

@@ -374,15 +374,21 @@ def _install_attr_hook(view: ColumnView) -> None:
 # fetch
 # ---------------------------------------------------------------------------
 
+def prefilter_is_noop(req: FetchSpansRequest) -> bool:
+    """True when the storage prefilter must pass every row through:
+    no predicates, or OR-semantics with a non-pushable sub-expression
+    (negation / cross-attribute compare) — any span might match."""
+    preds = [c for c in req.conditions if c.op is not None]
+    fetch_only = any(c.op is None and c.from_filter for c in req.conditions)
+    return not preds or (not req.all_conditions
+                         and (fetch_only or req.has_unconditioned_arm))
+
+
 def condition_mask(view: ColumnView, req: FetchSpansRequest) -> np.ndarray:
     """Storage-level first pass: vectorized mask from pushdown conditions."""
     n = view.n
     preds = [c for c in req.conditions if c.op is not None]
-    fetch_only = any(c.op is None and c.from_filter for c in req.conditions)
-    if not preds or (not req.all_conditions
-                     and (fetch_only or req.has_unconditioned_arm)):
-        # OR-semantics with a non-pushable sub-expression (e.g. a negation or
-        # cross-attribute compare): any span might match — no prefilter
+    if prefilter_is_noop(req):
         mask = np.ones(n, bool)
     else:
         from tempo_tpu.block.device_scan import device_pred_mask

@@ -36,6 +36,12 @@ class TempoDBConfig:
     pool_workers: int = 30
     dedicated_columns: tuple = ()
     row_group_rows: int = 50_000
+    # device read plane (block/device_scan.py): per-block resident column
+    # cache + fused first pass; LRU under a device-byte budget
+    device_plane: bool = True
+    plane_budget_bytes: int = 1 << 30
+    plane_max_blocks: int = 64
+    plane_host_budget_bytes: int = 4 << 30
 
 
 class TempoDB:
@@ -53,6 +59,16 @@ class TempoDB:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._block_cache: dict[tuple[str, str], BackendBlock] = {}
+        self.planes = None
+        if self.cfg.device_plane:
+            from tempo_tpu.db.plane_cache import PlaneCache
+
+            self.planes = PlaneCache(self.cfg.plane_budget_bytes,
+                                     self.cfg.plane_max_blocks,
+                                     self.cfg.plane_host_budget_bytes)
+        # read-plane routing counters: how many block scans took the fused
+        # device path vs the host engine (tests + /metrics)
+        self.plane_stats = {"fused_metric_blocks": 0, "host_metric_blocks": 0}
 
     # -- writer ------------------------------------------------------------
 
@@ -85,6 +101,20 @@ class TempoDB:
         for key in [k for k in self._block_cache
                     if k[0] == tenant and k[1] not in live]:
             del self._block_cache[key]
+        if self.planes is not None:
+            self.planes.drop_dead(tenant, live)
+
+    def _scan_source(self, meta: bm.BlockMeta, req,
+                     row_groups: Sequence[int] | None = None):
+        """(view, candidate_rows) stream for one block: the plane cache's
+        fused device first pass when enabled, else a direct parquet scan."""
+        from tempo_tpu.block.fetch import scan_views
+
+        if self.planes is not None:
+            return self.planes.get(self.backend_block(meta)).scan(
+                req, row_groups)
+        return scan_views(self.backend_block(meta), req,
+                          row_groups=row_groups)
 
     def blocks(self, tenant: str, start_s: float | None = None,
                end_s: float | None = None,
@@ -127,8 +157,8 @@ class TempoDB:
                row_groups: Sequence[int] | None = None):
         """TraceQL search over backend blocks (`tempodb.Search/Fetch`
         `tempodb.go:368,481`): compile once, stream row-group views from
-        every candidate block through the engine."""
-        from tempo_tpu.block.fetch import scan_views
+        every candidate block through the engine. The first pass rides the
+        device plane cache when enabled (one fused dispatch per block)."""
         from tempo_tpu.traceql.engine import compile_query, execute_search
 
         q, req = compile_query(query,
@@ -136,8 +166,7 @@ class TempoDB:
         if metas is None:
             metas = self.blocks(tenant, start_s, end_s)
         views = (v for m in metas
-                 for v in scan_views(self.backend_block(m), req,
-                                     row_groups=row_groups))
+                 for v in self._scan_source(m, req, row_groups))
         return execute_search(q, views, limit=limit,
                               start_ns=int((start_s or 0) * 1e9),
                               end_ns=int((end_s or 0) * 1e9))
@@ -150,21 +179,110 @@ class TempoDB:
         """TraceQL metrics over backend blocks: the raw MetricsEvaluator
         path (`engine_metrics.go:802`); returns job-level TimeSeries for a
         frontend combiner (or final series when used standalone). The clip
-        bounds restrict observation without changing the step grid."""
-        from tempo_tpu.block.fetch import scan_views
+        bounds restrict observation without changing the step grid.
+
+        Blocks whose query shape the device plane supports run the WHOLE
+        aggregation — mask, clip, step bucketing, group-by, metric scatter,
+        including the log2 histogram axis behind quantile_over_time — as
+        one fused dispatch per resident block; unsupported blocks/shapes
+        fall back to the host engine, and both merge through the job-level
+        series combiner (sums/min/max — the same tensor-add combine the
+        frontend applies across jobs)."""
+        from tempo_tpu.traceql import ast as A
         from tempo_tpu.traceql.engine import compile_query
-        from tempo_tpu.traceql.engine_metrics import MetricsEvaluator
+        from tempo_tpu.traceql.engine_metrics import (MetricsEvaluator,
+                                                      SeriesCombiner,
+                                                      grid_series)
 
         _, freq = compile_query(req.query, req.start_ns, req.end_ns)
         if metas is None:
             metas = self.blocks(tenant, req.start_ns / 1e9, req.end_ns / 1e9)
         ev = MetricsEvaluator(req, clip_start_ns, clip_end_ns)
+        # the fused path is exact only when the pushdown IS the filter:
+        # single pure-AND filter pipeline (all_conditions, the optimize()
+        # precondition of engine_metrics.go:885) and no compare() stage
+        fusable = (self.planes is not None
+                   and ev.fetch_req.all_conditions
+                   and all(isinstance(s, A.SpansetFilter) for s in ev.q.stages)
+                   and ev.m.kind != A.MetricsKind.COMPARE)
+        preds = [c for c in ev.fetch_req.conditions if c.op is not None]
+        device_parts: list = []
+        fused_blocks: list = []
         for m in metas:
-            for view, cand in scan_views(self.backend_block(m), freq,
-                                         row_groups=row_groups):
-                if len(cand):
-                    ev.observe(view)
-        return ev.results()
+            got = cb = None
+            if fusable:
+                cb = self.planes.get(self.backend_block(m))
+                got = cb.plane.metrics_grid(
+                    ev.m, preds, True, req.start_ns, req.end_ns, req.step_ns,
+                    clip_start_ns, clip_end_ns, row_groups)
+            if got is not None:
+                self.plane_stats["fused_metric_blocks"] += 1
+                labels, main, cnt, vcnt = got
+                device_parts.append(grid_series(ev.m, labels, main, cnt,
+                                                vcnt))
+                fused_blocks.append(cb)
+            else:
+                self.plane_stats["host_metric_blocks"] += 1
+                for view, cand in self._scan_source(m, freq, row_groups):
+                    if len(cand):
+                        ev.observe(view)
+        if not device_parts:
+            return ev.results()
+        comb = SeriesCombiner(ev.m.kind, req.n_steps)
+        comb.add_all(ev.results())
+        for part in device_parts:
+            comb.add_all(part)
+        out = list(comb.series.values())
+        self._fused_exemplars(out, ev, fused_blocks, req)
+        return out
+
+    def _fused_exemplars(self, series, ev, fused_blocks, req) -> None:
+        """Best-effort exemplars for the fused path (the grid kernel keeps
+        no row identities): sample a few matching rows from the first
+        cached view and attach trace-id exemplars to their group's series,
+        like `MetricsEvaluator._note_exemplars`."""
+        import numpy as np
+
+        from tempo_tpu.block.fetch import condition_mask
+        from tempo_tpu.traceql.engine_metrics import _fmt_label
+        from tempo_tpu.traceql.eval import eval_expr
+
+        if req.exemplars <= 0 or not fused_blocks:
+            return
+        cb = fused_blocks[0]
+        if not cb.views:
+            return
+        view = cb.views[0]
+        tid = view.col("trace:id")
+        st = view.col("__startTime")
+        if tid is None or st is None:
+            return
+        rows = np.flatnonzero(condition_mask(view, ev.fetch_req))[:8]
+        if len(rows) == 0:
+            return
+        gcol = eval_expr(view, ev.m.by[0]) if ev.m.by else None
+        gname = str(ev.m.by[0]) if ev.m.by else None
+        dur = view.col("duration")
+        by_group: dict = {}
+        for s in series:
+            d = dict(s.labels)
+            key = d.get(gname) if gname is not None else ""
+            by_group.setdefault(key, s)
+        for r in rows:
+            if gcol is not None:
+                if not gcol.exists[r]:
+                    continue
+                key = _fmt_label(gcol.values[r], gcol.t)
+            else:
+                key = ""
+            target = by_group.get(key)
+            if target is None or len(target.exemplars) >= 2:
+                continue
+            target.exemplars.append({
+                "traceId": str(tid.values[r]),
+                "value": float(dur.values[r]) if dur is not None else 0.0,
+                "timestampMs": int(st.values[r] / 1e6),
+            })
 
     # -- polling -----------------------------------------------------------
 

@@ -450,6 +450,41 @@ class MetricsEvaluator:
         return out
 
 
+def grid_series(m: A.MetricsAggregate, labels: list, main: np.ndarray,
+                cnt: np.ndarray, vcnt: np.ndarray) -> list[TimeSeries]:
+    """Device metrics grids → job-level TimeSeries, with the exact emission
+    semantics of `MetricsEvaluator.results()`: a series exists iff its
+    group matched the filter at least once (obs cnt row nonzero — even
+    when the measured attribute was missing on every matching span, like
+    the host registry); histogram kinds emit one series per nonzero log2
+    bucket; avg emits the companion `__meta: count` series counting VALUED
+    spans (vcnt). Labels ride pre-formatted from the plane's factorization
+    (same `_fmt_label` path)."""
+    group_name = str(m.by[0]) if m.by else None
+    k = m.kind
+    hist = k in (A.MetricsKind.QUANTILE_OVER_TIME,
+                 A.MetricsKind.HISTOGRAM_OVER_TIME)
+    out: list[TimeSeries] = []
+    for gi, lbl in enumerate(labels):
+        if not cnt[gi].any():
+            continue
+        key = ((group_name, lbl),) if group_name is not None else ()
+        if hist:
+            for b in range(HBUCKETS):
+                col = main[gi, :, b]
+                if col.any():
+                    out.append(TimeSeries(
+                        key + ((_LABEL_BUCKET, 2.0 ** b / 1e9),),
+                        col.astype(np.float64)))
+        elif k == A.MetricsKind.AVG_OVER_TIME:
+            out.append(TimeSeries(key, main[gi].astype(np.float64)))
+            out.append(TimeSeries(key + (("__meta", "count"),),
+                                  vcnt[gi].astype(np.float64)))
+        else:
+            out.append(TimeSeries(key, main[gi].astype(np.float64)))
+    return out
+
+
 def _is_duration_attr(attr) -> bool:
     return isinstance(attr, A.Attribute) and attr.intrinsic in (
         A.Intrinsic.DURATION, A.Intrinsic.TRACE_DURATION)
