@@ -263,33 +263,54 @@ def bench_query() -> dict:
             }]
 
     with tempfile.TemporaryDirectory() as tmp_dir:
+        from tempo_tpu.db.tempodb import TempoDBConfig
+
         db = TempoDB(LocalBackend(tmp_dir), LocalBackend(tmp_dir))
         db.write_block("bench", traces(), replication_factor=1)
         db.poll_now()
+        # host-engine reference instance over the SAME written block: the
+        # product speedup (device plane default-on vs off) measured at the
+        # product entry points, not a plane micro-bench
+        db_host = TempoDB(LocalBackend(tmp_dir), LocalBackend(tmp_dir),
+                          TempoDBConfig(device_plane=False))
+        db_host.poll_now()
         req = QueryRangeRequest(
             query="{ } | rate() by (resource.service.name)",
             start_ns=t_base, end_ns=t_base + int(900 * 1e9),
             step_ns=int(60 * 1e9))
+        qreq = QueryRangeRequest(
+            query="{ } | quantile_over_time(duration, .99)"
+                  " by (resource.service.name)",
+            start_ns=t_base, end_ns=t_base + int(900 * 1e9),
+            step_ns=int(60 * 1e9))
 
-        def qr() -> None:
-            db.query_range("bench", req)
+        def timed(fn, iters=3) -> float:
+            fn()                # warmup (compiles, page cache, adoption)
+            t0 = time.time()
+            for _ in range(iters):
+                fn()
+            return (time.time() - t0) / iters * 1000
 
-        def search() -> None:
-            db.search("bench", '{ span.http.status_code >= 400 }', limit=20,
-                      start_s=t_base / 1e9, end_s=now_s)
-
-        qr(); search()          # warmup (compiles, page cache)
-        t0 = time.time()
-        for _ in range(3):
-            qr()
-        qr_ms = (time.time() - t0) / 3 * 1000
-        t0 = time.time()
-        for _ in range(3):
-            search()
-        s_ms = (time.time() - t0) / 3 * 1000
+        qr_ms = timed(lambda: db.query_range("bench", req))
+        qq_ms = timed(lambda: db.query_range("bench", qreq))
+        s_ms = timed(lambda: db.search(
+            "bench", '{ span.http.status_code >= 400 }', limit=20,
+            start_s=t_base / 1e9, end_s=now_s))
+        qr_host_ms = timed(lambda: db_host.query_range("bench", req))
+        qq_host_ms = timed(lambda: db_host.query_range("bench", qreq))
+        s_host_ms = timed(lambda: db_host.search(
+            "bench", '{ span.http.status_code >= 400 }', limit=20,
+            start_s=t_base / 1e9, end_s=now_s))
+        fused = dict(db.plane_stats)
         scan = _bench_scan_plane(db)
         db.shutdown()
-    return {"query_range_ms": qr_ms, "search_ms": s_ms, **scan}
+        db_host.shutdown()
+    return {"query_range_ms": qr_ms, "search_ms": s_ms,
+            "qr_quantile_ms": qq_ms,
+            "query_range_host_ms": qr_host_ms, "search_host_ms": s_host_ms,
+            "qr_quantile_host_ms": qq_host_ms,
+            "fused_metric_blocks": fused.get("fused_metric_blocks", 0),
+            **scan}
 
 
 def _bench_scan_plane(db) -> dict:
@@ -479,6 +500,16 @@ def main() -> int:
         if "query_range_ms" in results else None,
         "search_100k_spans_ms": round(results["search_ms"], 1)
         if "search_ms" in results else None,
+        "qr_quantile_100k_ms": round(results["qr_quantile_ms"], 1)
+        if "qr_quantile_ms" in results else None,
+        # same queries with the device plane disabled (host engine)
+        "query_range_host_ms": round(results["query_range_host_ms"], 1)
+        if "query_range_host_ms" in results else None,
+        "search_host_ms": round(results["search_host_ms"], 1)
+        if "search_host_ms" in results else None,
+        "qr_quantile_host_ms": round(results["qr_quantile_host_ms"], 1)
+        if "qr_quantile_host_ms" in results else None,
+        "fused_metric_blocks": results.get("fused_metric_blocks"),
         "scan_device_ms": round(results["scan_device_ms"], 1)
         if "scan_device_ms" in results else None,
         "scan_numpy_ms": round(results["scan_numpy_ms"], 1)

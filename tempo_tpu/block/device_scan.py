@@ -870,10 +870,9 @@ class BlockScanPlane:
 
         key = (sig, esig, all_conditions, kind_tag, n_groups, n_steps,
                gcodes is not None, gex is not None, v_has_ex)
-        fn = self._qr_cache.get(key)
+        with self._lock:
+            fn = self._qr_cache.get(key)
         if fn is None:
-            if len(self._qr_cache) >= 64:
-                self._qr_cache.pop(next(iter(self._qr_cache)))
             n = self.n
 
             def build(rel, q_steps, frac_s, step_s, gcodes, gex, vcol, vex,
@@ -945,7 +944,11 @@ class BlockScanPlane:
                     return grid, cnt, vcnt
                 return grid, cnt, cnt
 
-            fn = self._qr_cache[key] = jax.jit(build)
+            fn = jax.jit(build)
+            with self._lock:
+                if len(self._qr_cache) >= 64:
+                    self._qr_cache.pop(next(iter(self._qr_cache)))
+                fn = self._qr_cache.setdefault(key, fn)
 
         main, cnt, vcnt = fn(self._cols[("times",)][0],
                              jnp.int32(q_steps), jnp.float32(frac_ns / 1e9),

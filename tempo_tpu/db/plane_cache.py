@@ -123,6 +123,10 @@ class PlaneCache:
             if entry is not None:
                 self._entries.move_to_end(key)
                 self.hits += 1
+                # lazy adoption grows footprints AFTER insertion; re-check
+                # the budgets on hits too, or a stable hit-only working
+                # set would never trigger eviction
+                self._evict_locked()
                 return entry
         # build outside the lock (full-block read); a racing duplicate
         # build is wasted work, not a correctness problem — last one wins

@@ -249,6 +249,9 @@ class TempoDB:
 
         if req.exemplars <= 0 or not fused_blocks:
             return
+        budget = req.exemplars - sum(len(s.exemplars) for s in series)
+        if budget <= 0:
+            return
         cb = fused_blocks[0]
         if not cb.views:
             return
@@ -257,7 +260,12 @@ class TempoDB:
         st = view.col("__startTime")
         if tid is None or st is None:
             return
-        rows = np.flatnonzero(condition_mask(view, ev.fetch_req))[:8]
+        # sample only rows inside the step window AND the observation clip,
+        # like the host path (observe() filters before _note_exemplars)
+        mask = condition_mask(view, ev.fetch_req)
+        ts = st.values
+        mask = mask & (ts >= ev.clip_start_ns) & (ts < ev.clip_end_ns)
+        rows = np.flatnonzero(mask)[:min(8, budget)]
         if len(rows) == 0:
             return
         gcol = eval_expr(view, ev.m.by[0]) if ev.m.by else None

@@ -79,7 +79,9 @@ class QueryRangeRequest:
 
     @property
     def n_steps(self) -> int:
-        return max(int(math.ceil((self.end_ns - self.start_ns) / self.step_ns)), 1)
+        # exact integer ceiling: float64 division can round the quotient
+        # and disagree with the device grid's integer math on huge windows
+        return max(-(-(self.end_ns - self.start_ns) // self.step_ns), 1)
 
     def step_timestamps_ms(self) -> list[int]:
         # samples are stamped at interval END, like IntervalOfMs consumers

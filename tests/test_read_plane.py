@@ -162,6 +162,20 @@ def test_search_product_parity(dbs):
         assert ids(a) == ids(b), q
 
 
+def test_search_time_window_parity(dbs):
+    """Windowed search: device and host prefilters must clip identically
+    (both clip on span start from the same FetchSpansRequest bounds —
+    regression guard for the suspected start-vs-overlap divergence)."""
+    dev, host = dbs
+    for lo, hi in ((T0 + 50, T0 + 150), (T0, T0 + 10), (T0 + 390, T0 + 500)):
+        for q in ('{ duration > 50ms }', '{ name = "op-1" }'):
+            a = sorted(m.trace_id for m in dev.search(
+                "t", q, limit=1000, start_s=lo, end_s=hi))
+            b = sorted(m.trace_id for m in host.search(
+                "t", q, limit=1000, start_s=lo, end_s=hi))
+            assert a == b, (q, lo, hi)
+
+
 def test_search_uses_device_first_pass(dbs):
     dev, _ = dbs
     meta = dev.blocklist.metas("t")[0]
