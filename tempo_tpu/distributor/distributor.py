@@ -15,7 +15,8 @@ from typing import Callable, Protocol, Sequence
 import numpy as np
 
 from tempo_tpu.distributor.limiter import RateLimiter, effective_rate
-from tempo_tpu.native import token_for  # native fnv batch; numpy fallback
+from tempo_tpu.native import group_keys  # native hash group; numpy fallback
+from tempo_tpu.native import token_for   # native fnv batch; numpy fallback
 from tempo_tpu.overrides import Overrides
 from tempo_tpu.ring import InstanceDesc, Ring, do_batch
 from tempo_tpu.utils.livetraces import _approx_size
@@ -184,20 +185,21 @@ class Distributor:
         self.dataquality.observe_start_ns(tenant, recs["start_ns"])
 
         # usage attribution by service: parse each UNIQUE Resource once
-        res_pairs = np.stack([recs["res_off"].astype(np.int64),
-                              recs["res_len"].astype(np.int64)], axis=1)
-        uniq_res, inv_res = np.unique(res_pairs, axis=0, return_inverse=True)
-        services = [_resource_service(raw, int(o), int(ln))
-                    for o, ln in uniq_res]
+        # (the wire offset alone identifies a Resource message)
+        uniq_off, first_r, inv_res = np.unique(
+            recs["res_off"].astype(np.int64), return_index=True,
+            return_inverse=True)
+        services = [_resource_service(raw, int(o), int(recs["res_len"][i]))
+                    for o, i in zip(uniq_off, first_r)]
         if self.usage.cfg.dimensions == ("service",):
             # even split of the wire size, matching observe(size_bytes=..)
             # so path choice cannot shift a tenant's attributed bytes
-            counts = np.bincount(inv_res, minlength=len(uniq_res))
+            counts = np.bincount(inv_res, minlength=len(uniq_off))
             per_span = sz / max(n, 1)
             self.usage.observe_grouped(tenant, [
                 ((services[i],), int(counts[i]),
                  float(counts[i]) * per_span)
-                for i in range(len(uniq_res)) if counts[i]])
+                for i in range(len(uniq_off)) if counts[i]])
 
         # validation: vectorized trace-id check (pkg/validation)
         errs: dict[str, int] = {}
@@ -209,27 +211,32 @@ class Distributor:
         if not valid.any():
             return errs
 
-        # regroup by trace: unique over (padded 16-byte id, wire length) —
-        # the length disambiguates a short id from the 16-byte id that
-        # shares its zero-padded form (the dict path keys on exact bytes)
+        # regroup by trace: one native hash pass over (padded 16-byte id ‖
+        # wire length) — the length disambiguates a short id from the
+        # 16-byte id that shares its zero-padded form (the dict path keys
+        # on exact bytes). `requestsByTraceID` distributor.go:694 without
+        # the O(n log n) sort numpy's void unique would pay.
         tids = np.ascontiguousarray(recs["trace_id"])
         vrows = np.flatnonzero(valid)
         keys = np.concatenate(
             [tids[vrows], recs["tid_len"][vrows, None].astype(np.uint8)],
             axis=1)
-        void = np.ascontiguousarray(keys).view([("v", "V17")]).ravel()
-        uniq_tid, first, inverse = np.unique(void, return_index=True,
-                                             return_inverse=True)
+        first, inverse = group_keys(keys)
         uniq_mat = tids[vrows[first]]
         uniq_len = recs["tid_len"][vrows[first]]
         tokens = token_for(tenant, uniq_mat)
-        n_traces = len(uniq_tid)
+        n_traces = len(first)
 
         from tempo_tpu.model.otlp import slice_otlp_payload
 
         def payload_for(items: list[int]) -> bytes:
-            sel = np.isin(inverse, np.asarray(items, np.int64))
-            wis = vrows[sel]
+            if len(items) == n_traces and len(vrows) == len(recs):
+                # full coverage AND nothing failed validation — only then
+                # is the raw payload the correct slice
+                return raw
+            pick = np.zeros(n_traces, bool)
+            pick[np.asarray(items, np.int64)] = True
+            wis = vrows[pick[inverse]]       # O(n) gather, no isin sort
             if len(wis) == len(recs):
                 return raw
             return slice_otlp_payload(raw, recs, wis.tolist())
@@ -241,11 +248,16 @@ class Distributor:
         item_reason: dict[int, str] = {}
         # keyed by (padded hex, wire length): replicas reply with exact
         # wire bytes, scan records pad — normalize without merging ids
-        # that differ only in trailing-zero padding
-        tid_to_item = {(uniq_mat[i].tobytes().hex(), int(uniq_len[i])): i
-                       for i in range(n_traces)}
+        # that differ only in trailing-zero padding. Built LAZILY: the
+        # happy path (no per-trace errors) never pays the n_traces
+        # tobytes+hex loop that showed up in the tee-path profile.
+        tid_to_item: dict = {}
 
         def _item_of(tid_hex: str) -> "int | None":
+            if not tid_to_item:
+                tid_to_item.update(
+                    {(uniq_mat[i].tobytes().hex(), int(uniq_len[i])): i
+                     for i in range(n_traces)})
             return tid_to_item.get((tid_hex.ljust(32, "0"),
                                     len(tid_hex) // 2))
 

@@ -151,7 +151,7 @@ def _build() -> str | None:
     tmp = f"{so}.{os.getpid()}.tmp"  # pid-unique: concurrent builds race
     try:
         subprocess.run(
-            ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+            ["g++", "-O3", "-march=native", "-pthread", "-shared", "-fPIC",
              "-o", tmp, _SRC],
             check=True, capture_output=True, timeout=120)
         os.replace(tmp, so)
@@ -194,8 +194,13 @@ def _load():
             lib.fnv1_tokens.restype = None
             lib.crc32c.argtypes = [c.c_char_p, c.c_int64]
             lib.crc32c.restype = c.c_uint32
+            lib.group_keys.argtypes = [u8p, c.c_int64, c.c_int32, i32p, i32p]
+            lib.group_keys.restype = c.c_int64
             lib.otlp_scan.argtypes = [u8p, c.c_int64, c.c_void_p, c.c_int64]
             lib.otlp_scan.restype = c.c_int64
+            lib.otlp_scan_mt.argtypes = [
+                u8p, c.c_int64, c.c_void_p, c.c_int64, c.c_int32]
+            lib.otlp_scan_mt.restype = c.c_int64
             lib.otlp_scan2.argtypes = [
                 u8p, c.c_int64, c.c_void_p, c.c_int64,
                 c.c_void_p, c.c_int64, i64p]
@@ -278,11 +283,48 @@ def token_for(tenant: str, trace_ids: np.ndarray) -> np.ndarray:
 
 # -- OTLP scan ---------------------------------------------------------------
 
+def group_keys(keys: np.ndarray) -> "tuple[np.ndarray, np.ndarray] | None":
+    """Group [n, k] uint8 fixed-width keys in first-occurrence order.
+
+    Returns (first_idx[int32, n_uniq], inverse[int32, n]) — the O(n) hash
+    replacement for `np.unique` over void views (which argsorts). Falls
+    back to numpy when the native layer is unavailable.
+    """
+    keys = np.ascontiguousarray(keys, np.uint8)
+    n, k = keys.shape
+    lib = _load()
+    if lib is None:
+        void = keys.view([("v", f"V{k}")]).ravel()
+        _, first, inverse = np.unique(void, return_index=True,
+                                      return_inverse=True)
+        # relabel np.unique's sorted order to first-occurrence order so
+        # fallback hosts group identically to the native path
+        order = np.argsort(first, kind="stable")
+        remap = np.empty(len(order), np.int64)
+        remap[order] = np.arange(len(order))
+        return (first[order].astype(np.int32),
+                remap[inverse].astype(np.int32))
+    inverse = np.empty(n, np.int32)
+    first = np.empty(max(n, 1), np.int32)
+    got = lib.group_keys(
+        keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n, k,
+        inverse.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        first.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return first[:got], inverse
+
+
+_SCAN_THREADS = min(8, os.cpu_count() or 1)
+_SCAN_MT_BYTES = 256 << 10        # payloads below this stay single-thread
+
+
 def otlp_scan(data: bytes, cap_hint: int = 4096) -> np.ndarray | None:
     """Single-pass OTLP proto scan → SpanRec structured array.
 
-    Returns None when the native library is unavailable (callers fall back
-    to the python decoder). Raises ValueError on malformed input.
+    Large payloads fan ResourceSpans ranges across threads (the GIL is
+    released inside the ctypes call); output order matches the sequential
+    scan exactly. Returns None when the native library is unavailable
+    (callers fall back to the python decoder). Raises ValueError on
+    malformed input.
     """
     lib = _load()
     if lib is None:
@@ -290,9 +332,14 @@ def otlp_scan(data: bytes, cap_hint: int = 4096) -> np.ndarray | None:
     buf = np.frombuffer(data, np.uint8)
     bp = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
     cap = max(cap_hint, 16)
+    mt = len(data) >= _SCAN_MT_BYTES and _SCAN_THREADS > 1
     while True:
         recs = np.zeros(cap, SPAN_REC_DTYPE)
-        n = lib.otlp_scan(bp, len(data), recs.ctypes.data, cap)
+        if mt:
+            n = lib.otlp_scan_mt(bp, len(data), recs.ctypes.data, cap,
+                                 _SCAN_THREADS)
+        else:
+            n = lib.otlp_scan(bp, len(data), recs.ctypes.data, cap)
         if n < 0:
             raise ValueError("malformed OTLP protobuf payload")
         if n <= cap:

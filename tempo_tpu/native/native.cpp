@@ -14,11 +14,14 @@
 // contract matches the python decoder exactly (id lengths preserved,
 // malformed input rejected, field order independent).
 
+#include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <cstddef>
 #include <cstdlib>
 #include <mutex>
+#include <thread>
 #include <vector>
 
 extern "C" {
@@ -279,6 +282,118 @@ int64_t otlp_scan(const uint8_t* buf, int64_t buflen,
     return otlp_scan2(buf, buflen, out, cap, nullptr, 0, &n_attrs);
 }
 
+}  // extern "C"
+
+// --- parallel scan -----------------------------------------------------------
+//
+// The distributor's scan is the serial floor of the tee path (SURVEY §3.1
+// hot loop ①). ResourceSpans are independent, so: one cheap sequential
+// pass walks ONLY message headers to count spans per ResourceSpans (span
+// bodies are skipped by length), then a prefix sum fixes each range's
+// output base and worker threads deep-scan their ranges into disjoint
+// slices. Output order is identical to the sequential scan.
+
+namespace {
+
+struct RsRange {
+    const uint8_t* start; uint64_t len;
+    const uint8_t* res_off; uint64_t res_len;
+    int64_t out_base; int64_t span_count;
+};
+
+// Count spans in one ResourceSpans by walking headers only.
+static int64_t count_spans_rs(const uint8_t* start, uint64_t len) {
+    Cursor rs{start, start + len, true};
+    uint32_t f2, w2; uint64_t v2, l2; const uint8_t* s2;
+    int64_t n = 0;
+    while (read_field(rs, f2, w2, v2, s2, l2)) {
+        if (f2 != 2 || w2 != 2) continue;          // ScopeSpans
+        Cursor ss{s2, s2 + l2, true};
+        uint32_t f3, w3; uint64_t v3, l3; const uint8_t* s3;
+        while (read_field(ss, f3, w3, v3, s3, l3)) {
+            if (f3 == 2 && w3 == 2) n++;
+        }
+        if (!ss.ok) return -1;
+    }
+    return rs.ok ? n : -1;
+}
+
+// Deep-scan one ResourceSpans into out[r.out_base...].
+static bool scan_rs_range(const uint8_t* buf, const RsRange& r,
+                          SpanRec* out) {
+    Cursor rs{r.start, r.start + r.len, true};
+    uint32_t f2, w2; uint64_t v2, l2; const uint8_t* s2;
+    int64_t k = r.out_base;
+    int64_t attr_count = 0;
+    while (read_field(rs, f2, w2, v2, s2, l2)) {
+        if (f2 != 2 || w2 != 2) continue;
+        Cursor ss{s2, s2 + l2, true};
+        uint32_t f3, w3; uint64_t v3, l3; const uint8_t* s3;
+        while (read_field(ss, f3, w3, v3, s3, l3)) {
+            if (f3 != 2 || w3 != 2) continue;
+            if (!scan_span(buf, s3, l3, r.res_off, r.res_len, k, out[k],
+                           nullptr, 0, attr_count))
+                return false;
+            k++;
+        }
+        if (!ss.ok) return false;
+    }
+    return rs.ok;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parallel variant of otlp_scan (no attribute extraction). Returns the
+// total span count (caller re-calls with a bigger buffer when > cap) or
+// -1 on malformed input. Falls back to single-threaded scanning when the
+// payload has too few ResourceSpans to split.
+int64_t otlp_scan_mt(const uint8_t* buf, int64_t buflen,
+                     SpanRec* out, int64_t cap, int32_t n_threads) {
+    std::vector<RsRange> ranges;
+    Cursor top{buf, buf + buflen, true};
+    uint32_t fnum, wt; uint64_t val, len; const uint8_t* start;
+    int64_t total = 0;
+    while (read_field(top, fnum, wt, val, start, len)) {
+        if (fnum != 1 || wt != 2) continue;
+        RsRange r{start, len, nullptr, 0, 0, 0};
+        Cursor rs1{start, start + len, true};
+        uint32_t f2, w2; uint64_t v2, l2; const uint8_t* s2;
+        while (read_field(rs1, f2, w2, v2, s2, l2)) {
+            if (f2 == 1 && w2 == 2) { r.res_off = s2; r.res_len = l2; }
+        }
+        if (!rs1.ok) return -1;
+        r.span_count = count_spans_rs(start, len);
+        if (r.span_count < 0) return -1;
+        r.out_base = total;
+        total += r.span_count;
+        ranges.push_back(r);
+    }
+    if (!top.ok) return -1;
+    if (total > cap) return total;                 // caller regrows
+    if (n_threads < 2 || ranges.size() < 2 || total < 4096) {
+        for (const RsRange& r : ranges)
+            if (!scan_rs_range(buf, r, out)) return -1;
+        return total;
+    }
+    int nt = (int)std::min<size_t>(n_threads, ranges.size());
+    std::atomic<bool> bad{false};
+    std::vector<std::thread> threads;
+    threads.reserve(nt);
+    for (int t = 0; t < nt; t++) {
+        threads.emplace_back([&, t]() {
+            for (size_t i = t; i < ranges.size(); i += nt) {
+                if (bad.load(std::memory_order_relaxed)) return;
+                if (!scan_rs_range(buf, ranges[i], out))
+                    bad.store(true, std::memory_order_relaxed);
+            }
+        });
+    }
+    for (auto& th : threads) th.join();
+    return bad.load() ? -1 : total;
+}
+
 // --- span events / links ----------------------------------------------------
 // Separate pass extracting Span.events (field 11) and Span.links (field 13)
 // keyed by span index (same traversal order as otlp_scan2), so the common
@@ -453,9 +568,49 @@ struct Interner {
     }
 };
 
+// --- fixed-width key grouping ----------------------------------------------
+//
+// Group n fixed-width byte keys (e.g. the distributor's padded trace id +
+// length byte, `requestsByTraceID` distributor.go:694) into first-occurrence
+// order: inverse[i] = dense group id, first_idx[g] = row of g's first
+// occurrence. One O(n) hash pass replaces numpy's void-view unique (an
+// O(n log n) memcmp argsort that dominated the tee-path profile).
+
 }  // namespace
 
 extern "C" {
+
+int64_t group_keys(const uint8_t* keys, int64_t n, int32_t key_len,
+                   int32_t* inverse, int32_t* first_idx) {
+    if (n <= 0) return 0;
+    uint64_t cap = 64;
+    while (cap < (uint64_t)n * 2) cap <<= 1;
+    std::vector<int32_t> table(cap, -1);   // slot -> group id
+    uint64_t mask = cap - 1;
+    int64_t n_groups = 0;
+    for (int64_t r = 0; r < n; r++) {
+        const uint8_t* k = keys + r * key_len;
+        uint64_t h = fnv1a64(k, key_len);
+        uint64_t i = h & mask;
+        while (true) {
+            int32_t g = table[i];
+            if (g == -1) {
+                table[i] = (int32_t)n_groups;
+                first_idx[n_groups] = (int32_t)r;
+                inverse[r] = (int32_t)n_groups;
+                n_groups++;
+                break;
+            }
+            if (memcmp(keys + (int64_t)first_idx[g] * key_len, k,
+                       key_len) == 0) {
+                inverse[r] = g;
+                break;
+            }
+            i = (i + 1) & mask;
+        }
+    }
+    return n_groups;
+}
 
 void* interner_new() { return new Interner(); }
 void interner_free(void* h) { delete (Interner*)h; }

@@ -280,7 +280,17 @@ class Ring:
             return [], np.zeros(0, np.int64)
         pos = np.searchsorted(st.tokens, tokens, side="left") \
             % len(st.tokens)
-        uniq, inverse = np.unique(pos, return_inverse=True)
+        if len(tokens) * 4 >= len(st.tokens):
+            # large batch: O(ring tokens) bincount beats the sort
+            hit = np.bincount(pos, minlength=len(st.tokens)) > 0
+            uniq = np.flatnonzero(hit)
+            remap = np.zeros(len(st.tokens), np.int64)
+            remap[uniq] = np.arange(len(uniq))
+            inverse = remap[pos]
+        else:
+            # small batch on a big ring: sorting the handful of positions
+            # is cheaper than touching every ring token
+            uniq, inverse = np.unique(pos, return_inverse=True)
         return [self._set_at(st, int(p), rf) for p in uniq], inverse
 
     def owns(self, member_id: str, key: str | int) -> bool:
@@ -403,18 +413,29 @@ def do_batch(ring: Ring, tokens: np.ndarray, indexes: Sequence[Any],
             by_instance.setdefault(inst.id, (inst, []))[1].append(ui)
 
     # group item positions by unique ring position once (argsort), instead
-    # of one O(n) scan per unique position per replica
-    order = np.argsort(inverse, kind="stable")
-    counts = np.bincount(inverse, minlength=len(sets))
-    bounds = np.zeros(len(sets) + 1, np.int64)
-    np.cumsum(counts, out=bounds[1:])
+    # of one O(n) scan per unique position per replica — computed lazily:
+    # an instance covering every position takes the whole batch directly
+    order = bounds = None
+
+    def _regroup():
+        nonlocal order, bounds
+        if order is None:
+            order = np.argsort(inverse, kind="stable")
+            counts = np.bincount(inverse, minlength=len(sets))
+            bounds = np.zeros(len(sets) + 1, np.int64)
+            np.cumsum(counts, out=bounds[1:])
 
     failures = np.zeros(len(sets), np.int64)
     errs: list[Exception] = []
     for iid, (inst, uis) in by_instance.items():
-        flat = [indexes[j]
-                for ui in uis
-                for j in order[bounds[ui]:bounds[ui + 1]].tolist()]
+        if len(uis) == len(sets):
+            # item order is not part of the send contract
+            flat = list(indexes)
+        else:
+            _regroup()
+            flat = [indexes[j]
+                    for ui in uis
+                    for j in order[bounds[ui]:bounds[ui + 1]].tolist()]
         try:
             send(inst, flat)
         except Exception as e:  # instance failed: charge every item it held

@@ -129,3 +129,46 @@ def test_large_int_attr_exact():
     data = pw.enc_field_msg(1, pw.enc_field_msg(2, pw.enc_field_msg(2, span)))
     nat = native.spans_from_otlp_proto_native(data)
     assert nat[0]["attrs"]["n"] == big  # exact, no double round-trip
+
+
+def test_group_keys_matches_numpy_grouping():
+    """Native hash grouping must partition identically to np.unique over
+    void views (group ids may differ — first-occurrence vs sorted order —
+    but the induced partition and first-row sets must match)."""
+    from tempo_tpu import native
+
+    rng = np.random.default_rng(5)
+    keys = rng.integers(0, 4, size=(2000, 17)).astype(np.uint8)
+    first, inverse = native.group_keys(keys)
+    void = np.ascontiguousarray(keys).view([("v", "V17")]).ravel()
+    _, f2, inv2 = np.unique(void, return_index=True, return_inverse=True)
+    assert len(first) == len(f2)
+    # bijection between label spaces
+    fwd: dict = {}
+    for a, b in zip(inverse.tolist(), inv2.tolist()):
+        assert fwd.setdefault(a, b) == b
+    # each group's first row really is its earliest occurrence
+    for g, fi in enumerate(first.tolist()):
+        rows = np.flatnonzero(inverse == g)
+        assert rows[0] == fi
+
+
+def test_otlp_scan_mt_matches_sequential(monkeypatch):
+    """The threaded scan must produce byte-identical records in the same
+    order as the sequential scan, and reject malformed payloads."""
+    from tempo_tpu import native
+
+    if not native.available():
+        pytest.skip("native layer unavailable")
+    import bench as B
+
+    payload = B._make_otlp_payload(8192, n_services=13)
+    monkeypatch.setattr(native, "_SCAN_MT_BYTES", 1)      # force MT
+    mt = native.otlp_scan(payload)
+    monkeypatch.setattr(native, "_SCAN_MT_BYTES", 1 << 60)  # force seq
+    seq = native.otlp_scan(payload)
+    assert len(mt) == len(seq) == 8192
+    assert (mt == seq).all()
+    monkeypatch.setattr(native, "_SCAN_MT_BYTES", 1)
+    with pytest.raises(ValueError):
+        native.otlp_scan(payload[:-3])

@@ -336,6 +336,10 @@ def test_columnar_push_matches_dict_path(rig):
     for ing in ingesters.values():
         spans = ing.find_trace_by_id("t1", bytes([1]) * 16)
         assert {s["span_id"] for s in spans} == {bytes([1]) * 8, b"\xaa" * 8}
+    # the invalid-id span was DISCARDED, not replicated (regression: the
+    # full-coverage raw-payload fast path must not bypass validation)
+    for ing in ingesters.values():
+        assert not ing.find_trace_by_id("t1", b"")
     # usage attribution by service matches the dict path's labels
     snap = dist.usage.prometheus_text()
     assert 'service="cs-0"' in snap and 'service="cs-1"' in snap
