@@ -94,6 +94,10 @@ class Handler(BaseHTTPRequestHandler):
         tenant = self._tenant()
         if not tenant:
             return self._err(401, "no org id")
+        if "|" in tenant and not path.startswith("/kv/"):
+            # `a|b` org ids are read-side federation only; writes must name
+            # ONE tenant (the reference rejects multi-tenant pushes)
+            return self._err(400, "multi-tenant org id not allowed on writes")
         try:
             if path == "/v1/traces":
                 return self._push(tenant)
@@ -316,6 +320,10 @@ class Handler(BaseHTTPRequestHandler):
                 return self._reply(200, _json_bytes({"limits": cur}))
             if path.startswith("/internal/"):
                 return self._internal_get(tenant, path, q)
+        except ValueError as e:
+            # client errors: bad TraceQL, unsupported multi-tenant shape
+            # (frontend.UnsupportedMultiTenant), malformed params → 400
+            return self._err(400, str(e))
         except Exception as e:
             return self._err(500, str(e))
         self._err(404, f"unknown path {path}")

@@ -391,6 +391,7 @@ class App:
             self.db, self.querier, cfg=self.cfg.frontend,
             overrides=self.overrides,
             generator_query_range=gen_qr,
+            cache_provider=getattr(self, "cache_provider", None),
             now=self.now)
 
     def _join_ring(self, key: str, instance_id: str) -> None:

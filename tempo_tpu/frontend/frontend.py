@@ -91,11 +91,29 @@ class _Job:
         self.event.set()
 
 
+class UnsupportedMultiTenant(ValueError):
+    """Client error: the endpoint does not support `a|b` org ids
+    (→ HTTP 400, like the reference's unsupported middleware)."""
+
+
+def split_tenants(tenant: str) -> list[str]:
+    """`X-Scope-OrgID: a|b` → ["a", "b"] (order-preserving, deduped) —
+    the multi-tenant federation split (`modules/frontend/frontend.go:
+    113-136` multiTenantMiddleware / pkg tenant.ValidTenantID)."""
+    seen: list[str] = []
+    for t in tenant.split("|"):
+        t = t.strip()
+        if t and t not in seen:
+            seen.append(t)
+    return seen or [tenant]
+
+
 class Frontend:
     def __init__(self, db: TempoDB, querier: Querier,
                  cfg: FrontendConfig | None = None,
                  overrides: Overrides | None = None,
                  generator_query_range: Callable[..., list[TimeSeries]] | None = None,
+                 cache_provider=None,
                  now: Callable[[], float] = time.time) -> None:
         self.db = db
         self.querier = querier
@@ -109,6 +127,28 @@ class Frontend:
         self._remote_lock = threading.Lock()
         self._remote_workers = 0  # connected gRPC worker-pull streams
         self._stop = threading.Event()
+        # search-response cache: sub-request results keyed by (block id,
+        # query, shard) — blocks are immutable so no invalidation exists
+        # (`modules/frontend/frontend.go:101` newFrontendCache +
+        # `cache_keys.go` searchJobCacheKey)
+        self._job_cache = None
+        if cache_provider is not None:
+            from tempo_tpu.backend.cache import ROLE_FRONTEND_SEARCH
+
+            self._job_cache = cache_provider.cache_for(ROLE_FRONTEND_SEARCH)
+
+    @property
+    def cache_stats(self) -> dict:
+        """Hit/miss counters straight from the role cache (it counts under
+        its own lock; duplicating here would race worker threads)."""
+        c = self._job_cache
+        return {"hits": getattr(c, "hits", 0),
+                "misses": getattr(c, "misses", 0)}
+
+    def cache_hit_ratio(self) -> float:
+        s = self.cache_stats
+        total = s["hits"] + s["misses"]
+        return s["hits"] / total if total else 0.0
 
     @property
     def remote_workers(self) -> int:
@@ -145,29 +185,77 @@ class Frontend:
     def _run_jobs(self, tenant: str, jobs: Sequence[SearchJob],
                   fn: Callable[[SearchJob], Any],
                   on_result: Callable[[Any], bool],
-                  spec_fn: Callable[[SearchJob], dict] | None = None) -> int:
+                  spec_fn: Callable[[SearchJob], dict] | None = None,
+                  cache: "tuple | None" = None) -> int:
         """Dispatch jobs; fold results via on_result (return False = early
         exit, like streaming combiners cancelling remaining work). Raises
         the first job error — a failed sub-query fails the whole query, as
         partial silent results are worse than an error. Keeps at most
         `concurrent_jobs` in flight so wide queries never trip the
-        per-tenant outstanding cap. Returns bytes processed (SLO)."""
-        wrapped = [_Job(j, fn, spec_fn(j) if spec_fn else None) for j in jobs]
+        per-tenant outstanding cap. Returns bytes processed (SLO).
+
+        `cache` = (key_fn, encode, decode): the search-response cache ware
+        (`frontend.go:101`). Hits are consulted BEFORE dispatch and writes
+        happen at fold time, so cached sub-requests are skipped no matter
+        who would have executed them — inline, local worker, or remote
+        worker stream. key_fn returning None marks a job uncacheable."""
+        key_fn = encode = decode = None
+        if cache is not None and self._job_cache is not None:
+            key_fn, encode, decode = cache
+
+        hits: dict[int, Any] = {}
+        pending: list[tuple[int, "_Job"]] = []
+        wrapped: list = []
+        for idx, j in enumerate(jobs):
+            key = key_fn(j) if key_fn else None
+            raw = self._job_cache.get(key) if key is not None else None
+            if raw is not None:
+                hits[idx] = decode(raw)
+                wrapped.append(None)
+            else:
+                wj = _Job(j, fn, spec_fn(j) if spec_fn else None)
+                wrapped.append(wj)
+                pending.append((idx, wj))
+
         nbytes = 0
+
+        def fold(idx, job, result) -> bool:
+            nonlocal nbytes
+            if key_fn and idx not in hits:
+                key = key_fn(job)
+                if key is not None:
+                    try:
+                        self._job_cache.put(key, encode(result))
+                    except Exception:
+                        pass           # cache write is best-effort
+            nbytes += _job_bytes(job)
+            return on_result(result)
+
         if not self._workers and not self.remote_workers:
-            for wj in wrapped:          # inline single-binary path
+            for idx, j in enumerate(jobs):    # inline single-binary path
+                if idx in hits:
+                    if not fold(idx, j, hits[idx]):
+                        break
+                    continue
+                wj = wrapped[idx]
                 wj.run()
                 if wj.error is not None:
                     raise wj.error
-                nbytes += _job_bytes(wj.job)
-                if not on_result(wj.result):
+                if not fold(idx, j, wj.result):
                     break
             return nbytes
         window = max(1, min(self.cfg.concurrent_jobs,
                             self.cfg.max_outstanding_per_tenant - 1))
-        for wj in wrapped[:window]:
+        for _, wj in pending[:window]:
             self.queue.enqueue(tenant, wj)
-        for i, wj in enumerate(wrapped):
+        qi = window                 # next pending job to enqueue
+        pi = 0                      # next pending job to await
+        for idx, j in enumerate(jobs):
+            if idx in hits:
+                if not fold(idx, j, hits[idx]):
+                    break
+                continue
+            wj = wrapped[idx]
             while not wj.event.wait(timeout=0.5):
                 if self._stop.is_set():
                     raise RuntimeError("frontend shutting down")
@@ -176,12 +264,13 @@ class Frontend:
                     # every worker disconnected with this job still queued:
                     # run it inline rather than hanging the query forever
                     wj.run_claimed()
-            if i + window < len(wrapped):
-                self.queue.enqueue(tenant, wrapped[i + window])
+            pi += 1
+            if qi < len(pending):
+                self.queue.enqueue(tenant, pending[qi][1])
+                qi += 1
             if wj.error is not None:
                 raise wj.error
-            nbytes += _job_bytes(wj.job)
-            if not on_result(wj.result):
+            if not fold(idx, j, wj.result):
                 break
         return nbytes
 
@@ -196,8 +285,22 @@ class Frontend:
         emit diff responses (`combiner/search.go`)."""
         from tempo_tpu.utils import tracing
         with tracing.span_for_tenant("frontend.Search", tenant, query=query):
-            return self._search(tenant, query, limit=limit, start_s=start_s,
-                                end_s=end_s, on_partial=on_partial)
+            tenants = split_tenants(tenant)
+            if len(tenants) == 1:
+                # normalized: 'a|a', 'a|', ' a ' all mean tenant 'a'
+                return self._search(tenants[0], query, limit=limit,
+                                    start_s=start_s, end_s=end_s,
+                                    on_partial=on_partial)
+            # multi-tenant federation: fan out per tenant, merge through
+            # the same top-N combiner (frontend.go:113-136)
+            comb = MetadataCombiner(limit)
+            for t in tenants:
+                for md in self._search(t, query, limit=limit,
+                                       start_s=start_s, end_s=end_s):
+                    comb.add(md)
+                if on_partial is not None:
+                    on_partial(comb.results())
+            return comb.results()
 
     def _search(self, tenant: str, query: str, *, limit: int = 20,
                 start_s: float | None = None, end_s: float | None = None,
@@ -228,6 +331,17 @@ class Frontend:
                     on_partial(combiner.results())
                 return not combiner.exhausted()
 
+            def search_key(j) -> str:
+                # times join the key only when the window cuts INTO the
+                # block; a fully-covered block's results are window-free
+                # (`cache_keys.go` searchJobCacheKey semantics)
+                m = j.meta
+                tpart = ("" if j.start_s <= m.start_time
+                         and j.end_s >= m.end_time
+                         else f":{j.start_s}:{j.end_s}")
+                return (f"sj:{tenant}:{m.block_id}:{_qhash(query)}:"
+                        f"{','.join(map(str, j.row_groups))}:{limit}{tpart}")
+
             nbytes += self._run_jobs(
                 tenant, jobs,
                 lambda j: self.querier.search_block(
@@ -238,7 +352,8 @@ class Frontend:
                     "kind": "search_block", "tenant": tenant,
                     "query": query, "meta": j.meta.to_json(),
                     "row_groups": list(j.row_groups), "limit": limit,
-                    "start_s": j.start_s, "end_s": j.end_s})
+                    "start_s": j.start_s, "end_s": j.end_s},
+                cache=(search_key, _encode_metadata, _decode_metadata))
         self.slos.record("search", tenant, self.now() - t0, nbytes)
         return combiner.results()
 
@@ -246,9 +361,13 @@ class Frontend:
                    start_s: float | None = None, end_s: float | None = None
                    ) -> list[dict] | None:
         t0 = self.now()
-        spans = self.querier.find_trace_by_id(tenant, trace_id, start_s, end_s)
+        spans: list[dict] = []
+        for t in split_tenants(tenant):
+            got = self.querier.find_trace_by_id(t, trace_id, start_s, end_s)
+            if got:
+                spans.extend(got)
         self.slos.record("traces", tenant, self.now() - t0,
-                         len(spans or []) * 200)
+                         len(spans) * 200)
         return sort_spans(combine_spans(spans)) if spans else None
 
     def query_range(self, tenant: str, query: str, *,
@@ -259,9 +378,15 @@ class Frontend:
         SeriesCombiner then final quantile/rate pass
         (`metrics_query_range_sharder.go` + `combiner/metrics_query_range.go`)."""
         from tempo_tpu.utils import tracing
-        with tracing.span_for_tenant("frontend.QueryRange", tenant,
+        tenants = split_tenants(tenant)
+        if len(tenants) > 1:
+            # the reference mounts newMultiTenantUnsupportedMiddleware on
+            # the metrics endpoints (frontend.go:163-175 analog)
+            raise UnsupportedMultiTenant(
+                "multi-tenant query of the metrics endpoint is not supported")
+        with tracing.span_for_tenant("frontend.QueryRange", tenants[0],
                                      query=query):
-            return self._query_range(tenant, query, start_s=start_s,
+            return self._query_range(tenants[0], query, start_s=start_s,
                                      end_s=end_s, step_s=step_s)
 
     def _query_range(self, tenant: str, query: str, *,
@@ -300,6 +425,17 @@ class Frontend:
                 comb.add_all(res)
                 return True
 
+            def qr_key(j) -> "str | None":
+                # cacheable only when the moving cutoff cannot affect the
+                # block (block entirely before it); the clip then drops
+                # out of the key and old blocks stay cacheable forever
+                m = j.meta
+                if m.end_time * 1e9 >= cutoff_ns:
+                    return None
+                return (f"qj:{tenant}:{m.block_id}:{_qhash(query)}:"
+                        f"{','.join(map(str, j.row_groups))}:"
+                        f"{req.start_ns}:{req.end_ns}:{req.step_ns}")
+
             nbytes += self._run_jobs(
                 tenant, jobs,
                 lambda j: self.querier.query_range_block(
@@ -312,37 +448,90 @@ class Frontend:
                     "end_ns": req.end_ns, "step_ns": req.step_ns,
                     "meta": j.meta.to_json(),
                     "row_groups": list(j.row_groups),
-                    "clip_end_ns": cutoff_ns})
+                    "clip_end_ns": cutoff_ns},
+                cache=(qr_key, _encode_series, _decode_series))
         self.slos.record("metrics", tenant, self.now() - t0, nbytes)
         return comb.final(req)
 
     def decode_job_result(self, spec: dict, result):
         """Decode a remote worker's JSON job result back into the objects
-        the fold expects (the inverse of `execute_job_spec`)."""
-        import numpy as np
-
-        from tempo_tpu.traceql.engine import TraceSearchMetadata
+        the fold expects (the inverse of `execute_job_spec`). Shares the
+        cache codecs so the remote path and the cache path cannot drift."""
+        import json
 
         if spec["kind"] == "search_block":
-            return [TraceSearchMetadata.from_json(t) for t in (result or [])]
+            return _decode_metadata(json.dumps(result or []).encode())
         if spec["kind"] == "query_range_block":
-            return [TimeSeries(
-                labels=tuple((k, v) for k, v in s["labels"]),
-                samples=np.asarray(s["samples"], np.float64))
-                for s in (result or [])]
+            return _decode_series(json.dumps(result or []).encode())
         raise ValueError(f"unknown job kind {spec['kind']!r}")
 
     def tag_names(self, tenant: str) -> dict[str, list[str]]:
         t0 = self.now()
-        out = self.querier.tag_names(tenant)
+        merged: dict[str, list[str]] = {}
+        for t in split_tenants(tenant):
+            for scope, names in self.querier.tag_names(t).items():
+                cur = merged.setdefault(scope, [])
+                cur.extend(n for n in names if n not in cur)
+        for scope in merged:
+            merged[scope] = sorted(merged[scope])
         self.slos.record("metadata", tenant, self.now() - t0, 0)
-        return out
+        return merged
 
     def tag_values(self, tenant: str, name: str, limit: int = 1000) -> list[dict]:
         t0 = self.now()
-        out = self.querier.tag_values(tenant, name, limit)
+        out: list[dict] = []
+        seen: set = set()
+        for t in split_tenants(tenant):
+            # each tenant is asked for the FULL limit: cross-tenant
+            # duplicates collapse in `seen`, so a smaller ask could
+            # starve distinct values hiding behind shared ones
+            for v in self.querier.tag_values(t, name, limit):
+                key = (v.get("type"), v.get("value"))
+                if key not in seen:
+                    seen.add(key)
+                    out.append(v)
         self.slos.record("metadata", tenant, self.now() - t0, 0)
-        return out
+        return out[:limit]
+
+
+def _qhash(query: str) -> str:
+    import hashlib
+
+    return hashlib.sha1(query.encode()).hexdigest()[:16]
+
+
+def _encode_metadata(res) -> bytes:
+    import json
+
+    return json.dumps([m.to_json() for m in res]).encode()
+
+
+def _decode_metadata(raw: bytes):
+    import json
+
+    from tempo_tpu.traceql.engine import TraceSearchMetadata
+
+    return [TraceSearchMetadata.from_json(t) for t in json.loads(raw)]
+
+
+def _encode_series(res) -> bytes:
+    import json
+
+    return json.dumps([
+        {"labels": [[k, v] for k, v in s.labels],
+         "samples": list(map(float, s.samples)),
+         "exemplars": s.exemplars} for s in res]).encode()
+
+
+def _decode_series(raw: bytes):
+    import json
+
+    import numpy as np
+
+    return [TimeSeries(labels=tuple((k, v) for k, v in s["labels"]),
+                       samples=np.asarray(s["samples"], np.float64),
+                       exemplars=list(s.get("exemplars", [])))
+            for s in json.loads(raw)]
 
 
 def _job_bytes(job: SearchJob) -> int:
