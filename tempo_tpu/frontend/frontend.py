@@ -249,7 +249,6 @@ class Frontend:
         for _, wj in pending[:window]:
             self.queue.enqueue(tenant, wj)
         qi = window                 # next pending job to enqueue
-        pi = 0                      # next pending job to await
         for idx, j in enumerate(jobs):
             if idx in hits:
                 if not fold(idx, j, hits[idx]):
@@ -264,7 +263,6 @@ class Frontend:
                     # every worker disconnected with this job still queued:
                     # run it inline rather than hanging the query forever
                     wj.run_claimed()
-            pi += 1
             if qi < len(pending):
                 self.queue.enqueue(tenant, pending[qi][1])
                 qi += 1
@@ -300,6 +298,8 @@ class Frontend:
                     comb.add(md)
                 if on_partial is not None:
                     on_partial(comb.results())
+                if comb.exhausted():
+                    break               # top-N full: skip remaining tenants
             return comb.results()
 
     def _search(self, tenant: str, query: str, *, limit: int = 20,

@@ -249,7 +249,11 @@ def execute_job_spec(querier, spec: dict):
             spec["tenant"], req, meta, rgs,
             clip_start_ns=spec.get("clip_start_ns"),
             clip_end_ns=spec.get("clip_end_ns"))
-        return [{"labels": list(s.labels),
-                 "samples": list(map(float, s.samples))}
+        # same shape _encode_series/_decode_series (frontend.py) use —
+        # exemplars included, or the remote path degrades results AND the
+        # frontend's fold-time cache write persists the degradation
+        return [{"labels": [list(kv) for kv in s.labels],
+                 "samples": list(map(float, s.samples)),
+                 "exemplars": list(getattr(s, "exemplars", []))}
                 for s in series]
     raise ValueError(f"unknown job kind {kind!r}")

@@ -5,10 +5,18 @@ The reference's ingest-storage path speaks to real Kafka through franz-go
 in-memory `Bus` covered only the testkafka half. This is an SDK-free
 client of the Kafka binary protocol — the subset the bus seam needs:
 
+- Metadata v1 (broker list + per-partition leaders)
 - Produce v3 with v2 RecordBatches (varint records, CRC32C integrity)
 - Fetch v4 (record batches decoded back into `Record`s)
+- FindCoordinator v1 (consumer-group coordinator discovery)
 - OffsetCommit v2 / OffsetFetch v1 (consumer-group offsets)
 - ListOffsets v1 (high watermark)
+
+Requests route to the PARTITION LEADER (produce/fetch) or the GROUP
+COORDINATOR (offsets) from a cached metadata map, refreshed once on
+NOT_LEADER/NOT_COORDINATOR class errors before the retry — the franz-go
+behavior (`writer_client.go:168-325`) a multi-broker cluster requires;
+against a single broker the bootstrap connection answers everything.
 
 `KafkaBus` exposes the same surface as `ingest.bus.Bus`, so the
 blockbuilder and the generator's consume loop run unchanged against a
@@ -302,23 +310,125 @@ class _Conn:
 
 
 class KafkaError(RuntimeError):
-    pass
+    def __init__(self, msg: str, code: "int | None" = None):
+        super().__init__(msg)
+        self.code = code
 
 
 def _check(code: int, what: str) -> None:
     if code != 0:
-        raise KafkaError(f"kafka {what} error code {code}")
+        raise KafkaError(f"kafka {what} error code {code}", code)
+
+
+# error classes that mean "your routing map is stale, refresh and retry":
+# UNKNOWN_TOPIC_OR_PARTITION(3), LEADER_NOT_AVAILABLE(5),
+# NOT_LEADER_FOR_PARTITION(6); COORDINATOR_NOT_AVAILABLE(15),
+# NOT_COORDINATOR(16)
+_STALE_LEADER = {3, 5, 6}
+_STALE_COORD = {15, 16}
 
 
 class KafkaBus:
-    """The `ingest.bus.Bus` surface over a real Kafka broker."""
+    """The `ingest.bus.Bus` surface over a real Kafka cluster."""
 
     def __init__(self, bootstrap: str, *, topic: str = "tempo-ingest",
                  n_partitions: int = 2, client_id: str = "tempo-tpu",
                  timeout_s: float = 10.0) -> None:
         self.topic = topic
         self.n_partitions = n_partitions
+        self._client_id = client_id
+        self._timeout = timeout_s
         self._conn = _Conn(bootstrap, client_id, timeout_s)
+        self._meta_lock = threading.Lock()
+        self._brokers: dict[int, tuple[str, int]] = {}   # node → addr
+        self._leaders: dict[int, int] = {}               # partition → node
+        self._coord: "tuple[str, int] | None" = None
+        self._conns: dict[tuple[str, int], _Conn] = {}
+
+    # -- routing ------------------------------------------------------------
+
+    def _conn_to(self, addr: "tuple[str, int] | None") -> _Conn:
+        if addr is None:
+            return self._conn
+        with self._meta_lock:
+            c = self._conns.get(addr)
+            if c is None:
+                c = self._conns[addr] = _Conn(
+                    f"{addr[0]}:{addr[1]}", self._client_id, self._timeout)
+        return c
+
+    def refresh_metadata(self) -> None:
+        """Metadata v1 → broker addresses + per-partition leaders, asked
+        of the bootstrap connection first and then any previously-known
+        broker (the bootstrap broker itself may be the dead one). Total
+        failure leaves the maps unchanged."""
+        with self._meta_lock:
+            fallbacks = [a for a in self._brokers.values()]
+        for conn in [self._conn] + [self._conn_to(a) for a in fallbacks]:
+            try:
+                self._refresh_via(conn)
+                return
+            except Exception:
+                continue             # keep old maps; next candidate
+
+    def _refresh_via(self, conn: _Conn) -> None:
+        r = _R(conn.request(3, 1, _i32(1) + _string(self.topic)))
+        brokers: dict[int, tuple[str, int]] = {}
+        for _b in range(r.i32()):
+            nid = r.i32()
+            host = r.string() or ""
+            port = r.i32()
+            r.string()                           # rack
+            brokers[nid] = (host, port)
+        r.i32()                                  # controller id
+        leaders: dict[int, int] = {}
+        for _t in range(r.i32()):
+            r.i16()                              # topic error
+            name = r.string()
+            r.i8()                               # is_internal
+            for _p in range(r.i32()):
+                r.i16()                          # partition error
+                pid = r.i32()
+                leader = r.i32()
+                for _x in range(max(r.i32(), 0)):
+                    r.i32()                      # replicas
+                for _x in range(max(r.i32(), 0)):
+                    r.i32()                      # isr
+                if name == self.topic:
+                    leaders[pid] = leader
+        with self._meta_lock:
+            self._brokers = brokers
+            self._leaders = leaders
+
+    def _leader_conn(self, partition: int) -> _Conn:
+        with self._meta_lock:
+            known = partition in self._leaders
+        if not known:
+            self.refresh_metadata()
+        with self._meta_lock:
+            addr = self._brokers.get(self._leaders.get(partition, -1))
+        return self._conn_to(addr)
+
+    def _coord_conn(self, group: str, force: bool = False) -> _Conn:
+        with self._meta_lock:
+            addr = self._coord
+        if addr is None or force:
+            addr = None
+            try:
+                r = _R(self._conn.request(10, 1, _string(group) + _i8(0)))
+                r.i32()                          # throttle
+                err = r.i16()
+                r.string()                       # error message
+                r.i32()                          # coordinator node id
+                host = r.string() or ""
+                port = r.i32()
+                if err == 0:
+                    addr = (host, port)
+            except Exception:
+                addr = None
+            with self._meta_lock:
+                self._coord = addr
+        return self._conn_to(addr)
 
     # -- produce ------------------------------------------------------------
 
@@ -328,7 +438,21 @@ class KafkaBus:
         body = (_string(None) + _i16(-1) + _i32(30_000) +   # acks=all
                 _i32(1) + _string(self.topic) +
                 _i32(1) + _i32(partition) + _bytes(batch))
-        r = _R(self._conn.request(0, 3, body))
+        for attempt in (0, 1):
+            try:
+                return self._produce_once(self._leader_conn(partition), body)
+            except KafkaError as e:
+                # code=None = connection-level failure (dead broker): the
+                # leader may have MOVED — remap before giving up, else a
+                # crashed leader bricks its partitions forever
+                if attempt or (e.code is not None
+                               and e.code not in _STALE_LEADER):
+                    raise
+                self.refresh_metadata()          # stale leader: remap once
+        raise AssertionError("unreachable")
+
+    def _produce_once(self, conn: _Conn, body: bytes) -> int:
+        r = _R(conn.request(0, 3, body))
         base = -1
         for _t in range(r.i32()):
             r.string()
@@ -350,7 +474,18 @@ class KafkaBus:
                 _i8(0) +                         # isolation: read uncommitted
                 _i32(1) + _string(self.topic) +
                 _i32(1) + _i32(partition) + _i64(offset) + _i32(max_bytes))
-        r = _R(self._conn.request(1, 4, body))
+        for attempt in (0, 1):
+            try:
+                return self._fetch_once(self._leader_conn(partition), body)
+            except KafkaError as e:
+                if attempt or (e.code is not None
+                               and e.code not in _STALE_LEADER):
+                    raise
+                self.refresh_metadata()          # incl. dead-broker remap
+        raise AssertionError("unreachable")
+
+    def _fetch_once(self, conn: _Conn, body: bytes) -> tuple[bytes, int]:
+        r = _R(conn.request(1, 4, body))
         r.i32()                                  # throttle
         batches = b""
         hw = 0
@@ -399,26 +534,43 @@ class KafkaBus:
                 _i32(1) + _string(self.topic) +
                 _i32(1) + _i32(partition % self.n_partitions) +
                 _i64(offset) + _string(None))
-        r = _R(self._conn.request(8, 2, body))
-        for _t in range(r.i32()):
-            r.string()
-            for _p in range(r.i32()):
-                r.i32()
-                _check(r.i16(), "offset commit")
+        for attempt in (0, 1):
+            try:
+                r = _R(self._coord_conn(group, force=bool(attempt))
+                       .request(8, 2, body))
+                for _t in range(r.i32()):
+                    r.string()
+                    for _p in range(r.i32()):
+                        r.i32()
+                        _check(r.i16(), "offset commit")
+                return
+            except KafkaError as e:
+                if attempt or (e.code is not None
+                               and e.code not in _STALE_COORD):
+                    raise                        # retry re-finds coordinator
+        raise AssertionError("unreachable")
 
     def committed(self, group: str, partition: int) -> int:
         body = (_string(group) + _i32(1) + _string(self.topic) +
                 _i32(1) + _i32(partition % self.n_partitions))
-        r = _R(self._conn.request(9, 1, body))
-        off = 0
-        for _t in range(r.i32()):
-            r.string()
-            for _p in range(r.i32()):
-                r.i32()
-                off = r.i64()
-                r.string()                       # metadata
-                _check(r.i16(), "offset fetch")
-        return max(off, 0)                       # -1 = no commit yet
+        for attempt in (0, 1):
+            try:
+                r = _R(self._coord_conn(group, force=bool(attempt))
+                       .request(9, 1, body))
+                off = 0
+                for _t in range(r.i32()):
+                    r.string()
+                    for _p in range(r.i32()):
+                        r.i32()
+                        off = r.i64()
+                        r.string()               # metadata
+                        _check(r.i16(), "offset fetch")
+                return max(off, 0)               # -1 = no commit yet
+            except KafkaError as e:
+                if attempt or (e.code is not None
+                               and e.code not in _STALE_COORD):
+                    raise                        # retry re-finds coordinator
+        raise AssertionError("unreachable")
 
     def high_watermark(self, partition: int) -> int:
         _b, hw = self._fetch_raw(partition % self.n_partitions, 0,
@@ -430,6 +582,11 @@ class KafkaBus:
 
     def close(self) -> None:
         self._conn.close()
+        with self._meta_lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            c.close()
 
 
 __all__ = ["KafkaBus", "KafkaError", "crc32c",

@@ -82,15 +82,67 @@ class _R:
         return (v >> 1) ^ -(v & 1)
 
 
-class MockKafkaBroker:
-    """One broker, N partitions per topic, stores (key, value) records."""
+class MockCluster:
+    """Shared state of a mock multi-broker cluster: logs, consumer-group
+    offsets, and the leadership map (partition → broker id). Brokers that
+    do NOT lead a partition answer NOT_LEADER_FOR_PARTITION(6); offset
+    RPCs on a non-coordinator answer NOT_COORDINATOR(16) — the behaviors
+    a leader-routing client must handle (franz-go does; a bootstrap-only
+    client will fail against this, which is the point)."""
 
-    def __init__(self, n_partitions: int = 2) -> None:
+    def __init__(self, n_partitions: int = 2, n_brokers: int = 1) -> None:
         self.n_partitions = n_partitions
+        self.n_brokers = n_brokers
         self.logs: dict[tuple[str, int], list[tuple[bytes, bytes]]] = {}
         self.offsets: dict[tuple[str, str, int], int] = {}
         self.lock = threading.Lock()
-        self.produce_batches = 0      # verified batches accepted
+        self.produce_batches = 0
+        self.leaders = {p: p % n_brokers for p in range(n_partitions)}
+        self.coordinator = 0
+        self.addrs: dict[int, tuple[str, int]] = {}   # set after bind
+
+    def move_leader(self, partition: int, broker_id: int) -> None:
+        with self.lock:
+            self.leaders[partition] = broker_id
+
+
+class MockKafkaBroker:
+    """One broker of a MockCluster (or standalone, leading everything)."""
+
+    def __init__(self, n_partitions: int = 2,
+                 cluster: "MockCluster | None" = None,
+                 broker_id: int = 0) -> None:
+        self.cluster = cluster or MockCluster(n_partitions, 1)
+        self.broker_id = broker_id
+        self.n_partitions = self.cluster.n_partitions
+        # per-broker request counters (tests assert routing)
+        self.produce_reqs = 0
+        self.fetch_reqs = 0
+        self.offset_reqs = 0
+
+    # shared-state proxies (back-compat with the single-broker tests)
+    @property
+    def logs(self):
+        return self.cluster.logs
+
+    @property
+    def offsets(self):
+        return self.cluster.offsets
+
+    @property
+    def lock(self):
+        return self.cluster.lock
+
+    @property
+    def produce_batches(self):
+        return self.cluster.produce_batches
+
+    def _leads(self, partition: int) -> bool:
+        with self.cluster.lock:
+            return self.cluster.leaders.get(partition) == self.broker_id
+
+    def _is_coordinator(self) -> bool:
+        return self.cluster.coordinator == self.broker_id
 
     # -- record batch verification + decode ---------------------------------
 
@@ -149,13 +201,49 @@ class MockKafkaBroker:
             return self._produce(body)
         if api_key == 1:
             return self._fetch(body)
+        if api_key == 3:
+            return self._metadata(body)
         if api_key == 8:
             return self._offset_commit(body)
         if api_key == 9:
             return self._offset_fetch(body)
+        if api_key == 10:
+            return self._find_coordinator(body)
         raise ValueError(f"unsupported api key {api_key}")
 
+    def _metadata(self, body: bytes) -> bytes:
+        # Metadata v1 response: brokers, controller, topics w/ leaders
+        r = _R(body)
+        topics = [r.string() for _ in range(max(r.take(">i"), 0))]
+        c = self.cluster
+        with c.lock:
+            addrs = dict(c.addrs)
+            leaders = dict(c.leaders)
+        brokers = b"".join(
+            _i32(nid) + _str(host) + _i32(port) + _i16(-1)   # rack null
+            for nid, (host, port) in sorted(addrs.items()))
+        out_topics = []
+        for name in topics or ["tempo-ingest"]:
+            parts = b"".join(
+                _i16(0) + _i32(p) + _i32(leaders[p]) +
+                _i32(0) + _i32(0)                # replicas, isr empty
+                for p in range(c.n_partitions))
+            out_topics.append(_i16(0) + _str(name) +
+                              struct.pack(">b", 0) +   # is_internal
+                              _i32(c.n_partitions) + parts)
+        return (_i32(len(addrs)) + brokers + _i32(c.coordinator) +
+                _i32(len(out_topics)) + b"".join(out_topics))
+
+    def _find_coordinator(self, body: bytes) -> bytes:
+        # FindCoordinator v1: throttle, err, errmsg, node, host, port
+        c = self.cluster
+        with c.lock:
+            host, port = c.addrs.get(c.coordinator, ("127.0.0.1", 0))
+        return (_i32(0) + _i16(0) + _str("") +
+                _i32(c.coordinator) + _str(host) + _i32(port))
+
     def _produce(self, body: bytes) -> bytes:
+        self.produce_reqs += 1
         r = _R(body)
         r.string()                              # transactional id
         r.take(">h")                            # acks
@@ -167,18 +255,23 @@ class MockKafkaBroker:
             for _p in range(r.take(">i")):
                 part = r.take(">i")
                 batch = r.bytes_() or b""
+                if not self._leads(part):
+                    parts.append(_i32(part) + _i16(6) +   # NOT_LEADER
+                                 _i64(-1) + _i64(-1))
+                    continue
                 recs = self._decode_batch(batch)
                 with self.lock:
                     log = self.logs.setdefault((topic, part), [])
                     base = len(log)
                     log.extend(recs)
-                    self.produce_batches += 1
+                    self.cluster.produce_batches += 1
                 parts.append(_i32(part) + _i16(0) + _i64(base) + _i64(-1))
             out_topics.append(
                 _str(topic) + _i32(len(parts)) + b"".join(parts))
         return (_i32(len(out_topics)) + b"".join(out_topics) + _i32(0))
 
     def _fetch(self, body: bytes) -> bytes:
+        self.fetch_reqs += 1
         r = _R(body)
         r.take(">i"); r.take(">i"); r.take(">i"); r.take(">i")
         r.take(">b")                            # isolation
@@ -190,6 +283,10 @@ class MockKafkaBroker:
                 part = r.take(">i")
                 offset = r.take(">q")
                 max_bytes = r.take(">i")
+                if not self._leads(part):
+                    parts.append(_i32(part) + _i16(6) + _i64(-1) +
+                                 _i64(-1) + _i32(0) + _i32(0))
+                    continue
                 with self.lock:
                     log = list(self.logs.get((topic, part), []))
                 hw = len(log)
@@ -206,6 +303,7 @@ class MockKafkaBroker:
         return _i32(0) + _i32(len(out_topics)) + b"".join(out_topics)
 
     def _offset_commit(self, body: bytes) -> bytes:
+        self.offset_reqs += 1
         r = _R(body)
         group = r.string()
         r.take(">i")                            # generation
@@ -219,6 +317,9 @@ class MockKafkaBroker:
                 part = r.take(">i")
                 off = r.take(">q")
                 r.string()                      # metadata
+                if not self._is_coordinator():
+                    parts.append(_i32(part) + _i16(16))   # NOT_COORDINATOR
+                    continue
                 with self.lock:
                     self.offsets[(group, topic, part)] = off
                 parts.append(_i32(part) + _i16(0))
@@ -227,6 +328,7 @@ class MockKafkaBroker:
         return _i32(len(out_topics)) + b"".join(out_topics)
 
     def _offset_fetch(self, body: bytes) -> bytes:
+        self.offset_reqs += 1
         r = _R(body)
         group = r.string()
         out_topics = []
@@ -235,6 +337,10 @@ class MockKafkaBroker:
             parts = []
             for _p in range(r.take(">i")):
                 part = r.take(">i")
+                if not self._is_coordinator():
+                    parts.append(_i32(part) + _i64(-1) + _str("") +
+                                 _i16(16))
+                    continue
                 with self.lock:
                     off = self.offsets.get((group, topic, part), -1)
                 parts.append(_i32(part) + _i64(off) + _str("") + _i16(0))
@@ -261,12 +367,18 @@ def _zig(v: int) -> bytes:
             return bytes(out)
 
 
-def start_mock_kafka(n_partitions: int = 2):
-    """Returns (server_socket_thread_handle, port, broker). Serves until
-    the returned closer is called."""
-    import socketserver
+def _readn(sock, n):
+    out = b""
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            return None
+        out += chunk
+    return out
 
-    broker = MockKafkaBroker(n_partitions)
+
+def _serve_broker(broker: MockKafkaBroker):
+    import socketserver
 
     class Handler(socketserver.BaseRequestHandler):
         def handle(self):
@@ -291,17 +403,35 @@ def start_mock_kafka(n_partitions: int = 2):
             except (ConnectionError, ValueError, struct.error):
                 return
 
-    def _readn(sock, n):
-        out = b""
-        while len(out) < n:
-            chunk = sock.recv(n - len(out))
-            if not chunk:
-                return None
-            out += chunk
-        return out
-
     srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), Handler)
     srv.daemon_threads = True
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
-    return srv, srv.server_address[1], broker
+    return srv, srv.server_address[1]
+
+
+def start_mock_kafka(n_partitions: int = 2):
+    """Single-broker cluster. Returns (server, port, broker); the broker
+    leads every partition and coordinates every group."""
+    cluster = MockCluster(n_partitions, 1)
+    cluster.leaders = {p: 0 for p in range(n_partitions)}
+    broker = MockKafkaBroker(cluster=cluster, broker_id=0)
+    srv, port = _serve_broker(broker)
+    cluster.addrs[0] = ("127.0.0.1", port)
+    return srv, port, broker
+
+
+def start_mock_kafka_cluster(n_partitions: int = 4, n_brokers: int = 2):
+    """Multi-broker cluster with SPLIT leadership (partition p led by
+    broker p % n_brokers; broker 0 coordinates groups). Returns
+    (servers, ports, brokers, cluster)."""
+    cluster = MockCluster(n_partitions, n_brokers)
+    servers, ports, brokers = [], [], []
+    for bid in range(n_brokers):
+        broker = MockKafkaBroker(cluster=cluster, broker_id=bid)
+        srv, port = _serve_broker(broker)
+        cluster.addrs[bid] = ("127.0.0.1", port)
+        servers.append(srv)
+        ports.append(port)
+        brokers.append(broker)
+    return servers, ports, brokers, cluster
