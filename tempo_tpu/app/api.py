@@ -512,6 +512,24 @@ class Handler(BaseHTTPRequestHandler):
             for (op, tenant), v in fe.slos.within.items():
                 lines.append(f'tempo_query_frontend_queries_within_slo_total'
                              f'{{op="{op}",tenant="{esc(tenant)}"}} {v}')
+            cs = fe.cache_stats
+            lines.append(f"tempo_query_frontend_cache_hits_total "
+                         f"{cs['hits']}")
+            lines.append(f"tempo_query_frontend_cache_misses_total "
+                         f"{cs['misses']}")
+        db = getattr(self.app, "db", None)
+        if db is not None:
+            for k, v in db.plane_stats.items():
+                lines.append(f"tempo_read_plane_{k}_total {v}")
+            if db.planes is not None:
+                ps = db.planes.stats()
+                for k in ("entries", "device_bytes", "host_bytes",
+                          "device_budget_bytes", "host_budget_bytes"):
+                    lines.append(f"tempo_read_plane_cache_{k} {ps[k]}")
+                lines.append(f"tempo_read_plane_cache_hits_total "
+                             f"{ps['hits']}")
+                lines.append(f"tempo_read_plane_cache_misses_total "
+                             f"{ps['misses']}")
         ing = self.app.ingester
         if ing is not None:
             with ing.lock:

@@ -14,6 +14,11 @@ Commands (subset of the reference's 27, the operationally load-bearing ones):
   rewrite drop <tenant> <block> <hex-id>  rebuild a block without a trace
                                   (`cmd-rewrite-blocks.go` drop-trace)
   migrate tenant <src-tenant> <dst-tenant>  copy blocks (`cmd-migrate-tenant.go`)
+  list column-sizes <tenant> <block>  per-column byte stats (`cmd-list-column.go`)
+  list wal <dir>                  WAL segment/span inventory
+  view rows <tenant> <block>      dump span rows as JSON lines
+  query attr <tenant> <key> <value>  one-attribute backend search
+  compact dry-run <tenant>        pending compaction jobs, read-only
 
 Backend selection: --backend local --path DIR (or mem for tests).
 """
@@ -304,6 +309,125 @@ def cmd_query_tags(args) -> int:
     return 0
 
 
+def cmd_list_column_sizes(args) -> int:
+    """Per-parquet-column compressed/uncompressed byte stats for one block
+    (`cmd-list-column.go` / the size half of `cmd-analyse-block.go`)."""
+    from tempo_tpu.backend.meta import read_block_meta
+
+    db = _db(args)
+    m = read_block_meta(db.r, args.block, args.tenant)
+    md = db.backend_block(m).parquet_file().metadata
+    agg: dict[str, list[int]] = {}
+    for rg in range(md.num_row_groups):
+        g = md.row_group(rg)
+        for ci in range(g.num_columns):
+            c = g.column(ci)
+            a = agg.setdefault(c.path_in_schema, [0, 0])
+            a[0] += c.total_compressed_size
+            a[1] += c.total_uncompressed_size
+    total_c = sum(v[0] for v in agg.values()) or 1
+    print(f"{'COLUMN':42} {'COMPRESSED':>12} {'RAW':>12} {'%':>6}")
+    for name, (comp, raw) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
+        print(f"{name:42} {comp:>12} {raw:>12} {100 * comp / total_c:>5.1f}%")
+    print(f"total: {total_c} compressed bytes, "
+          f"{md.num_rows} rows, {md.num_row_groups} row groups")
+    return 0
+
+
+def cmd_view_rows(args) -> int:
+    """Dump span rows of one block as JSON lines (block inspect /
+    dump-rows; `cmd-parquet-...`-style deep inspection)."""
+    from tempo_tpu.backend.meta import read_block_meta
+    from tempo_tpu.block.fetch import scan_views
+
+    db = _db(args)
+    block = db.backend_block(read_block_meta(db.r, args.block, args.tenant))
+    rgs = [args.rg] if args.rg is not None else None
+    left = args.limit
+    for view, _cand in scan_views(block, None, row_groups=rgs):
+        tid = view.col("trace:id")
+        sid = view.col("span:id")
+        name = view.col("name")
+        svc = view.col("resource.service.name")
+        dur = view.col("duration")
+        st = view.col("__startTime")
+        for i in range(view.n):
+            if left <= 0:
+                return 0
+            print(json.dumps({
+                "traceID": tid.values[i], "spanID": sid.values[i],
+                "name": name.values[i], "service": svc.values[i],
+                "startUnixNano": int(st.values[i]),
+                "durationNanos": int(dur.values[i])}))
+            left -= 1
+    return 0
+
+
+def cmd_search_attr(args) -> int:
+    """Search backend blocks by one attribute equality — the quick
+    operator triage shape (`cmd-search.go` attr mode) without writing
+    TraceQL by hand."""
+    v = args.value
+    qstr = '"' + v.replace('"', '\\"') + '"'
+    try:
+        float(v)
+        # numeric-looking values OR both typings: attrs stored as string
+        # "200" vs int 200 both match (incomparable arms are just false)
+        query = f'{{ .{args.key} = {qstr} || .{args.key} = {v} }}'
+    except ValueError:
+        query = f'{{ .{args.key} = {qstr} }}'
+    db = _db(args)
+    res = db.search(args.tenant, query, limit=args.limit)
+    for md in res:
+        print(f"{md.trace_id} {md.root_service_name} "
+              f"{md.root_trace_name} {md.duration_ms}ms")
+    print(f"{len(res)} traces for {query}")
+    return 0
+
+
+def cmd_list_wal(args) -> int:
+    """Inspect a WAL directory: per-block segment/span/byte counts
+    (`cmd-list-...` over `tempodb/wal`)."""
+    import os
+
+    from tempo_tpu.block.wal import rescan_blocks
+
+    blocks = rescan_blocks(args.dir)
+    print(f"{'TENANT':16} {'BLOCK':38} {'SEGMENTS':>8} {'SPANS':>8} "
+          f"{'BYTES':>10}")
+    total = 0
+    for wb in blocks:
+        segs = wb.segments()
+        nbytes = sum(os.path.getsize(s) for s in segs
+                     if os.path.exists(s))
+        nspans = sum(1 for _ in wb.iter_spans())
+        total += nspans
+        print(f"{wb.tenant:16} {wb.block_id:38} {len(segs):>8} "
+              f"{nspans:>8} {nbytes:>10}")
+    print(f"total: {len(blocks)} wal blocks, {total} spans")
+    return 0
+
+
+def cmd_compact_dryrun(args) -> int:
+    """Show which block groups the time-window selector WOULD compact —
+    no reads, no writes (`tempodb/compaction_block_selector.go` applied
+    read-only)."""
+    db = _db(args)
+    metas = db.blocklist.metas(args.tenant)
+    jobs = db.selector.blocks_to_compact(metas)
+    if not jobs:
+        print("nothing to compact")
+        return 0
+    for gi, group in enumerate(jobs):
+        total = sum(m.size_bytes for m in group)
+        print(f"job {gi}: {len(group)} blocks, {total} bytes")
+        for m in group:
+            print(f"  {m.block_id} lvl={m.compaction_level} "
+                  f"objects={m.total_objects} size={m.size_bytes}")
+    print(f"{len(jobs)} compaction job(s) pending")
+    return 0
+
+
 def cmd_usage_stats(args) -> int:
     """Print the persisted anonymized usage report (pkg/usagestats)."""
     from tempo_tpu.backend.raw import KeyPath
@@ -373,6 +497,9 @@ def main(argv: list[str] | None = None) -> int:
     q = ls.add_parser("block"); q.add_argument("tenant"); q.add_argument("block"); q.set_defaults(fn=cmd_list_block)
     q = ls.add_parser("compaction-summary"); q.add_argument("tenant"); q.set_defaults(fn=cmd_compaction_summary)
     q = ls.add_parser("index"); q.add_argument("tenant"); q.set_defaults(fn=cmd_list_index)
+    q = ls.add_parser("column-sizes"); q.add_argument("tenant"); q.add_argument("block")
+    q.set_defaults(fn=cmd_list_column_sizes)
+    q = ls.add_parser("wal"); q.add_argument("dir"); q.set_defaults(fn=cmd_list_wal)
 
     p = sub.add_parser("analyse")
     an = p.add_subparsers(dest="what", required=True)
@@ -387,6 +514,10 @@ def main(argv: list[str] | None = None) -> int:
     vw = p.add_subparsers(dest="what", required=True)
     q = vw.add_parser("pq-schema"); q.add_argument("tenant"); q.add_argument("block")
     q.set_defaults(fn=cmd_view_schema)
+    q = vw.add_parser("rows"); q.add_argument("tenant"); q.add_argument("block")
+    q.add_argument("--rg", type=int, default=None)
+    q.add_argument("--limit", type=int, default=50)
+    q.set_defaults(fn=cmd_view_rows)
 
     p = sub.add_parser("query")
     qs = p.add_subparsers(dest="what", required=True)
@@ -401,6 +532,10 @@ def main(argv: list[str] | None = None) -> int:
     q = qs.add_parser("tags"); q.add_argument("tenant")
     q.add_argument("--limit", type=int, default=1000)
     q.set_defaults(fn=cmd_query_tags)
+    q = qs.add_parser("attr"); q.add_argument("tenant")
+    q.add_argument("key"); q.add_argument("value")
+    q.add_argument("--limit", type=int, default=20)
+    q.set_defaults(fn=cmd_search_attr)
     for what in ("trace", "search", "tags"):
         q = qs.add_parser(f"api-{what}")
         q.add_argument("url"); q.add_argument("tenant")
@@ -424,6 +559,11 @@ def main(argv: list[str] | None = None) -> int:
     mg = p.add_subparsers(dest="what", required=True)
     q = mg.add_parser("tenant"); q.add_argument("src"); q.add_argument("dst")
     q.set_defaults(fn=cmd_migrate_tenant)
+
+    p = sub.add_parser("compact")
+    cp = p.add_subparsers(dest="what", required=True)
+    q = cp.add_parser("dry-run"); q.add_argument("tenant")
+    q.set_defaults(fn=cmd_compact_dryrun)
 
     q = sub.add_parser("usage-stats"); q.set_defaults(fn=cmd_usage_stats)
     q = sub.add_parser("version"); q.set_defaults(fn=cmd_version)

@@ -170,6 +170,8 @@ class PlaneCache:
                                     for e in self._entries.values()),
                 "host_bytes": sum(e.host_bytes
                                   for e in self._entries.values()),
+                "device_budget_bytes": self.budget_bytes,
+                "host_budget_bytes": self.host_budget_bytes,
                 "hits": self.hits,
                 "misses": self.misses,
             }

@@ -80,6 +80,26 @@ class _Services:
             context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         return b""   # empty ExportTraceServiceResponse = full success
 
+    # -- jaeger api_v2 collector (gRPC reporter protocol) -------------------
+
+    def jaeger_post_spans(self, request: bytes, context) -> bytes:
+        """`jaeger.api_v2.CollectorService/PostSpans` — the gRPC half of
+        the jaeger receiver (thrift-over-HTTP is in app/api.py); ref
+        `modules/distributor/receiver/shim.go:165-171`."""
+        tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
+        from tempo_tpu.distributor.distributor import RateLimited
+        from tempo_tpu.model.jaeger import spans_from_jaeger_proto
+
+        try:
+            spans = spans_from_jaeger_proto(request)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        try:
+            self.app.distributor.push_spans(tenant, spans)
+        except RateLimited as e:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        return b""   # empty PostSpansResponse
+
     # -- Pusher (ingester) --------------------------------------------------
 
     def push_bytes_v2(self, request: bytes, context) -> bytes:
@@ -342,6 +362,9 @@ def build_grpc_server(app, address: str = "127.0.0.1:0",
         server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
             "opentelemetry.proto.collector.trace.v1.TraceService",
             {"Export": unary(svc.otlp_export)}),))
+        server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
+            "jaeger.api_v2.CollectorService",
+            {"PostSpans": unary(svc.jaeger_post_spans)}),))
     if app.ingester is not None:
         server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
             "tempopb.Pusher",

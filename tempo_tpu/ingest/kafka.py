@@ -414,18 +414,25 @@ class KafkaBus:
             addr = self._coord
         if addr is None or force:
             addr = None
-            try:
-                r = _R(self._conn.request(10, 1, _string(group) + _i8(0)))
-                r.i32()                          # throttle
-                err = r.i16()
-                r.string()                       # error message
-                r.i32()                          # coordinator node id
-                host = r.string() or ""
-                port = r.i32()
-                if err == 0:
-                    addr = (host, port)
-            except Exception:
-                addr = None
+            # like refresh_metadata: ask the bootstrap connection first,
+            # then any known broker — the bootstrap broker may be the
+            # dead one (the blockbuilder's offsets must survive that)
+            with self._meta_lock:
+                fallbacks = list(self._brokers.values())
+            for conn in [self._conn] + [self._conn_to(a) for a in fallbacks]:
+                try:
+                    r = _R(conn.request(10, 1, _string(group) + _i8(0)))
+                    r.i32()                      # throttle
+                    err = r.i16()
+                    r.string()                   # error message
+                    r.i32()                      # coordinator node id
+                    host = r.string() or ""
+                    port = r.i32()
+                    if err == 0:
+                        addr = (host, port)
+                        break
+                except Exception:
+                    continue
             with self._meta_lock:
                 self._coord = addr
         return self._conn_to(addr)

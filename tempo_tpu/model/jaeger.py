@@ -177,6 +177,26 @@ def _read_tags(r: _R) -> dict[str, Any]:
     return out
 
 
+def _intrinsics_from_tags(attrs: dict) -> tuple[int, int]:
+    """(kind, status_code) from OTel-mapped jaeger tags — span.kind is
+    POPPED from attrs; error/otel.status_code stay (the translator keeps
+    them). Shared by the thrift and api_v2-proto decoders so the two
+    receiver protocols can never diverge on the mapping."""
+    kind = 0
+    sk = attrs.pop("span.kind", None)
+    if isinstance(sk, str):
+        kind = _KIND_FROM_STR.get(sk.lower(), 0)
+    status_code = 0
+    err = attrs.get("error")
+    if err is True or (isinstance(err, str) and err.lower() == "true"):
+        status_code = 2            # STATUS_CODE_ERROR, like the translator
+    otel_status = attrs.get("otel.status_code")
+    if isinstance(otel_status, str):
+        status_code = {"OK": 1, "ERROR": 2}.get(otel_status.upper(),
+                                                status_code)
+    return kind, status_code
+
+
 def _read_span(r: _R) -> dict:
     """One jaeger.thrift Span → span dict (service/res_attrs patched in by
     the caller once the Process struct is known)."""
@@ -204,18 +224,7 @@ def _read_span(r: _R) -> dict:
         else:
             r.skip(ft)
 
-    kind = 0
-    sk = attrs.pop("span.kind", None)
-    if isinstance(sk, str):
-        kind = _KIND_FROM_STR.get(sk.lower(), 0)
-    status_code = 0
-    err = attrs.get("error")
-    if err is True or (isinstance(err, str) and err.lower() == "true"):
-        status_code = 2            # STATUS_CODE_ERROR, like the translator
-    otel_status = attrs.get("otel.status_code")
-    if isinstance(otel_status, str):
-        status_code = {"OK": 1, "ERROR": 2}.get(otel_status.upper(),
-                                                status_code)
+    kind, status_code = _intrinsics_from_tags(attrs)
     u64 = lambda v: v & ((1 << 64) - 1)
     start_ns = start_us * 1000
     return {
@@ -273,4 +282,138 @@ def spans_from_jaeger_thrift(data: bytes) -> list[dict]:
         raise ValueError(f"malformed jaeger thrift payload: {e}") from None
 
 
-__all__ = ["spans_from_jaeger_thrift"]
+# -- jaeger api_v2 protobuf (model.proto) -----------------------------------
+#
+# The gRPC collector variant (`jaeger.api_v2.CollectorService/PostSpans`,
+# ref `modules/distributor/receiver/shim.go:165-171` jaeger receiver
+# protocols). Same span-dict mapping as the thrift path above; the wire is
+# protobuf Batch{spans=1, process=2} instead of TBinaryProtocol.
+
+def _pb_ts_ns(buf: bytes) -> int:
+    """Timestamp/Duration {seconds=1, nanos=2} → nanoseconds."""
+    from tempo_tpu.model.proto_wire import iter_fields
+
+    sec = nanos = 0
+    for fnum, wt, val in iter_fields(buf):
+        if fnum == 1 and wt == 0:
+            sec = val
+        elif fnum == 2 and wt == 0:
+            nanos = val
+    return sec * 1_000_000_000 + nanos
+
+
+def _pb_keyvalues(bufs: list) -> dict:
+    """repeated model.KeyValue → attrs dict (typed like the thrift tags)."""
+    from tempo_tpu.model.proto_wire import f64, iter_fields
+
+    out: dict[str, Any] = {}
+    for kv in bufs:
+        key = ""
+        vtype = 0
+        vals: dict[int, Any] = {}
+        for fnum, wt, val in iter_fields(kv):
+            if fnum == 1 and wt == 2:
+                key = bytes(val).decode("utf-8", "replace")
+            elif fnum == 2 and wt == 0:
+                vtype = val
+            elif fnum in (3, 7) and wt == 2:
+                vals[fnum] = val
+            elif fnum in (4, 5) and wt == 0:
+                vals[fnum] = val
+            elif fnum == 6 and wt == 1:
+                vals[fnum] = f64(val)
+        if not key:
+            continue
+        if vtype == 1:
+            out[key] = bool(vals.get(4, 0))
+        elif vtype == 2:
+            v = vals.get(5, 0)
+            out[key] = v - (1 << 64) if v >= (1 << 63) else v
+        elif vtype == 3:
+            out[key] = float(vals.get(6, 0.0))
+        elif vtype == 4:
+            out[key] = bytes(vals.get(7) or b"").hex()
+        else:
+            out[key] = bytes(vals.get(3) or b"").decode("utf-8", "replace")
+    return out
+
+
+def _pb_process(buf: bytes) -> tuple[str, dict]:
+    from tempo_tpu.model.proto_wire import decode_fields
+
+    f = decode_fields(buf)
+    service = bytes(f.get(1, [b""])[0] or b"").decode("utf-8", "replace") \
+        if f.get(1) else ""
+    return service, _pb_keyvalues(f.get(2, []))
+
+
+def _pb_span(buf: bytes) -> dict:
+    from tempo_tpu.model.proto_wire import decode_fields, iter_fields
+
+    f = decode_fields(buf)
+    tid = bytes(f.get(1, [b""])[0] or b"")
+    sid = bytes(f.get(2, [b""])[0] or b"")
+    name = bytes(f.get(3, [b""])[0] or b"").decode("utf-8", "replace") \
+        if f.get(3) else ""
+    psid = b""
+    for ref in f.get(4, []):
+        r_sid = b""
+        r_type = 0
+        for fnum, wt, val in iter_fields(ref):
+            if fnum == 2 and wt == 2:
+                r_sid = bytes(val)
+            elif fnum == 3 and wt == 0:
+                r_type = val
+        if r_type == 0 and r_sid:                 # CHILD_OF
+            psid = r_sid
+    start_ns = _pb_ts_ns(f[6][0]) if f.get(6) else 0
+    dur_ns = _pb_ts_ns(f[7][0]) if f.get(7) else 0
+    attrs = _pb_keyvalues(f.get(8, []))
+    service = ""
+    res_attrs: "dict | None" = None
+    if f.get(10):                                 # per-span Process override
+        service, tags = _pb_process(f[10][0])
+        res_attrs = dict(tags)
+        res_attrs.setdefault("service.name", service)
+
+    kind, status_code = _intrinsics_from_tags(attrs)
+    return {
+        "trace_id": tid, "span_id": sid,
+        "parent_span_id": psid,
+        "name": name, "service": service, "kind": kind,
+        "status_code": status_code,
+        "start_unix_nano": start_ns,
+        "end_unix_nano": start_ns + dur_ns,
+        "attrs": attrs, "res_attrs": res_attrs,
+    }
+
+
+def spans_from_jaeger_proto(data: bytes, wrapped: bool = True) -> list[dict]:
+    """Decode one api_v2 `PostSpansRequest` (wrapped=True; its field 1 is
+    the Batch) or a bare `Batch` into span dicts. Raises ValueError on
+    malformed bytes."""
+    from tempo_tpu.model.proto_wire import decode_fields
+
+    try:
+        f = decode_fields(data)
+        if wrapped:
+            f = decode_fields(f[1][0]) if f.get(1) else {}
+        service = ""
+        res_attrs: dict[str, Any] = {}
+        if f.get(2):
+            service, res_attrs = _pb_process(f[2][0])
+        out = [_pb_span(b) for b in f.get(1, [])]
+        base = dict(res_attrs)
+        base.setdefault("service.name", service)
+        for s in out:
+            if s["res_attrs"] is None:            # batch Process applies
+                s["service"] = service
+                s["res_attrs"] = base
+            elif not s["service"]:
+                s["service"] = s["res_attrs"].get("service.name", "")
+        return out
+    except (ValueError, struct.error, IndexError, KeyError) as e:
+        raise ValueError(f"malformed jaeger proto payload: {e}") from None
+
+
+__all__ = ["spans_from_jaeger_thrift", "spans_from_jaeger_proto"]

@@ -252,3 +252,61 @@ def test_tempo_query_jaeger_plugin(tmp_path):
             qserver.stop(0)
         srv.shutdown()
         app.shutdown()
+
+
+def test_cli_round4_commands(block_dir, capsys, tmp_path):
+    """Round-4 operator commands: column sizes, row dump, attr search,
+    wal inventory, compaction dry-run (`cmd-list-column.go`,
+    `cmd-search.go`, wal + block-selector inspection)."""
+    path, meta = block_dir
+    # per-column byte stats
+    assert cli_main(["--path", path, "list", "column-sizes", "t1",
+                     meta.block_id]) == 0
+    out = capsys.readouterr().out
+    assert "name" in out and "COMPRESSED" in out and "row groups" in out
+    # row dump (limited, JSON lines)
+    assert cli_main(["--path", path, "view", "rows", "t1", meta.block_id,
+                     "--limit", "3"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 3
+    import json as _json
+    row = _json.loads(out[0])
+    assert row["service"] == "svc" and len(row["traceID"]) == 32
+    # attr search
+    assert cli_main(["--path", path, "query", "attr", "t1",
+                     "http.path", "/page/3"]) == 0
+    out = capsys.readouterr().out
+    assert "1 traces" in out
+    # wal inventory
+    from tempo_tpu.block.wal import WALBlock
+    wb = WALBlock(str(tmp_path / "wal"), "t1")
+    wb.append([{"trace_id": b"\x01" * 16, "span_id": b"\x02" * 8,
+                "name": "w", "service": "svc",
+                "start_unix_nano": int(T0 * 1e9),
+                "end_unix_nano": int(T0 * 1e9) + 1000}])
+    assert cli_main(["--path", path, "list", "wal",
+                     str(tmp_path / "wal")]) == 0
+    out = capsys.readouterr().out
+    assert "1 wal blocks, 1 spans" in out
+    # compaction dry-run: one block -> nothing to compact; write three
+    # more into the same window -> a pending job appears, and NO block
+    # disappears (read-only)
+    assert cli_main(["--path", path, "compact", "dry-run", "t1"]) == 0
+    assert "nothing to compact" in capsys.readouterr().out
+    be = LocalBackend(path)
+    db = TempoDB(be, be)
+    db.poll_now()
+    for _ in range(3):
+        traces = [(bytes([99]) * 16, [{
+            "trace_id": bytes([99]) * 16, "span_id": bytes([9]) * 8,
+            "name": "x", "service": "svc",
+            "start_unix_nano": int((T0 + 1) * 1e9),
+            "end_unix_nano": int((T0 + 1) * 1e9) + 1000}])]
+        db.write_block("t1", traces)
+    n_before = len(db.blocklist.metas("t1"))
+    assert cli_main(["--path", path, "compact", "dry-run", "t1"]) == 0
+    out = capsys.readouterr().out
+    assert "compaction job(s) pending" in out
+    db2 = TempoDB(be, be)
+    db2.poll_now()
+    assert len(db2.blocklist.metas("t1")) == n_before   # read-only

@@ -315,3 +315,38 @@ def test_tempopb_wire_is_protobuf():
     pr = tempopb.enc_push_response([None, "trace_too_large", None])
     assert tempopb.dec_push_response(pr, 3) == [None, "trace_too_large", None]
     assert tempopb.dec_push_response(b"", 2) == [None, None]
+
+
+def test_jaeger_grpc_post_spans(grpc_cluster):
+    """api_v2 CollectorService/PostSpans end-to-end: a jaeger-proto batch
+    (built with the tempo-query encoder — the inverse translation) lands
+    in the ingester and is searchable, with span.kind/error tags mapped
+    to intrinsics (shim.go:165-171 jaeger gRPC receiver)."""
+    from tempo_tpu.model import proto_wire as pw
+    from tempo_tpu.tempoquery.plugin import _jaeger_span
+
+    apps, ports = grpc_cluster
+    t0 = int((time.time() - 5) * 1e9)
+    tid = bytes.fromhex("ef" * 16)
+    span = {"trace_id": tid, "span_id": "aa" * 8, "name": "jgrpc-op",
+            "service": "jgrpc-svc", "kind": 2, "status_code": 2,
+            "start_unix_nano": t0, "end_unix_nano": t0 + 40_000_000,
+            "attrs": {"http.method": "GET"},
+            "res_attrs": {"service.name": "jgrpc-svc", "region": "r1"}}
+    batch = (pw.enc_field_msg(1, _jaeger_span(span, tid)) +
+             pw.enc_field_msg(2, pw.enc_field_str(1, "jgrpc-svc")))
+    request = pw.enc_field_msg(1, batch)        # PostSpansRequest{batch=1}
+
+    with grpc.insecure_channel(f"127.0.0.1:{ports['dist']}") as ch:
+        post = ch.unary_unary("/jaeger.api_v2.CollectorService/PostSpans")
+        assert post(request, timeout=10) == b""
+
+    spans = apps["query"].frontend.find_trace("single-tenant", tid)
+    assert spans and spans[0]["name"] == "jgrpc-op"
+    assert spans[0]["service"] == "jgrpc-svc"
+    assert spans[0]["kind"] == 2                # span.kind tag → intrinsic
+    assert spans[0]["status_code"] == 2         # error tag → status
+    assert spans[0]["attrs"]["http.method"] == "GET"
+    res = apps["query"].frontend.search(
+        "single-tenant", '{ status = error && name = "jgrpc-op" }')
+    assert len(res) == 1 and res[0].trace_id == "ef" * 16
