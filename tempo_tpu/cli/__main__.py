@@ -367,14 +367,17 @@ def cmd_search_attr(args) -> int:
     """Search backend blocks by one attribute equality — the quick
     operator triage shape (`cmd-search.go` attr mode) without writing
     TraceQL by hand."""
+    import re as _re
+
     v = args.value
     qstr = '"' + v.replace('"', '\\"') + '"'
-    try:
-        float(v)
+    if _re.fullmatch(r"-?\d+(\.\d+)?", v):
         # numeric-looking values OR both typings: attrs stored as string
-        # "200" vs int 200 both match (incomparable arms are just false)
+        # "200" vs int 200 both match (incomparable arms are just false).
+        # Strict literal check — float() would admit nan/inf/1_0, which
+        # are not TraceQL numbers
         query = f'{{ .{args.key} = {qstr} || .{args.key} = {v} }}'
-    except ValueError:
+    else:
         query = f'{{ .{args.key} = {qstr} }}'
     db = _db(args)
     res = db.search(args.tenant, query, limit=args.limit)

@@ -24,7 +24,56 @@ class ForwarderConfig:
     # strict matches on name/service/kind/status_code or span attrs (the
     # OTTL-filter analog, dict-level since the tee runs pre-batching)
     filter: dict = dataclasses.field(default_factory=dict)
+    # filter_policies: full pkg/spanfilter-shape policies
+    # [{"include": {"match_type": "strict"|"regex",
+    #               "attributes": [{"key": ..., "value": ...}]},
+    #   "exclude": {...}}, ...] — keys: kind/status/name/span.*/resource.*
+    # (the per-tenant OTTL filtering of `modules/distributor/forwarder`)
+    filter_policies: list = dataclasses.field(default_factory=list)
     queue_size: int = 1000
+
+
+# intrinsic string forms (pkg/spanfilter's splitPolicy enum strings)
+_KIND_STRS = ("SPAN_KIND_UNSPECIFIED", "SPAN_KIND_INTERNAL",
+              "SPAN_KIND_SERVER", "SPAN_KIND_CLIENT",
+              "SPAN_KIND_PRODUCER", "SPAN_KIND_CONSUMER")
+_STATUS_STRS = ("STATUS_CODE_UNSET", "STATUS_CODE_OK", "STATUS_CODE_ERROR")
+
+
+def _span_value(span: dict, key: str):
+    """Resolve a policy key on a span dict, mirroring the vectorized
+    engine's scoping (`utils/spanfilter._match_one`)."""
+    if key in ("kind", "span.kind"):
+        k = int(span.get("kind", 0) or 0)
+        return _KIND_STRS[k] if 0 <= k < len(_KIND_STRS) else _KIND_STRS[0]
+    if key in ("status", "span.status", "status.code"):
+        c = int(span.get("status_code", 0) or 0)
+        return _STATUS_STRS[c] if 0 <= c < 3 else _STATUS_STRS[0]
+    if key in ("name", "span.name"):
+        return span.get("name", "")
+    if key.startswith("resource."):
+        return (span.get("res_attrs") or {}).get(key[len("resource."):])
+    if key.startswith("span."):
+        return (span.get("attrs") or {}).get(key[len("span."):])
+    return (span.get("attrs") or {}).get(key)
+
+
+def _policy_matches(span: dict, pm: dict) -> bool:
+    """Every attribute of the PolicyMatch must match (spanfilter.go:53)."""
+    import re
+
+    regex = pm.get("match_type") == "regex"
+    for am in pm.get("attributes", ()):
+        have = _span_value(span, str(am.get("key", "")))
+        if have is None:
+            return False
+        want = str(am.get("value", ""))
+        if regex:
+            if not re.fullmatch(want, str(have)):
+                return False
+        elif str(have) != want:
+            return False
+    return True
 
 
 def _span_matches(span: dict, wants: dict) -> bool:
@@ -39,13 +88,23 @@ def _span_matches(span: dict, wants: dict) -> bool:
     return True
 
 
-def keep_span(span: dict, flt: dict) -> bool:
-    inc = flt.get("include")
+def keep_span(span: dict, flt: dict,
+              policies: "Sequence[dict] | None" = None) -> bool:
+    inc = flt.get("include") if flt else None
     if inc and not _span_matches(span, inc):
         return False
-    exc = flt.get("exclude")
+    exc = flt.get("exclude") if flt else None
     if exc and _span_matches(span, exc):
         return False
+    # policy semantics: kept iff for EVERY policy (include absent or
+    # matched) and (exclude absent or not matched)
+    for p in policies or ():
+        pinc = p.get("include")
+        if pinc and not _policy_matches(span, pinc):
+            return False
+        pexc = p.get("exclude")
+        if pexc and _policy_matches(span, pexc):
+            return False
     return True
 
 
@@ -102,7 +161,16 @@ class Forwarder:
 
     def __init__(self, cfg: ForwarderConfig,
                  sink: Callable[[Sequence[dict]], None] | None = None) -> None:
+        import re
+
         self.cfg = cfg
+        # validate regex policies at REGISTRATION, where a config error
+        # belongs — not per span on the ingest path
+        for p in cfg.filter_policies or ():
+            for pm in (p.get("include"), p.get("exclude")):
+                if pm and pm.get("match_type") == "regex":
+                    for am in pm.get("attributes", ()):
+                        re.compile(str(am.get("value", "")))
         self.sink = sink or http_sink(cfg.endpoint)
         self._q: queue.Queue = queue.Queue(maxsize=cfg.queue_size)
         self.dropped = 0
@@ -112,8 +180,16 @@ class Forwarder:
         self._thread.start()
 
     def offer(self, spans: Sequence[dict]) -> None:
-        if self.cfg.filter:
-            spans = [s for s in spans if keep_span(s, self.cfg.filter)]
+        if self.cfg.filter or self.cfg.filter_policies:
+            try:
+                spans = [s for s in spans
+                         if keep_span(s, self.cfg.filter,
+                                      self.cfg.filter_policies)]
+            except Exception:
+                # the tee is best-effort and must NEVER fail ingest: a
+                # filter blow-up counts the batch as dropped
+                self.dropped += len(spans)
+                return
         if not spans:
             return
         try:

@@ -100,6 +100,33 @@ class _Services:
             context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         return b""   # empty PostSpansResponse
 
+    # -- opencensus agent trace service (legacy reporter protocol) ----------
+
+    def opencensus_export(self, request_iterator, context):
+        """`opencensus.proto.agent.trace.v1.TraceService/Export` (bidi
+        stream): Node/Resource arrive on the first message and persist
+        for the stream; spans on every message. Last of the reference
+        shim's receiver protocols (`shim.go:165-171`)."""
+        tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
+        from tempo_tpu.distributor.distributor import RateLimited
+        from tempo_tpu.model.opencensus import spans_from_opencensus
+
+        service = ""
+        res_attrs: dict = {}
+        for request in request_iterator:
+            try:
+                spans, service, res_attrs = spans_from_opencensus(
+                    request, service, res_attrs)
+            except ValueError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            if spans:
+                try:
+                    self.app.distributor.push_spans(tenant, spans)
+                except RateLimited as e:
+                    context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                                  str(e))
+            yield b""   # empty ExportTraceServiceResponse per message
+
     # -- Pusher (ingester) --------------------------------------------------
 
     def push_bytes_v2(self, request: bytes, context) -> bytes:
@@ -365,6 +392,9 @@ def build_grpc_server(app, address: str = "127.0.0.1:0",
         server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
             "jaeger.api_v2.CollectorService",
             {"PostSpans": unary(svc.jaeger_post_spans)}),))
+        server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
+            "opencensus.proto.agent.trace.v1.TraceService",
+            {"Export": bidi(svc.opencensus_export)}),))
     if app.ingester is not None:
         server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
             "tempopb.Pusher",

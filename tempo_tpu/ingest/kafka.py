@@ -362,14 +362,23 @@ class KafkaBus:
         of the bootstrap connection first and then any previously-known
         broker (the bootstrap broker itself may be the dead one). Total
         failure leaves the maps unchanged."""
-        with self._meta_lock:
-            fallbacks = [a for a in self._brokers.values()]
-        for conn in [self._conn] + [self._conn_to(a) for a in fallbacks]:
+        for conn in self._candidate_conns():
             try:
                 self._refresh_via(conn)
                 return
             except Exception:
                 continue             # keep old maps; next candidate
+
+    def _candidate_conns(self) -> "list[_Conn]":
+        """Bootstrap connection first, then every known broker (deduped
+        against the bootstrap address) — shared by metadata refresh and
+        coordinator discovery so both heal around any single dead
+        broker."""
+        with self._meta_lock:
+            fallbacks = list(self._brokers.values())
+        boot = set(self._conn.addrs)
+        return [self._conn] + [self._conn_to(a) for a in fallbacks
+                               if a not in boot]
 
     def _refresh_via(self, conn: _Conn) -> None:
         r = _R(conn.request(3, 1, _i32(1) + _string(self.topic)))
@@ -414,12 +423,9 @@ class KafkaBus:
             addr = self._coord
         if addr is None or force:
             addr = None
-            # like refresh_metadata: ask the bootstrap connection first,
-            # then any known broker — the bootstrap broker may be the
-            # dead one (the blockbuilder's offsets must survive that)
-            with self._meta_lock:
-                fallbacks = list(self._brokers.values())
-            for conn in [self._conn] + [self._conn_to(a) for a in fallbacks]:
+            # same candidate order as refresh_metadata: the bootstrap
+            # broker may be the dead one (blockbuilder offsets survive)
+            for conn in self._candidate_conns():
                 try:
                     r = _R(conn.request(10, 1, _string(group) + _i8(0)))
                     r.i32()                      # throttle

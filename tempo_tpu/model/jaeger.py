@@ -412,7 +412,9 @@ def spans_from_jaeger_proto(data: bytes, wrapped: bool = True) -> list[dict]:
             elif not s["service"]:
                 s["service"] = s["res_attrs"].get("service.name", "")
         return out
-    except (ValueError, struct.error, IndexError, KeyError) as e:
+    except (ValueError, TypeError, struct.error, IndexError, KeyError) as e:
+        # TypeError: a message-typed field encoded as a varint decodes to
+        # int and memoryview()/iter_fields() reject it
         raise ValueError(f"malformed jaeger proto payload: {e}") from None
 
 

@@ -350,3 +350,60 @@ def test_jaeger_grpc_post_spans(grpc_cluster):
     res = apps["query"].frontend.search(
         "single-tenant", '{ status = error && name = "jgrpc-op" }')
     assert len(res) == 1 and res[0].trace_id == "ef" * 16
+
+
+def test_opencensus_grpc_export(grpc_cluster):
+    """OC agent TraceService/Export (bidi): Node+Resource on the first
+    message persist for the stream; spans land and are searchable
+    (shim.go:165-171 opencensus receiver)."""
+    from tempo_tpu.model import proto_wire as pw
+
+    apps, ports = grpc_cluster
+    t0 = int((time.time() - 5) * 1e9)
+
+    def ts(ns):
+        return pw.enc_field_varint(1, ns // 10**9) + \
+            pw.enc_field_varint(2, ns % 10**9)
+
+    def trunc(s):
+        return pw.enc_field_msg(1, s.encode()) if False else \
+            pw.enc_field_str(1, s)
+
+    def attr(k, v):
+        av = pw.enc_field_msg(1, trunc(v)) if isinstance(v, str) else \
+            pw.enc_field_varint(2, v)
+        return pw.enc_field_msg(1, pw.enc_field_str(1, k) +
+                                pw.enc_field_msg(2, av))
+
+    tid = bytes.fromhex("1b" * 16)
+    span = (pw.enc_field_bytes(1, tid) +
+            pw.enc_field_bytes(2, bytes.fromhex("2c" * 8)) +
+            pw.enc_field_msg(5, trunc("oc-op")) +
+            pw.enc_field_varint(6, 1) +              # OC SERVER
+            pw.enc_field_msg(7, ts(t0)) +
+            pw.enc_field_msg(8, ts(t0 + 25_000_000)) +
+            pw.enc_field_msg(9, attr("oc.key", "v1")) +
+            pw.enc_field_msg(13, pw.enc_field_varint(1, 5)))  # status !=0
+    node = pw.enc_field_msg(3, pw.enc_field_str(1, "oc-svc"))
+    first = pw.enc_field_msg(1, node) + pw.enc_field_msg(2, span)
+    # second message: spans only (node persists)
+    span2 = (pw.enc_field_bytes(1, tid) +
+             pw.enc_field_bytes(2, bytes.fromhex("3d" * 8)) +
+             pw.enc_field_msg(5, trunc("oc-op2")) +
+             pw.enc_field_msg(7, ts(t0)) +
+             pw.enc_field_msg(8, ts(t0 + 1_000_000)))
+    second = pw.enc_field_msg(2, span2)
+
+    with grpc.insecure_channel(f"127.0.0.1:{ports['dist']}") as ch:
+        export = ch.stream_stream(
+            "/opencensus.proto.agent.trace.v1.TraceService/Export")
+        responses = list(export(iter([first, second]), timeout=10))
+        assert len(responses) == 2
+
+    spans = apps["query"].frontend.find_trace("single-tenant", tid)
+    assert spans and len(spans) == 2
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["oc-op"]["kind"] == 2          # OC SERVER → OTel SERVER
+    assert by_name["oc-op"]["status_code"] == 2   # nonzero code → ERROR
+    assert by_name["oc-op"]["attrs"]["oc.key"] == "v1"
+    assert by_name["oc-op2"]["service"] == "oc-svc"   # node persisted

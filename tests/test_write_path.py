@@ -359,3 +359,62 @@ def test_columnar_push_matches_dict_path(rig):
         ka = sorted((s["span_id"], s["name"]) for s in a)
         kb = sorted((s["span_id"], s["name"]) for s in b)
         assert ka == kb
+
+
+def test_kafka_receiver_consumes_topic(rig):
+    """Kafka receiver (shim.go:165-171 "kafka"): OTLP payloads produced
+    to a topic by an external pipeline are consumed into the distributor;
+    offsets commit after the push (at-least-once)."""
+    from tempo_tpu.distributor.receiver_kafka import (KafkaReceiver,
+                                                      KafkaReceiverConfig)
+    from tempo_tpu.ingest.bus import Bus
+    from tempo_tpu.model.otlp import encode_spans_otlp
+
+    t, now, backend, ring, ingesters, dist = rig
+    bus = Bus(n_partitions=2)
+    spans = [mkspan(bytes([40]) * 16, bytes([1]) * 8, name="kr-op",
+                    res_attrs={"service.name": "kr-svc"})]
+    bus.produce(0, "t1", encode_spans_otlp(spans))
+    bus.produce(1, "t1", encode_spans_otlp(
+        [mkspan(bytes([41]) * 16, bytes([2]) * 8, name="kr-op2")]))
+    rx = KafkaReceiver(bus, dist, KafkaReceiverConfig(partitions=(0, 1)))
+    assert rx.run_once() == 2
+    held = sum(1 for ing in ingesters.values()
+               if ing.find_trace_by_id("t1", bytes([40]) * 16))
+    assert held == 3                       # RF3 replication applied
+    assert bus.committed(rx.cfg.group, 0) == 1
+    assert bus.committed(rx.cfg.group, 1) == 1
+    assert rx.run_once() == 0              # nothing new: offsets held
+
+
+def test_forwarder_filter_policies(rig):
+    """pkg/spanfilter-shaped per-tenant policies on the forwarder tee
+    (the OTTL-filter analog): regex include + strict exclude."""
+    from tempo_tpu.distributor.forwarder import (Forwarder,
+                                                 ForwarderConfig)
+
+    t, now, backend, ring, ingesters, dist = rig
+    got: list = []
+    fwd = Forwarder(
+        ForwarderConfig(
+            name="f1",
+            filter_policies=[{
+                "include": {"match_type": "regex",
+                            "attributes": [{"key": "span.name",
+                                            "value": "keep-.*"}]},
+                "exclude": {"match_type": "strict",
+                            "attributes": [{"key": "span.kind",
+                                            "value": "SPAN_KIND_CLIENT"}]},
+            }]),
+        sink=got.extend)
+    dist.forwarders.register("t1", fwd)
+    spans = [
+        mkspan(bytes([50]) * 16, bytes([1]) * 8, name="keep-a", kind=2),
+        mkspan(bytes([51]) * 16, bytes([2]) * 8, name="keep-b", kind=3),
+        mkspan(bytes([52]) * 16, bytes([3]) * 8, name="drop-c", kind=2),
+    ]
+    dist.push_spans("t1", spans)
+    fwd.flush()
+    fwd.shutdown()
+    names = sorted(s["name"] for s in got)
+    assert names == ["keep-a"], names      # regex kept keep-*, CLIENT excluded
