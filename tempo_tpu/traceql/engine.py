@@ -135,10 +135,20 @@ def execute_search(
     """
     q = parse(query) if isinstance(query, str) else query
     combiner = MetadataCombiner(limit)
+    simple = bool(q.stages) and all(isinstance(s, A.SpansetFilter)
+                                    for s in q.stages)
     for view, cand in view_iter:
         if len(cand) == 0:
             continue
-        spansets = evaluate_pipeline(q, view)
+        if simple:
+            # all-filter pipeline: one vectorized mask + reduceat ranking
+            # replaces the per-trace Spanset loop; only the top-`limit`
+            # traces materialize Python objects (the second-pass analog
+            # of the pre-pass below, pulled before object construction)
+            spansets = _simple_filter_spansets(q, view, limit,
+                                               start_ns, end_ns)
+        else:
+            spansets = evaluate_pipeline(q, view)
         if not spansets:
             continue
         # Vectorized pre-pass: per-spanset time bounds via one reduceat,
@@ -184,6 +194,48 @@ def execute_search(
         if combiner.exhausted():
             break
     return combiner.results()
+
+
+def _simple_filter_spansets(q: A.Pipeline, view: ColumnView, limit: int,
+                            start_ns: int, end_ns: int) -> list[Spanset]:
+    """Top-`limit` spansets of an all-SpansetFilter pipeline, fully
+    vectorized: sequential filter stages compose to a mask intersection,
+    trace grouping is a reduceat over the (trace-aligned) row order, and
+    ranking matches the combiner's most-recent-start key exactly."""
+    from tempo_tpu.traceql.eval import eval_expr
+
+    st = view.meta.get("start_unix_nano")
+    dur = view.meta.get("duration_ns")
+    if st is None or dur is None:
+        return evaluate_pipeline(q, view)     # in-memory view: slow path
+    mask = None
+    for s in q.stages:
+        m = eval_expr(view, s.expr).bool_mask()
+        mask = m if mask is None else mask & m
+    rows = np.flatnonzero(mask)
+    if len(rows) == 0:
+        return []
+    keys = view.trace_idx[rows]
+    if len(keys) > 1 and not (np.diff(keys) >= 0).all():
+        order = np.argsort(keys, kind="stable")
+        rows, keys = rows[order], keys[order]
+    starts = np.concatenate([[0], np.flatnonzero(np.diff(keys)) + 1])
+    ends = np.concatenate([starts[1:], [len(rows)]])
+    t0s = np.minimum.reduceat(st[rows], starts).astype(np.int64)
+    t1s = np.maximum.reduceat(st[rows] + dur[rows], starts).astype(np.int64)
+    ok = np.ones(len(starts), bool)
+    if start_ns:
+        ok &= t1s >= start_ns
+    if end_ns:
+        ok &= t0s < end_ns
+    sel = np.flatnonzero(ok)
+    if len(sel) == 0:
+        return []
+    top = np.sort(sel[np.argsort(-t0s[sel], kind="stable")[:limit]])
+    # ascending (scan) order: the combiner breaks equal-start ties by
+    # insertion order, so emission order must match the per-trace path
+    return [Spanset(int(keys[starts[i]]), rows[starts[i]:ends[i]])
+            for i in top.tolist()]
 
 
 def _trace_metadata(view: ColumnView, ss: Spanset,
