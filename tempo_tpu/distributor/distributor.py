@@ -36,6 +36,9 @@ class IngesterClient(Protocol):
 
 
 class GeneratorClient(Protocol):
+    # in-process implementations may set accepts_local_trust = True and
+    # take push_otlp(..., trusted=True) for bytes validated in THIS
+    # process; remote clients must not (their process re-validates)
     def push_otlp(self, tenant: str, data: bytes) -> int: ...
 
 
@@ -301,8 +304,16 @@ class Distributor:
         if self.generator_ring is not None and self.generator_clients \
                 and lim.generator.processors:
             def send_gen(inst: InstanceDesc, items: list[int]) -> None:
-                self.generator_clients[inst.id].push_otlp(
-                    tenant, payload_for(items))
+                client = self.generator_clients[inst.id]
+                if getattr(client, "accepts_local_trust", False):
+                    # in-process generator (explicit marker — never
+                    # inferred): these bytes already passed this process's
+                    # scan validation, so the stage may trust them. Remote
+                    # clients re-validate at their own process boundary.
+                    client.push_otlp(tenant, payload_for(items),
+                                     trusted=True)
+                else:
+                    client.push_otlp(tenant, payload_for(items))
 
             try:
                 do_batch(self.generator_ring, tokens,

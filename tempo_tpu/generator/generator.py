@@ -21,6 +21,10 @@ from tempo_tpu.overrides import Overrides
 
 
 class Generator:
+    # the distributor's in-process tee may pass trusted=True to push_otlp
+    # (bytes validated by its own scan); see GeneratorClient protocol
+    accepts_local_trust = True
+
     def __init__(self, cfg: GeneratorConfig | None = None,
                  overrides: Overrides | None = None,
                  instance_id: str = "generator-0",
@@ -74,12 +78,16 @@ class Generator:
                 res_attrs=s.get("res_attrs"))
         inst.push_batch(b.build())
 
-    def push_otlp(self, tenant: str, data: bytes) -> int:
+    def push_otlp(self, tenant: str, data: bytes,
+                  trusted: bool = False) -> int:
         """OTLP ExportTraceServiceRequest bytes → series state, staged by
         the vectorized native-scan path. The reference's PushSpansRequest
         carries OTLP-shaped ResourceSpans (`tempo.proto` PushSpansRequest),
         so raw-OTLP ingest at the generator is wire-parity, minus the
-        per-span Python staging. Returns span count."""
+        per-span Python staging. Returns span count. `trusted` marks bytes
+        already validated IN THIS PROCESS (the distributor's tee): the
+        stage may skip re-validating attribute bytes; never set it for
+        wire input."""
         from tempo_tpu.model.otlp_batch import batch_from_otlp
 
         inst = self.instance(tenant)
@@ -87,7 +95,8 @@ class Generator:
         sb, sizes = batch_from_otlp(data, inst.registry.interner,
                                     return_sizes=True,
                                     include_span_attrs=need_span,
-                                    include_res_attrs=need_res)
+                                    include_res_attrs=need_res,
+                                    trusted=trusted)
         inst.push_batch(sb, span_sizes=sizes)
         return sb.n
 

@@ -36,7 +36,8 @@ _MAX_RES_ATTRS = 32
 def batch_from_otlp(data: bytes, interner: StringInterner,
                     return_sizes: bool = False,
                     include_span_attrs: bool = True,
-                    include_res_attrs: bool = True):
+                    include_res_attrs: bool = True,
+                    trusted: bool = False):
     """OTLP ExportTraceServiceRequest bytes → SpanBatch.
 
     Uses the one-pass C++ staging kernel when the native layer is
@@ -57,7 +58,8 @@ def batch_from_otlp(data: bytes, interner: StringInterner,
         else None
     if nat is not None:
         staged = native.otlp_stage(nat, data,
-                                   skip_span_attrs=not include_span_attrs)
+                                   skip_span_attrs=not include_span_attrs,
+                                   trust_attrs=trusted)
         if staged is not None:
             return _batch_from_staged(data, interner, staged, return_sizes,
                                       include_span_attrs, include_res_attrs)

@@ -487,20 +487,24 @@ class NativeRowTable:
 
 
 def otlp_stage(interner: "NativeInterner", data: bytes,
-               cap_hint: int = 4096, skip_span_attrs: bool = False):
+               cap_hint: int = 4096, skip_span_attrs: bool = False,
+               trust_attrs: bool = False):
     """One-pass OTLP bytes → interned columns.
 
     Returns (spans StageRec[], span_attrs StageAttr[], res_attrs
     StageAttr[], resources StageRes[]) or None when the native library is
     unavailable. Raises ValueError on malformed input. With
     `skip_span_attrs` the scan validates span attributes but neither
-    interns nor emits them (intrinsic-dims-only callers)."""
+    interns nor emits them (intrinsic-dims-only callers); `trust_attrs`
+    additionally skips that validation — ONLY for bytes already validated
+    in this process (the distributor's in-process tee)."""
     lib = _load()
     if lib is None:
         return None
     buf = np.frombuffer(data, np.uint8)
     bp = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
-    flags = 1 if skip_span_attrs else 0
+    flags = (1 if skip_span_attrs else 0) | \
+        (2 if trust_attrs and skip_span_attrs else 0)
     cap = max(cap_hint, 16)
     acap = 16 if skip_span_attrs else cap * 4
     rcap, rescap = 256, 64

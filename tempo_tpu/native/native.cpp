@@ -945,7 +945,7 @@ static bool stage_resource(StageCtx& c, const uint8_t* rm, uint64_t rmlen,
 
 static bool stage_span(StageCtx& c, const uint8_t* sp, uint64_t splen,
                        int32_t res_idx, int32_t service_id,
-                       bool skip_attrs) {
+                       bool skip_attrs, bool trust_attrs) {
     StageRec rec;
     memset(&rec, 0, sizeof(rec));
     rec.name_id = c.empty_id;
@@ -971,12 +971,16 @@ static bool stage_span(StageCtx& c, const uint8_t* sp, uint64_t splen,
             case 8: if (w != 2) rec.end_ns = v; break;
             case 9: {
                 if (skip_attrs) {
-                    // caller's processors never read span attrs: validate
-                    // the bytes (decoder contract) without interning or
-                    // storing — the offset-based parser does exactly that
-                    AttrRec scratch;
-                    if (!parse_keyvalue(c.buf, s, l, span_idx, scratch))
-                        return false;
+                    // caller's processors never read span attrs. When the
+                    // bytes were already validated upstream in-process
+                    // (the distributor's scan — trust_attrs), skip even
+                    // the validation walk; else validate without
+                    // interning or storing
+                    if (!trust_attrs) {
+                        AttrRec scratch;
+                        if (!parse_keyvalue(c.buf, s, l, span_idx, scratch))
+                            return false;
+                    }
                     break;
                 }
                 StageAttr a;
@@ -1058,7 +1062,7 @@ int32_t otlp_stage(void* interner, const uint8_t* buf, int64_t buflen,
             while (read_field(ss, f3, w3, v3, s3, l3)) {
                 if (f3 != 2 || w3 != 2) continue;  // Span
                 if (!stage_span(c, s3, l3, res_idx, r.service_id,
-                                (flags & 1) != 0))
+                                (flags & 1) != 0, (flags & 2) != 0))
                     return -1;
             }
             if (!ss.ok) return -1;
