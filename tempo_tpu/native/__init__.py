@@ -315,9 +315,12 @@ def group_keys(keys: np.ndarray) -> "tuple[np.ndarray, np.ndarray] | None":
 
 _SCAN_THREADS = min(8, os.cpu_count() or 1)
 _SCAN_MT_BYTES = 256 << 10        # payloads below this stay single-thread
+# adaptive capacity hints: start where the last payload ended so steady
+# traffic never pays the scan-twice-regrow pass
+_CAP_HINTS: dict = {}
 
 
-def otlp_scan(data: bytes, cap_hint: int = 4096) -> np.ndarray | None:
+def otlp_scan(data: bytes, cap_hint: "int | None" = None) -> np.ndarray | None:
     """Single-pass OTLP proto scan → SpanRec structured array.
 
     Large payloads fan ResourceSpans ranges across threads (the GIL is
@@ -331,10 +334,14 @@ def otlp_scan(data: bytes, cap_hint: int = 4096) -> np.ndarray | None:
         return None
     buf = np.frombuffer(data, np.uint8)
     bp = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
-    cap = max(cap_hint, 16)
+    # an EXPLICIT cap_hint is honored exactly (tests exercise the regrow
+    # branch with it); only the default consults the adaptive hint
+    cap = cap_hint if cap_hint is not None else max(
+        _CAP_HINTS.get("scan", 4096), 16)
+    cap = max(cap, 16)
     mt = len(data) >= _SCAN_MT_BYTES and _SCAN_THREADS > 1
     while True:
-        recs = np.zeros(cap, SPAN_REC_DTYPE)
+        recs = np.empty(cap, SPAN_REC_DTYPE)   # scan fills every used rec
         if mt:
             n = lib.otlp_scan_mt(bp, len(data), recs.ctypes.data, cap,
                                  _SCAN_THREADS)
@@ -343,6 +350,10 @@ def otlp_scan(data: bytes, cap_hint: int = 4096) -> np.ndarray | None:
         if n < 0:
             raise ValueError("malformed OTLP protobuf payload")
         if n <= cap:
+            _CAP_HINTS["scan"] = int(n)
+            if n * 4 < cap:
+                # don't let a small result pin a hint-inflated buffer
+                return recs[:n].copy()
             return recs[:n]
         cap = int(n)
 
@@ -487,7 +498,7 @@ class NativeRowTable:
 
 
 def otlp_stage(interner: "NativeInterner", data: bytes,
-               cap_hint: int = 4096, skip_span_attrs: bool = False,
+               cap_hint: "int | None" = None, skip_span_attrs: bool = False,
                trust_attrs: bool = False):
     """One-pass OTLP bytes → interned columns.
 
@@ -505,14 +516,18 @@ def otlp_stage(interner: "NativeInterner", data: bytes,
     bp = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
     flags = (1 if skip_span_attrs else 0) | \
         (2 if trust_attrs and skip_span_attrs else 0)
-    cap = max(cap_hint, 16)
-    acap = 16 if skip_span_attrs else cap * 4
+    cap = cap_hint if cap_hint is not None else max(
+        _CAP_HINTS.get("stage", 4096), 16)
+    cap = max(cap, 16)
+    acap = 16 if skip_span_attrs else max(
+        cap * 4, _CAP_HINTS.get("stage_attrs", 64))
     rcap, rescap = 256, 64
     while True:
-        spans = np.zeros(cap, STAGE_REC_DTYPE)
-        sattrs = np.zeros(acap, STAGE_ATTR_DTYPE)
-        rattrs = np.zeros(rcap, STAGE_ATTR_DTYPE)
-        res = np.zeros(rescap, STAGE_RES_DTYPE)
+        # stage fills every record it emits: empty alloc, no MB memsets
+        spans = np.empty(cap, STAGE_REC_DTYPE)
+        sattrs = np.empty(acap, STAGE_ATTR_DTYPE)
+        rattrs = np.empty(rcap, STAGE_ATTR_DTYPE)
+        res = np.empty(rescap, STAGE_RES_DTYPE)
         n_out = np.zeros(4, np.int64)
         rc = lib.otlp_stage(
             interner._h, bp, len(data),
@@ -523,7 +538,13 @@ def otlp_stage(interner: "NativeInterner", data: bytes,
             raise ValueError("malformed OTLP protobuf payload")
         ns, na, nr, nres = (int(x) for x in n_out)
         if ns <= cap and na <= acap and nr <= rcap and nres <= rescap:
-            return spans[:ns], sattrs[:na], rattrs[:nr], res[:nres]
+            _CAP_HINTS["stage"] = ns
+            if not skip_span_attrs:
+                _CAP_HINTS["stage_attrs"] = na
+            out = (spans[:ns], sattrs[:na], rattrs[:nr], res[:nres])
+            if ns * 4 < cap:
+                out = tuple(a.copy() for a in out)
+            return out
         cap, acap = max(cap, ns), max(acap, na)
         rcap, rescap = max(rcap, nr), max(rescap, nres)
 

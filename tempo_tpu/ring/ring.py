@@ -72,7 +72,7 @@ class _RingState:
     fresh ids with stale owners (ADVICE r2 #1)."""
 
     __slots__ = ("instances", "ids", "tokens", "owners", "walk_cache",
-                 "shuffle_ids", "shuffle_rings", "fingerprint")
+                 "shuffle_ids", "shuffle_rings", "fingerprint", "set_cache")
 
     def __init__(self, instances: dict[str, InstanceDesc]) -> None:
         self.instances = instances
@@ -110,6 +110,11 @@ class _RingState:
         # (tenant, size) -> sub-Ring built from THIS snapshot's descs
         # (never shared: health reads the current heartbeat_ts)
         self.shuffle_rings: dict[tuple[str, int], "Ring"] = {}
+        # (pos, rf) -> (built_at, ReplicationSet): health-FILTERED sets,
+        # so entries expire on a short TTL (heartbeat timeouts are
+        # seconds-granular; rebuilding per batch_lookup call was the
+        # distributor hot path's biggest python cost)
+        self.set_cache: dict[tuple[int, int], tuple[float, object]] = {}
 
     def walk_from(self, start: int, rf: int) -> list[InstanceDesc]:
         """Clockwise walk from ring position `start` collecting rf distinct
@@ -234,7 +239,19 @@ class Ring:
         return self._state.walk(token, rf)
 
     def _set_at(self, st: _RingState, pos: int, rf: int) -> ReplicationSet:
-        """ReplicationSet for ring position `pos`, health-filtered now."""
+        """ReplicationSet for ring position `pos`, health-filtered (cached
+        on the snapshot for 0.5s — see _RingState.set_cache)."""
+        key = (pos, rf)
+        cached = st.set_cache.get(key)
+        now = self.now()
+        if cached is not None and now - cached[0] < 0.5:
+            return cached[1]
+        rs = self._set_at_uncached(st, pos, rf)
+        st.set_cache[key] = (now, rs)
+        return rs
+
+    def _set_at_uncached(self, st: _RingState, pos: int,
+                         rf: int) -> ReplicationSet:
         full = [st.instances[iid] for iid in st.walk_members(pos, rf)]
         if not full:
             # an empty ring can never satisfy quorum — failing loudly beats
@@ -278,6 +295,12 @@ class Ring:
             if len(tokens):
                 raise RuntimeError("ring is empty: no instances registered")
             return [], np.zeros(0, np.int64)
+        if len(tokens) == 0:
+            return [], np.zeros(0, np.int64)
+        if len(st.instances) == 1:
+            # one registrant owns every token: no per-token position math
+            return ([self._set_at(st, 0, rf)],
+                    np.zeros(len(tokens), np.int64))
         pos = np.searchsorted(st.tokens, tokens, side="left") \
             % len(st.tokens)
         if len(tokens) * 4 >= len(st.tokens):
