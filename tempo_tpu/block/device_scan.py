@@ -233,19 +233,24 @@ def _icmp(jnp, op: A.Op, hi, lo, lh, ll):
     return (hi < lh) | ((hi == lh) & (lo <= ll))
 
 
-def _term_masks(jnp, sig: tuple, args, n: int):
+def _term_masks(jnp, sig: tuple, args, n: int, ivec, ibase: int):
     """Evaluate each term of a plan signature → list of bool vectors.
 
-    Term shapes (args consumed left to right):
-      ("lut", neg, has_ex)    codes, lut, [exists]
-      ("icmp", op, has_ex)    hi, lo, lh, ll, [exists]
-      ("nil", want, has_ex)   [exists]   (x = nil / x != nil)
+    Device arrays ride in `args` (consumed left to right); EVERY scalar
+    literal is an element of the single packed int32 vector `ivec`
+    (starting at `ibase`) — one H2D transfer per call however many
+    predicates the plan holds, which is what makes the plane win behind
+    a high-latency device link. Term shapes:
+      ("lut", neg, has_ex)    args: codes, lut, [exists]
+      ("icmp", op, has_ex)    args: hi, lo, [exists]; ivec: lh, ll
+      ("nil", want, has_ex)   args: [exists]   (x = nil / x != nil)
       ("const", val)          —
     Missing attributes never match (exists ANDs after negation), matching
     `Col.bool_mask` in the numpy plane.
     """
     out = []
     i = 0
+    k = ibase
     for term in sig:
         kind = term[0]
         if kind == "lut":
@@ -260,9 +265,10 @@ def _term_masks(jnp, sig: tuple, args, n: int):
                 i += 1
         elif kind == "icmp":
             _, op, has_ex = term
-            hi, lo, lh, ll = args[i], args[i + 1], args[i + 2], args[i + 3]
-            i += 4
-            m = _icmp(jnp, op, hi, lo, lh, ll)
+            hi, lo = args[i], args[i + 1]
+            i += 2
+            m = _icmp(jnp, op, hi, lo, ivec[k], ivec[k + 1])
+            k += 2
             if has_ex:
                 m = m & args[i]
                 i += 1
@@ -277,7 +283,7 @@ def _term_masks(jnp, sig: tuple, args, n: int):
         else:                                    # ("const", val)
             m = jnp.full((n,), bool(term[1]))
         out.append(m)
-    return out, i
+    return out, i, k
 
 
 @functools.lru_cache(maxsize=128)
@@ -288,9 +294,10 @@ def _block_mask_kernel(n: int, pred_sig: tuple, extra_sig: tuple,
     import jax
     import jax.numpy as jnp
 
-    def fn(*args):
-        pred_masks, used = _term_masks(jnp, pred_sig, args, n)
-        extra_masks, _ = _term_masks(jnp, extra_sig, args[used:], n)
+    def fn(ivec, *args):
+        pred_masks, used, k = _term_masks(jnp, pred_sig, args, n, ivec, 0)
+        extra_masks, _, _ = _term_masks(jnp, extra_sig, args[used:], n,
+                                        ivec, k)
         mask = None
         for m in pred_masks:
             mask = m if mask is None else (mask & m if all_conditions
@@ -414,6 +421,30 @@ def device_pred_mask(view, preds: Sequence, all_conditions: bool
 # ---------------------------------------------------------------------------
 # the production block plane
 # ---------------------------------------------------------------------------
+
+class GridHandle:
+    """An in-flight fused metrics grid: the dispatch is async; fetch()
+    performs the single packed D2H and unpacks (labels, main, cnt, vcnt).
+    Callers launch every block's grid before fetching any, so N blocks
+    pipeline their device round trips instead of serializing them."""
+
+    __slots__ = ("labels", "_packed", "_main_shape", "_cnt_shape")
+
+    def __init__(self, labels, packed, main_shape, cnt_shape):
+        self.labels = labels
+        self._packed = packed
+        self._main_shape = main_shape
+        self._cnt_shape = cnt_shape
+
+    def fetch(self):
+        flat = np.asarray(self._packed)
+        m = int(np.prod(self._main_shape))
+        c = int(np.prod(self._cnt_shape))
+        main = flat[:m].reshape(self._main_shape)
+        cnt = flat[m:m + c].reshape(self._cnt_shape)
+        vcnt = flat[m + c:].reshape(self._cnt_shape)
+        return self.labels, main, cnt, vcnt
+
 
 def _fmt_group_labels(values: np.ndarray, t: str) -> tuple[np.ndarray, list]:
     """Factorize a host column into int32 codes + formatted label strings,
@@ -655,18 +686,18 @@ class BlockScanPlane:
         # nil comparisons prune on the existence mask alone
         if getattr(static, "type", None) == A.StaticType.NIL:
             if c.op not in (A.Op.EQ, A.Op.NEQ):
-                return (("const", False), [])
+                return (("const", False), [], [])
             host = self._host_col(c.attr)
             if host is None:
                 return None
             want = c.op == A.Op.NEQ
             if host.exists.all():
-                return (("const", want), [])
+                return (("const", want), [], [])
             with self._lock:
                 ex = self._cols.get(("ex", c.attr))
                 if ex is None:
                     ex = self._cols[("ex", c.attr)] = self._up(host.exists)
-            return (("nil", want, True), [ex])
+            return (("nil", want, True), [ex], [])
         lit_t = _STATIC_T.get(getattr(static, "type", None))
         if lit_t is None:
             return None
@@ -678,17 +709,37 @@ class BlockScanPlane:
                 # and mixed columns fall back to the host plane
                 host = self._host_col(c.attr)
                 if host is not None and host.t in (NUM, STATUS, KIND, BOOL):
-                    return (("const", False), [])
+                    return (("const", False), [], [])
                 return None
-            term = _dict_term(c.op, v, ent[2])
-            if term is None:
-                return None
-            (kind, _, neg), lut = term
+            # the uploaded lut is cached per (attr, op, value): repeated
+            # queries pay ZERO H2D transfers for their predicates. The
+            # cache stores (neg, lut) so _dict_term stays the single
+            # source of negation truth; entries are budget-accounted and
+            # capacity-capped (high-cardinality literal workloads must
+            # not grow device memory unboundedly)
+            lkey = ("plut", c.attr, c.op, v)
+            with self._lock:
+                cached = self._cols.get(lkey)
+            if cached is None:
+                term = _dict_term(c.op, v, ent[2])
+                if term is None:
+                    return None
+                (kind, _, neg), lut = term
+                lut_dev = self._up(lut)
+                with self._lock:
+                    pluts = [k for k in self._cols if k[0] == "plut"]
+                    if len(pluts) >= 256:
+                        for k in pluts[:128]:
+                            arr = self._cols.pop(k)[1]
+                            self.device_bytes -= int(arr.nbytes)
+                    self._cols[lkey] = (neg, lut_dev)
+            else:
+                neg, lut_dev = cached
             has_ex = ent[3] is not None
-            args = [ent[1], jnp.asarray(lut)]
+            args = [ent[1], lut_dev]
             if has_ex:
                 args.append(ent[3])
-            return (("lut", neg, has_ex), args)
+            return (("lut", neg, has_ex), args, [])
         # numeric-family literal
         if c.op not in _NUM_OPS:
             return None
@@ -696,37 +747,56 @@ class BlockScanPlane:
         if ent is None:
             host = self._host_col(c.attr)
             if host is not None and host.t == STR:
-                return (("const", False), [])    # str col vs num literal
+                return (("const", False), [], [])  # str col vs num literal
             return None                          # float col → host fallback
         _, hi, lo, ex, col_t = ent
         if col_t != lit_t:                       # distinct lattices → false
-            return (("const", False), [])
+            return (("const", False), [], [])
         norm = _int_literal(c.op, v if not isinstance(v, bool) else int(v))
         if norm[0] == "const":
-            return (("const", norm[1]), [])
+            return (("const", norm[1]), [], [])
         _, op2, lit = norm
         lh, ll = _split_lit(lit)
         has_ex = ex is not None
-        args = [hi, lo, jnp.int32(lh), jnp.int32(ll)]
+        args = [hi, lo]
         if has_ex:
             args.append(ex)
-        return (("icmp", op2, has_ex), args)
+        return (("icmp", op2, has_ex), args, [lh, ll])
 
     def _plan(self, preds: Sequence, all_conditions: bool):
-        sig, args = [], []
+        sig, args, ints = [], [], []
         for c in preds:
             got = self._plan_pred(c)
             if got is None:
                 return None
             sig.append(got[0])
             args.extend(got[1])
-        return tuple(sig), args
+            ints.extend(got[2])
+        return tuple(sig), args, ints
+
+    def _ensure_rg_lut(self, row_groups):
+        key = ("rglut", tuple(row_groups))
+        with self._lock:
+            got = self._cols.get(key)
+        if got is None:
+            import jax.numpy as jnp
+
+            lut = np.zeros(len(self.sizes), bool)
+            sel = [g for g in row_groups if 0 <= g < len(self.sizes)]
+            if sel:
+                lut[np.asarray(sel)] = True
+            got = jnp.asarray(lut)
+            with self._lock:
+                if len([k for k in self._cols if k[0] == "rglut"]) >= 64:
+                    for k in [k for k in self._cols if k[0] == "rglut"][:32]:
+                        del self._cols[k]
+                self._cols[key] = got
+        return got
 
     def _extra_terms(self, time_range, row_groups):
-        """Always-AND terms: exact time clip + row-group shard selection."""
-        import jax.numpy as jnp
-
-        sig, args = [], []
+        """Always-AND terms: exact time clip + row-group shard selection.
+        Returns (sig, device args, int literals)."""
+        sig, args, ints = [], [], []
         if time_range is not None and any(time_range):
             lo_ns, hi_ns = time_range
             if not self._ensure_times():
@@ -738,36 +808,37 @@ class BlockScanPlane:
             if lo_ns:
                 lh, ll = _split_lit(int(np.float64(lo_ns)))
                 sig.append(("icmp", A.Op.GTE, False))
-                args.extend([thi, tlo, jnp.int32(lh), jnp.int32(ll)])
+                args.extend([thi, tlo])
+                ints.extend([lh, ll])
             if hi_ns:
                 lh, ll = _split_lit(int(np.float64(hi_ns)))
                 sig.append(("icmp", A.Op.LT, False))
-                args.extend([thi, tlo, jnp.int32(lh), jnp.int32(ll)])
+                args.extend([thi, tlo])
+                ints.extend([lh, ll])
         if row_groups is not None:
-            lut = np.zeros(len(self.sizes), bool)
-            sel = [g for g in row_groups if 0 <= g < len(self.sizes)]
-            if sel:
-                lut[np.asarray(sel)] = True
             sig.append(("lut", None, False))
-            args.extend([self._ensure_rgids(), jnp.asarray(lut)])
-        return tuple(sig), args
+            args.extend([self._ensure_rgids(),
+                         self._ensure_rg_lut(row_groups)])
+        return tuple(sig), args, ints
 
     # -- masks --------------------------------------------------------------
 
     def mask_async(self, preds: Sequence, all_conditions: bool,
                    time_range=None, row_groups=None):
         """Launch the fused block mask; returns a device array (or None
-        when a predicate shape is unsupported). No sync, no D2H."""
+        when a predicate shape is unsupported). No sync, no D2H; a single
+        packed-literal H2D rides along with the call."""
         plan = self._plan(list(preds), all_conditions)
         if plan is None:
             return None
         extra = self._extra_terms(time_range, row_groups)
         if extra is None:
             return None
-        sig, args = plan
-        esig, eargs = extra
+        sig, args, ints = plan
+        esig, eargs, eints = extra
         fn = _block_mask_kernel(self.n, sig, esig, all_conditions)
-        return fn(*args, *eargs)
+        ivec = np.asarray(ints + eints, np.int32)
+        return fn(ivec, *args, *eargs)
 
     def mask(self, preds: Sequence, all_conditions: bool,
              time_range=None, row_groups=None) -> Optional[np.ndarray]:
@@ -794,13 +865,20 @@ class BlockScanPlane:
         `histogram_over_time` (ref `Log2Bucketize` engine_metrics.go:1392).
 
         `m` is the A.MetricsAggregate. Returns None when any shape is
-        unsupported (caller falls back to the host engine), else
+        unsupported (caller falls back to the host engine), else a
+        GridHandle whose fetch() yields
         (group_label_list, main_grid, obs_count_grid, value_count_grid):
           count/rate       main [G, steps] counts
           min/max/sum/avg  main [G, steps]
           quantile/hist    main [G, steps, 64] bucket counts
         obs counts gate series emission (group matched the filter);
         value counts back avg's companion `__meta: count` series.
+
+        Transfer economics (the plane must win through a high-latency
+        device link): per call, H2D is ONE packed int32 literal vector +
+        ONE packed f32 vector; D2H is ONE packed grid (the three grids
+        concatenate raveled). Launches are async — the caller launches
+        every block's grid before fetching any (`db/tempodb.py`).
         """
         import jax
         import jax.numpy as jnp
@@ -830,8 +908,8 @@ class BlockScanPlane:
         extra = self._extra_terms((clip_lo, clip_hi), row_groups)
         if extra is None:
             return None
-        sig, args = plan
-        esig, eargs = extra
+        sig, args, ints = plan
+        esig, eargs, eints = extra
 
         if m.by:
             gent = self._ensure_group(m.by[0])
@@ -875,10 +953,13 @@ class BlockScanPlane:
         if fn is None:
             n = self.n
 
-            def build(rel, q_steps, frac_s, step_s, gcodes, gex, vcol, vex,
-                      *margs):
-                pred_masks, used = _term_masks(jnp, sig, margs, n)
-                extra_masks, _ = _term_masks(jnp, esig, margs[used:], n)
+            def build(rel, ivec, fvec, gcodes, gex, vcol, vex, *margs):
+                q_steps = ivec[0]
+                frac_s, step_s = fvec[0], fvec[1]
+                pred_masks, used, k = _term_masks(jnp, sig, margs, n,
+                                                  ivec, 1)
+                extra_masks, _, _ = _term_masks(jnp, esig, margs[used:], n,
+                                                ivec, k)
                 mask = None
                 for pm in pred_masks:
                     mask = pm if mask is None else (
@@ -911,28 +992,30 @@ class BlockScanPlane:
                 cnt = jnp.zeros((n_groups, n_steps), jnp.float32
                                 ).at[obs_slots, steps].add(
                     jnp.where(ok, 1.0, 0.0), mode="drop")
+                pack = lambda main, vcnt: jnp.concatenate(
+                    [main.reshape(-1), cnt.reshape(-1), vcnt.reshape(-1)])
                 if kind_tag == "count":
-                    return cnt, cnt, cnt
+                    return pack(cnt, cnt)
                 okv = ok & vex if vex is not None else ok
                 slots = jnp.where(okv, slots, n_groups)
                 ones = jnp.where(okv, 1.0, 0.0)
                 if kind_tag == "hist":
                     grid = jnp.zeros((n_groups, n_steps, 64), jnp.float32)
                     grid = grid.at[slots, steps, vcol].add(ones, mode="drop")
-                    return grid, cnt, cnt
+                    return pack(grid, cnt)
                 vals = vcol
                 if kind_tag == "min":
                     grid = jnp.full((n_groups, n_steps), jnp.inf,
                                     jnp.float32)
                     grid = grid.at[slots, steps].min(
                         jnp.where(okv, vals, jnp.inf), mode="drop")
-                    return grid, cnt, cnt
+                    return pack(grid, cnt)
                 if kind_tag == "max":
                     grid = jnp.full((n_groups, n_steps), -jnp.inf,
                                     jnp.float32)
                     grid = grid.at[slots, steps].max(
                         jnp.where(okv, vals, -jnp.inf), mode="drop")
-                    return grid, cnt, cnt
+                    return pack(grid, cnt)
                 grid = jnp.zeros((n_groups, n_steps), jnp.float32
                                  ).at[slots, steps].add(
                     jnp.where(okv, vals, 0.0), mode="drop")
@@ -941,8 +1024,8 @@ class BlockScanPlane:
                     vcnt = jnp.zeros((n_groups, n_steps), jnp.float32
                                      ).at[slots, steps].add(ones,
                                                             mode="drop")
-                    return grid, cnt, vcnt
-                return grid, cnt, cnt
+                    return pack(grid, vcnt)
+                return pack(grid, cnt)
 
             fn = jax.jit(build)
             with self._lock:
@@ -950,13 +1033,15 @@ class BlockScanPlane:
                     self._qr_cache.pop(next(iter(self._qr_cache)))
                 fn = self._qr_cache.setdefault(key, fn)
 
-        main, cnt, vcnt = fn(self._cols[("times",)][0],
-                             jnp.int32(q_steps), jnp.float32(frac_ns / 1e9),
-                             jnp.float32(step_ns / 1e9),
-                             gcodes, gex, vargs[0] if vargs else None,
-                             vargs[1] if len(vargs) > 1 else None,
-                             *args, *eargs)
-        return glabels, np.asarray(main), np.asarray(cnt), np.asarray(vcnt)
+        ivec = np.asarray([q_steps] + ints + eints, np.int32)
+        fvec = np.asarray([frac_ns / 1e9, step_ns / 1e9], np.float32)
+        packed = fn(self._cols[("times",)][0], ivec, fvec,
+                    gcodes, gex, vargs[0] if vargs else None,
+                    vargs[1] if len(vargs) > 1 else None,
+                    *args, *eargs)
+        main_shape = ((n_groups, n_steps, 64) if kind_tag == "hist"
+                      else (n_groups, n_steps))
+        return GridHandle(glabels, packed, main_shape, (n_groups, n_steps))
 
     # -- back-compat wrapper (bench/tests from round 3) ---------------------
 
@@ -975,5 +1060,5 @@ class BlockScanPlane:
                                 step_ns)
         if got is None:
             return None
-        labels, main = got[0], got[1]
+        labels, main, _cnt, _vcnt = got.fetch()
         return labels, main

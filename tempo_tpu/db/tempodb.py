@@ -206,32 +206,35 @@ class TempoDB:
                    and all(isinstance(s, A.SpansetFilter) for s in ev.q.stages)
                    and ev.m.kind != A.MetricsKind.COMPARE)
         preds = [c for c in ev.fetch_req.conditions if c.op is not None]
-        device_parts: list = []
+        # phase 1: LAUNCH every supported block's fused grid (async — the
+        # dispatches pipeline their device round trips) and run the host
+        # engine over unsupported blocks meanwhile
+        handles: list = []
         fused_blocks: list = []
         for m in metas:
-            got = cb = None
+            handle = cb = None
             if fusable:
                 cb = self.planes.get(self.backend_block(m))
-                got = cb.plane.metrics_grid(
+                handle = cb.plane.metrics_grid(
                     ev.m, preds, True, req.start_ns, req.end_ns, req.step_ns,
                     clip_start_ns, clip_end_ns, row_groups)
-            if got is not None:
+            if handle is not None:
                 self.plane_stats["fused_metric_blocks"] += 1
-                labels, main, cnt, vcnt = got
-                device_parts.append(grid_series(ev.m, labels, main, cnt,
-                                                vcnt))
+                handles.append(handle)
                 fused_blocks.append(cb)
             else:
                 self.plane_stats["host_metric_blocks"] += 1
                 for view, cand in self._scan_source(m, freq, row_groups):
                     if len(cand):
                         ev.observe(view)
-        if not device_parts:
+        if not handles:
             return ev.results()
+        # phase 2: fetch (one packed D2H per block) + emit series
         comb = SeriesCombiner(ev.m.kind, req.n_steps)
         comb.add_all(ev.results())
-        for part in device_parts:
-            comb.add_all(part)
+        for handle in handles:
+            labels, main, cnt, vcnt = handle.fetch()
+            comb.add_all(grid_series(ev.m, labels, main, cnt, vcnt))
         out = list(comb.series.values())
         self._fused_exemplars(out, ev, fused_blocks, req)
         return out

@@ -350,7 +350,9 @@ def otlp_scan(data: bytes, cap_hint: "int | None" = None) -> np.ndarray | None:
         if n < 0:
             raise ValueError("malformed OTLP protobuf payload")
         if n <= cap:
-            _CAP_HINTS["scan"] = int(n)
+            # 25% headroom + a floor: size jitter must not re-trigger
+            # the scan-twice regrow this hint exists to kill
+            _CAP_HINTS["scan"] = max(4096, int(n) * 5 // 4)
             if n * 4 < cap:
                 # don't let a small result pin a hint-inflated buffer
                 return recs[:n].copy()
@@ -516,8 +518,9 @@ def otlp_stage(interner: "NativeInterner", data: bytes,
     bp = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
     flags = (1 if skip_span_attrs else 0) | \
         (2 if trust_attrs and skip_span_attrs else 0)
+    hint_key = "stage_skip" if skip_span_attrs else "stage_full"
     cap = cap_hint if cap_hint is not None else max(
-        _CAP_HINTS.get("stage", 4096), 16)
+        _CAP_HINTS.get(hint_key, 4096), 16)
     cap = max(cap, 16)
     acap = 16 if skip_span_attrs else max(
         cap * 4, _CAP_HINTS.get("stage_attrs", 64))
@@ -538,9 +541,9 @@ def otlp_stage(interner: "NativeInterner", data: bytes,
             raise ValueError("malformed OTLP protobuf payload")
         ns, na, nr, nres = (int(x) for x in n_out)
         if ns <= cap and na <= acap and nr <= rcap and nres <= rescap:
-            _CAP_HINTS["stage"] = ns
+            _CAP_HINTS[hint_key] = max(4096, ns * 5 // 4)
             if not skip_span_attrs:
-                _CAP_HINTS["stage_attrs"] = na
+                _CAP_HINTS["stage_attrs"] = max(256, na * 5 // 4)
             out = (spans[:ns], sattrs[:na], rattrs[:nr], res[:nres])
             if ns * 4 < cap:
                 out = tuple(a.copy() for a in out)

@@ -242,3 +242,23 @@ def test_exemplars_present_on_fused_path(dbs):
                             end_ns=int((T0 + 400) * 1e9), step_ns=int(60e9))
     series = dev.query_range("t", req)
     assert any(s.exemplars for s in series)
+
+
+def test_nil_predicates_on_plane_path(dbs):
+    """nil comparisons ride the plane's existence-mask term (regression:
+    the packed-literal refactor missed the nil/const tuple arity and
+    raised IndexError instead of serving or falling back)."""
+    dev, host = dbs
+    for q in ('{ span.retries != nil }', '{ span.retries = nil }',
+              '{ span.nothere = nil }'):
+        a = sorted(m.trace_id for m in dev.search("t", q, limit=1000))
+        b = sorted(m.trace_id for m in host.search("t", q, limit=1000))
+        assert a == b, q
+    req = QueryRangeRequest(query='{ span.retries != nil } | rate() by (name)',
+                            start_ns=int(T0 * 1e9),
+                            end_ns=int((T0 + 400) * 1e9), step_ns=int(60e9))
+    a = _series_map(dev.query_range("t", req))
+    b = _series_map(host.query_range("t", req))
+    assert set(a) == set(b)
+    for k in b:
+        np.testing.assert_allclose(a[k], b[k], rtol=1e-5)
