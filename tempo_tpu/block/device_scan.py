@@ -779,17 +779,16 @@ class BlockScanPlane:
         with self._lock:
             got = self._cols.get(key)
         if got is None:
-            import jax.numpy as jnp
-
             lut = np.zeros(len(self.sizes), bool)
             sel = [g for g in row_groups if 0 <= g < len(self.sizes)]
             if sel:
                 lut[np.asarray(sel)] = True
-            got = jnp.asarray(lut)
+            got = self._up(lut)               # budget-accounted like all uploads
             with self._lock:
-                if len([k for k in self._cols if k[0] == "rglut"]) >= 64:
-                    for k in [k for k in self._cols if k[0] == "rglut"][:32]:
-                        del self._cols[k]
+                rgluts = [k for k in self._cols if k[0] == "rglut"]
+                if len(rgluts) >= 64:
+                    for k in rgluts[:32]:
+                        self.device_bytes -= int(self._cols.pop(k).nbytes)
                 self._cols[key] = got
         return got
 

@@ -211,6 +211,15 @@ class TempoDB:
         # engine over unsupported blocks meanwhile
         handles: list = []
         fused_blocks: list = []
+        fused_parts: list = []
+        MAX_INFLIGHT = 8   # bound live device grids (hist grids are big)
+
+        def drain(to: int) -> None:
+            while len(handles) > to:
+                labels, main, cnt, vcnt = handles.pop(0).fetch()
+                fused_parts.append(grid_series(ev.m, labels, main, cnt,
+                                               vcnt))
+
         for m in metas:
             handle = cb = None
             if fusable:
@@ -222,19 +231,19 @@ class TempoDB:
                 self.plane_stats["fused_metric_blocks"] += 1
                 handles.append(handle)
                 fused_blocks.append(cb)
+                drain(MAX_INFLIGHT - 1)   # pipeline, bounded residency
             else:
                 self.plane_stats["host_metric_blocks"] += 1
                 for view, cand in self._scan_source(m, freq, row_groups):
                     if len(cand):
                         ev.observe(view)
-        if not handles:
+        drain(0)
+        if not fused_parts:
             return ev.results()
-        # phase 2: fetch (one packed D2H per block) + emit series
         comb = SeriesCombiner(ev.m.kind, req.n_steps)
         comb.add_all(ev.results())
-        for handle in handles:
-            labels, main, cnt, vcnt = handle.fetch()
-            comb.add_all(grid_series(ev.m, labels, main, cnt, vcnt))
+        for part in fused_parts:
+            comb.add_all(part)
         out = list(comb.series.values())
         self._fused_exemplars(out, ev, fused_blocks, req)
         return out

@@ -241,6 +241,12 @@ def _load():
                 c.c_void_p, c.c_int64, c.c_void_p, c.c_int64,
                 c.c_int32, i64p]
             lib.otlp_stage.restype = c.c_int32
+            lib.otlp_stage_mt.argtypes = [
+                c.c_void_p, u8p, c.c_int64,
+                c.c_void_p, c.c_int64, c.c_void_p, c.c_int64,
+                c.c_void_p, c.c_int64,
+                c.c_int32, i64p, c.c_int32]
+            lib.otlp_stage_mt.restype = c.c_int32
             _LIB = lib
         except Exception:
             _LIB = None
@@ -525,6 +531,8 @@ def otlp_stage(interner: "NativeInterner", data: bytes,
     acap = 16 if skip_span_attrs else max(
         cap * 4, _CAP_HINTS.get("stage_attrs", 64))
     rcap, rescap = 256, 64
+    mt = (skip_span_attrs and len(data) >= _SCAN_MT_BYTES
+          and _SCAN_THREADS > 1)
     while True:
         # stage fills every record it emits: empty alloc, no MB memsets
         spans = np.empty(cap, STAGE_REC_DTYPE)
@@ -532,11 +540,21 @@ def otlp_stage(interner: "NativeInterner", data: bytes,
         rattrs = np.empty(rcap, STAGE_ATTR_DTYPE)
         res = np.empty(rescap, STAGE_RES_DTYPE)
         n_out = np.zeros(4, np.int64)
-        rc = lib.otlp_stage(
-            interner._h, bp, len(data),
-            spans.ctypes.data, cap, sattrs.ctypes.data, acap,
-            rattrs.ctypes.data, rcap, res.ctypes.data, rescap,
-            flags, n_out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if mt:
+            # parallel staging (skip-attrs shapes): ResourceSpans ranges
+            # fan across threads with thread-local intern memos
+            rc = lib.otlp_stage_mt(
+                interner._h, bp, len(data),
+                spans.ctypes.data, cap,
+                rattrs.ctypes.data, rcap, res.ctypes.data, rescap,
+                flags, n_out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                _SCAN_THREADS)
+        else:
+            rc = lib.otlp_stage(
+                interner._h, bp, len(data),
+                spans.ctypes.data, cap, sattrs.ctypes.data, acap,
+                rattrs.ctypes.data, rcap, res.ctypes.data, rescap,
+                flags, n_out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
         if rc != 0:
             raise ValueError("malformed OTLP protobuf payload")
         ns, na, nr, nres = (int(x) for x in n_out)

@@ -868,6 +868,49 @@ struct StageRes {
 
 namespace {
 
+// Thread-local intern memo: payloads repeat a handful of strings (span
+// names, service names, status messages) thousands of times; each worker
+// resolves repeats from its private table and takes the global interner
+// mutex only on a local miss (~|unique strings| times per thread), so the
+// parallel stage is not serialized on the interner lock.
+struct LocalIntern {
+    struct E { uint64_t h; int64_t off; int32_t len; int32_t id; };
+    std::vector<E> tab;
+    uint64_t mask;
+    Interner* it;
+    const uint8_t* base;
+
+    LocalIntern(Interner* i, const uint8_t* b) : it(i), base(b) {
+        tab.assign(1 << 10, E{0, 0, 0, -1});
+        mask = tab.size() - 1;
+    }
+
+    int32_t get(const uint8_t* s, int64_t len) {
+        uint64_t h = fnv1a64(s, len);
+        uint64_t i = h & mask;
+        int probes = 0;
+        while (probes++ < 32) {
+            E& e = tab[i];
+            if (e.id == -1) {
+                int32_t id;
+                {
+                    std::lock_guard<std::mutex> g(it->mu);
+                    id = it->intern_locked(s, len);
+                }
+                e = E{h, s - base, (int32_t)len, id};
+                return id;
+            }
+            if (e.h == h && e.len == len &&
+                memcmp(base + e.off, s, len) == 0)
+                return e.id;
+            i = (i + 1) & mask;
+        }
+        // pathological collision chain: fall back to the global table
+        std::lock_guard<std::mutex> g(it->mu);
+        return it->intern_locked(s, len);
+    }
+};
+
 struct StageCtx {
     Interner* it;
     const uint8_t* buf;
@@ -877,6 +920,13 @@ struct StageCtx {
     StageRes* res; int64_t res_cap; int64_t n_res = 0;
     int32_t empty_id;
     int32_t svc_key_id;                // id of "service.name"
+    LocalIntern* local = nullptr;      // set on parallel workers only
+
+    // serial path: caller holds it->mu for the whole pass;
+    // parallel path: LocalIntern takes it per local miss
+    int32_t intern(const uint8_t* s, int64_t len) {
+        return local ? local->get(s, len) : it->intern_locked(s, len);
+    }
 };
 
 // Parse one KeyValue into a StageAttr (interning key + string value).
@@ -890,7 +940,7 @@ static bool stage_keyvalue(StageCtx& c, const uint8_t* kv, uint64_t kvlen,
     a._pad = 0;
     const uint8_t* val_start = nullptr; uint64_t val_len = 0;
     while (read_field(cur, f, w, v, s, l)) {
-        if (f == 1 && w == 2) a.key_id = c.it->intern_locked(s, l);
+        if (f == 1 && w == 2) a.key_id = c.intern(s, l);
         else if (f == 2 && w == 2) { val_start = s; val_len = l; }
     }
     if (!cur.ok) return false;
@@ -900,7 +950,7 @@ static bool stage_keyvalue(StageCtx& c, const uint8_t* kv, uint64_t kvlen,
             switch (f) {
                 case 1: if (w == 2) {
                             a.typ = 1;
-                            a.sval_id = c.it->intern_locked(s, l);
+                            a.sval_id = c.intern(s, l);
                             a.sval_off = s - c.buf;
                             a.sval_len = (int32_t)l;
                         } break;
@@ -965,7 +1015,7 @@ static bool stage_span(StageCtx& c, const uint8_t* sp, uint64_t splen,
                     if (l <= 8) memcpy(rec.span_id, s, l); break;
             case 4: rec.pid_len = (int32_t)l;
                     if (l <= 8) memcpy(rec.parent_span_id, s, l); break;
-            case 5: rec.name_id = c.it->intern_locked(s, l); break;
+            case 5: rec.name_id = c.intern(s, l); break;
             case 6: if (w == 0) rec.kind = (int32_t)v; break;
             case 7: if (w != 2) rec.start_ns = v; break;
             case 8: if (w != 2) rec.end_ns = v; break;
@@ -994,7 +1044,7 @@ static bool stage_span(StageCtx& c, const uint8_t* sp, uint64_t splen,
                 uint32_t f5, w5; uint64_t v5, l5; const uint8_t* s5;
                 while (read_field(st, f5, w5, v5, s5, l5)) {
                     if (f5 == 2 && w5 == 2)
-                        rec.status_msg_id = c.it->intern_locked(s5, l5);
+                        rec.status_msg_id = c.intern(s5, l5);
                     else if (f5 == 3) rec.status_code = (int32_t)v5;
                 }
                 if (!st.ok) return false;
@@ -1073,6 +1123,128 @@ int32_t otlp_stage(void* interner, const uint8_t* buf, int64_t buflen,
     n_out[0] = c.n_spans; n_out[1] = c.n_sattrs;
     n_out[2] = c.n_rattrs; n_out[3] = c.n_res;
     return 0;
+}
+
+// Parallel staging for the skip-attrs shape (the generator's default:
+// processors read only intrinsic dimensions). A sequential prelude stages
+// Resources and counts spans per ResourceSpans (header walk only); worker
+// threads then deep-stage disjoint output ranges with thread-local intern
+// memos (LocalIntern) in front of the shared interner. Output order is
+// identical to the sequential stage. Returns -1 malformed, 0 ok; when the
+// span count exceeds span_cap only counts are written (caller regrows and
+// re-calls — interning is idempotent).
+int32_t otlp_stage_mt(void* interner, const uint8_t* buf, int64_t buflen,
+                      StageRec* spans, int64_t span_cap,
+                      StageAttr* rattrs, int64_t rattr_cap,
+                      StageRes* res, int64_t res_cap,
+                      int32_t flags, int64_t* n_out, int32_t n_threads) {
+    if (!(flags & 1)) return -2;               // skip-attrs shapes only
+    Interner* it = (Interner*)interner;
+    struct Range {
+        const uint8_t* start; uint64_t len;
+        int64_t out_base; int64_t count;
+        int32_t res_idx; int32_t service_id;
+    };
+    std::vector<Range> ranges;
+    int64_t total = 0, n_res = 0;
+    {
+        // prelude holds the interner lock: resource staging interns the
+        // (few) service names / resource keys exactly like the serial pass
+        std::lock_guard<std::mutex> g(it->mu);
+        StageCtx c;
+        c.it = it; c.buf = buf;
+        c.spans = nullptr; c.span_cap = 0;
+        c.sattrs = nullptr; c.sattr_cap = 0;
+        c.rattrs = rattrs; c.rattr_cap = rattr_cap;
+        c.res = res; c.res_cap = res_cap;
+        static const uint8_t kEmpty = 0;
+        c.empty_id = it->intern_locked(&kEmpty, 0);
+        c.svc_key_id = it->intern_locked((const uint8_t*)"service.name", 12);
+        Cursor top{buf, buf + buflen, true};
+        uint32_t f, w; uint64_t v, len; const uint8_t* start;
+        while (read_field(top, f, w, v, start, len)) {
+            if (f != 1 || w != 2) continue;    // ResourceSpans
+            const uint8_t* rm = nullptr; uint64_t rmlen = 0;
+            uint32_t f2, w2; uint64_t v2, l2; const uint8_t* s2;
+            Cursor rs1{start, start + len, true};
+            while (read_field(rs1, f2, w2, v2, s2, l2)) {
+                if (f2 == 1 && w2 == 2) { rm = s2; rmlen = l2; }
+            }
+            if (!rs1.ok) return -1;
+            StageRes r;
+            if (!stage_resource(c, rm, rmlen, r)) return -1;
+            int32_t res_idx = (int32_t)c.n_res;
+            if (c.n_res < c.res_cap) c.res[c.n_res] = r;
+            c.n_res++;
+            int64_t cnt = count_spans_rs(start, len);
+            if (cnt < 0) return -1;
+            ranges.push_back(Range{start, len, total, cnt,
+                                   res_idx, r.service_id});
+            total += cnt;
+        }
+        if (!top.ok) return -1;
+        n_res = c.n_res;
+        n_out[0] = total; n_out[1] = 0;
+        n_out[2] = c.n_rattrs; n_out[3] = n_res;
+        if (total > span_cap || c.n_rattrs > rattr_cap)
+            return 0;                          // caller regrows
+    }
+    static const uint8_t kEmpty2 = 0;
+    int32_t empty_id, svc_key_id;
+    {
+        std::lock_guard<std::mutex> g(it->mu);
+        empty_id = it->intern_locked(&kEmpty2, 0);
+        svc_key_id = it->intern_locked((const uint8_t*)"service.name", 12);
+    }
+    bool skip = true, trust = (flags & 2) != 0;
+    int nt = (int)std::min<size_t>(std::max(n_threads, 1),
+                                   std::max<size_t>(ranges.size(), 1));
+    std::atomic<bool> bad{false};
+
+    auto work = [&](int t) {
+        LocalIntern local(it, buf);
+        StageCtx c;
+        c.it = it; c.buf = buf;
+        c.spans = spans; c.span_cap = span_cap;
+        c.sattrs = nullptr; c.sattr_cap = 0;
+        c.rattrs = nullptr; c.rattr_cap = 0;
+        c.res = nullptr; c.res_cap = 0;
+        c.empty_id = empty_id;
+        c.svc_key_id = svc_key_id;
+        c.local = &local;
+        for (size_t ri = t; ri < ranges.size(); ri += nt) {
+            if (bad.load(std::memory_order_relaxed)) return;
+            const Range& r = ranges[ri];
+            c.n_spans = r.out_base;
+            Cursor rs{r.start, r.start + r.len, true};
+            uint32_t f2, w2; uint64_t v2, l2; const uint8_t* s2;
+            while (read_field(rs, f2, w2, v2, s2, l2)) {
+                if (f2 != 2 || w2 != 2) continue;      // ScopeSpans
+                Cursor ss{s2, s2 + l2, true};
+                uint32_t f3, w3; uint64_t v3, l3; const uint8_t* s3;
+                while (read_field(ss, f3, w3, v3, s3, l3)) {
+                    if (f3 != 2 || w3 != 2) continue;  // Span
+                    if (!stage_span(c, s3, l3, r.res_idx, r.service_id,
+                                    skip, trust)) {
+                        bad.store(true, std::memory_order_relaxed);
+                        return;
+                    }
+                }
+                if (!ss.ok) { bad.store(true); return; }
+            }
+            if (!rs.ok) { bad.store(true); return; }
+        }
+    };
+
+    if (nt < 2 || total < 4096) {
+        for (int t = 0; t < nt; t++) work(t);
+    } else {
+        std::vector<std::thread> threads;
+        threads.reserve(nt);
+        for (int t = 0; t < nt; t++) threads.emplace_back(work, t);
+        for (auto& th : threads) th.join();
+    }
+    return bad.load() ? -1 : 0;
 }
 
 }  // extern "C"

@@ -172,3 +172,41 @@ def test_otlp_scan_mt_matches_sequential(monkeypatch):
     monkeypatch.setattr(native, "_SCAN_MT_BYTES", 1)
     with pytest.raises(ValueError):
         native.otlp_scan(payload[:-3])
+
+
+def test_otlp_stage_mt_matches_serial(monkeypatch):
+    """Parallel staging (skip-attrs shape) must emit the same records in
+    the same order as the serial stage — intern ids may differ between
+    interners, so string CONTENT is compared."""
+    from tempo_tpu.model.interner import StringInterner
+
+    if not native.available():
+        pytest.skip("native layer unavailable")
+    import bench as B
+
+    payload = B._make_otlp_payload(8192, n_services=13)
+    it_mt, it_s = StringInterner(), StringInterner()
+    monkeypatch.setattr(native, "_SCAN_MT_BYTES", 1)
+    monkeypatch.setattr(native, "_SCAN_THREADS", 4)   # force MT even on 1 cpu
+    a = native.otlp_stage(it_mt.native_handle(), payload,
+                          skip_span_attrs=True)
+    monkeypatch.setattr(native, "_SCAN_MT_BYTES", 1 << 60)
+    b = native.otlp_stage(it_s.native_handle(), payload,
+                          skip_span_attrs=True)
+    it_mt.sync(); it_s.sync()
+    sa, sb = a[0], b[0]
+    assert len(sa) == len(sb) == 8192
+    for col in ("trace_id", "span_id", "start_ns", "end_ns", "kind",
+                "status_code", "res_idx", "span_len"):
+        assert (sa[col] == sb[col]).all(), col
+    na = [it_mt.lookup(int(i)) for i in sa["name_id"]]
+    nb = [it_s.lookup(int(i)) for i in sb["name_id"]]
+    assert na == nb
+    va = [it_mt.lookup(int(i)) for i in sa["service_id"]]
+    vb = [it_s.lookup(int(i)) for i in sb["service_id"]]
+    assert va == vb
+    # malformed rejection on the mt path too
+    monkeypatch.setattr(native, "_SCAN_MT_BYTES", 1)
+    with pytest.raises(ValueError):
+        native.otlp_stage(it_mt.native_handle(), payload[:-5],
+                          skip_span_attrs=True)
