@@ -1,0 +1,9 @@
+{{- define "tempo-tpu.fullname" -}}
+{{- printf "%s" .Release.Name | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "tempo-tpu.labels" -}}
+app.kubernetes.io/name: tempo-tpu
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+{{- end -}}
