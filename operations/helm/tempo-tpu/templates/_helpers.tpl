@@ -1,9 +1,16 @@
 {{- define "tempo-tpu.fullname" -}}
-{{- printf "%s" .Release.Name | trunc 63 | trimSuffix "-" -}}
+{{- /* leave room for the longest "-<target>" suffix (-metrics-generator,
+       18 chars) under the 63-char DNS label limit */ -}}
+{{- printf "%s" .Release.Name | trunc 44 | trimSuffix "-" -}}
 {{- end -}}
 
 {{- define "tempo-tpu.labels" -}}
 app.kubernetes.io/name: tempo-tpu
 app.kubernetes.io/instance: {{ .Release.Name }}
 app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+{{- end -}}
+
+{{- define "tempo-tpu.selector" -}}
+app.kubernetes.io/name: tempo-tpu
+app.kubernetes.io/instance: {{ .Release.Name }}
 {{- end -}}

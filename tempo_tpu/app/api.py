@@ -3,13 +3,17 @@
 Paths (Tempo-compatible):
   POST /v1/traces                      OTLP HTTP ingest (json or protobuf)
   GET  /api/traces/{id}                trace by id (json spans)
+  GET  /api/v2/traces/{id}             v2: trace + completion status
   GET  /api/search?q=&start=&end=&limit=
-  GET  /api/search/tags[?scope=]
-  GET  /api/search/tag/{name}/values
+  GET  /api/search/tags                v1: flat tagNames
+  GET  /api/v2/search/tags[?scope=]    v2: per-scope listing
+  GET  /api/search/tag/{name}/values   v1: bare string values
+  GET  /api/v2/search/tag/{name}/values  v2: typed values
+  GET  /api/metrics/query?q=&start=&end=   instant (one value/series)
   GET  /api/metrics/query_range?q=&start=&end=&step=
   GET  /api/metrics/summary?q=&groupBy=    (span-metrics summary)
   GET  /api/overrides            (+POST)   user-configurable overrides
-  GET  /ready /status /metrics /api/echo
+  GET  /ready /status /metrics /api/echo /api/status/buildinfo
 
 Multi-tenancy: `X-Scope-OrgID` header; without it the fake single tenant
 is used (dskit user injection behavior).
@@ -283,6 +287,12 @@ class Handler(BaseHTTPRequestHandler):
                 return self._reply(200 if self.app.ready else 503,
                                    b"ready" if self.app.ready else b"starting",
                                    "text/plain")
+            if path == "/api/status/buildinfo":
+                # PathBuildInfo (`http.go:76`): prometheus-style build info
+                return self._reply(200, _json_bytes({
+                    "version": "tempo-tpu-0.4",
+                    "revision": "dev", "branch": "main",
+                    "goVersion": "n/a (python+jax+cpp)"}))
             if path == "/api/echo":
                 return self._reply(200, b"echo", "text/plain")
             if path == "/status" or path.startswith("/status/"):
@@ -303,16 +313,26 @@ class Handler(BaseHTTPRequestHandler):
             tenant = self._tenant()
             if not tenant:
                 return self._err(401, "no org id")
+            if path.startswith("/api/v2/traces/"):
+                return self._trace_by_id(tenant, path.split("/")[-1], v2=True)
             if path.startswith("/api/traces/"):
                 return self._trace_by_id(tenant, path.split("/")[-1])
             if path == "/api/search":
                 return self._search(tenant, q)
+            if path == "/api/v2/search/tags":
+                return self._tags(tenant, q, v2=True)
             if path == "/api/search/tags":
                 return self._tags(tenant, q)
+            if (path.startswith("/api/v2/search/tag/")
+                    and path.endswith("/values")):
+                return self._tag_values(tenant, path.split("/")[-2], q,
+                                        v2=True)
             if path.startswith("/api/search/tag/") and path.endswith("/values"):
                 return self._tag_values(tenant, path.split("/")[-2], q)
             if path == "/api/metrics/query_range":
                 return self._query_range(tenant, q)
+            if path == "/api/metrics/query":
+                return self._query_instant(tenant, q)
             if path == "/api/metrics/summary":
                 return self._metrics_summary(tenant, q)
             if path == "/api/overrides":
@@ -358,7 +378,8 @@ class Handler(BaseHTTPRequestHandler):
                     tenant, q["name"], int(q.get("limit", 1000)))}))
         self._err(404, f"unknown internal path {path}")
 
-    def _trace_by_id(self, tenant: str, hexid: str) -> None:
+    def _trace_by_id(self, tenant: str, hexid: str,
+                     v2: bool = False) -> None:
         tid = bytes.fromhex(hexid)
         spans = self.app.frontend.find_trace(tenant, tid)
         if spans is None:
@@ -368,6 +389,12 @@ class Handler(BaseHTTPRequestHandler):
                 "span_id": s.get("span_id", b"").hex(),
                 "parent_span_id": s.get("parent_span_id", b"").hex()}
                for s in spans]
+        if v2:
+            # PathTracesV2 (`pkg/api/http.go:88`): TraceByIDResponse shape
+            # with trace + status (partial-trace reporting hook)
+            return self._reply(200, _json_bytes({
+                "trace": {"trace_id": hexid, "spans": out},
+                "status": "COMPLETE"}))
         self._reply(200, _json_bytes({"trace_id": hexid, "spans": out}))
 
     def _search(self, tenant: str, q: dict) -> None:
@@ -380,15 +407,22 @@ class Handler(BaseHTTPRequestHandler):
             "traces": [md.to_json() for md in res],
             "metrics": {"inspectedTraces": len(res)}}))
 
-    def _tags(self, tenant: str, q: dict) -> None:
+    def _tags(self, tenant: str, q: dict, v2: bool = False) -> None:
         names = self.app.frontend.tag_names(tenant)
         scope = q.get("scope", "")
         if scope:
             names = {scope: names.get(scope, [])}
-        self._reply(200, _json_bytes({
-            "scopes": [{"name": k, "tags": v} for k, v in names.items()]}))
+        if v2:
+            # PathSearchTagsV2: per-scope listing (`http.go:87`)
+            return self._reply(200, _json_bytes({
+                "scopes": [{"name": k, "tags": v}
+                           for k, v in names.items()]}))
+        # v1: flat names union (`http.go:73` SearchTagsResponse)
+        flat = sorted({n for v in names.values() for n in v})
+        self._reply(200, _json_bytes({"tagNames": flat}))
 
-    def _tag_values(self, tenant: str, name: str, q: dict) -> None:
+    def _tag_values(self, tenant: str, name: str, q: dict,
+                    v2: bool = False) -> None:
         # routed through frontend (SLO accounting) or querier directly on
         # frontend-less targets, so ingester recent data is included like
         # /api/search/tags (ADVICE r1)
@@ -399,7 +433,12 @@ class Handler(BaseHTTPRequestHandler):
             vals = self.app.querier.tag_values(tenant, name, limit)
         else:
             return self._err(400, "no query module on this target")
-        self._reply(200, _json_bytes({"tagValues": vals}))
+        if v2:
+            # PathSearchTagValuesV2: typed values (`http.go:86`)
+            return self._reply(200, _json_bytes({"tagValues": vals}))
+        # v1: bare strings (`http.go:74` SearchTagValuesResponse)
+        self._reply(200, _json_bytes({
+            "tagValues": [str(v.get("value", "")) for v in vals]}))
 
     def _query_range(self, tenant: str, q: dict) -> None:
         series = self.app.frontend.query_range(
@@ -415,6 +454,22 @@ class Handler(BaseHTTPRequestHandler):
         ts_ms = req.step_timestamps_ms()
         self._reply(200, _json_bytes({
             "series": [s.to_json(ts_ms) for s in series]}))
+
+    def _query_instant(self, tenant: str, q: dict) -> None:
+        """PathMetricsQueryInstant (`http.go:80`): one value per series —
+        a range query whose single step spans [start, end)."""
+        start_s, end_s = float(q["start"]), float(q["end"])
+        series = self.app.frontend.query_range(
+            tenant, q.get("q") or q.get("query", ""),
+            start_s=start_s, end_s=end_s, step_s=max(end_s - start_s, 1e-9))
+        def _val(ts) -> "float | None":
+            v = float(ts.samples[0]) if len(ts.samples) else 0.0
+            return v if v == v else None      # NaN is not RFC-8259 JSON
+        self._reply(200, _json_bytes({"series": [
+            {"labels": [{"key": k, "value": {"stringValue": str(v)}}
+                        for k, v in ts.labels],
+             "value": _val(ts)}
+            for ts in series]}))
 
     def _metrics_summary(self, tenant: str, q: dict) -> None:
         if self.app.generator is None:

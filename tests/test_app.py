@@ -155,8 +155,10 @@ def test_http_e2e(server):
     assert code == 200 and len(res["traces"]) == 1
     # tags
     code, tags = _get(f"{base}/api/search/tags")
-    span_tags = next(s["tags"] for s in tags["scopes"] if s["name"] == "span")
-    assert "http.status_code" in span_tags
+    assert "http.status_code" in tags["tagNames"]          # v1: flat union
+    code, tags2 = _get(f"{base}/api/v2/search/tags")
+    span_tags = next(s["tags"] for s in tags2["scopes"] if s["name"] == "span")
+    assert "http.status_code" in span_tags                 # v2: scoped
     # metrics query range (generator local-blocks path)
     now = time.time()
     code, qr = _get(f"{base}/api/metrics/query_range?q=" +
@@ -194,10 +196,10 @@ def test_tag_values_includes_ingester_recent_data(server):
     assert code == 200
     code, res = _get(f"{base}/api/search/tag/.http.status_code/values")
     assert code == 200
-    assert any(v["value"] == "200" for v in res["tagValues"])
+    assert "200" in res["tagValues"]                       # v1: bare strings
     code, res = _get(
-        f"{base}/api/search/tag/resource.service.name/values")
-    assert any(v["value"] == "shop" for v in res["tagValues"])
+        f"{base}/api/v2/search/tag/resource.service.name/values")
+    assert any(v["value"] == "shop" for v in res["tagValues"])  # v2: typed
 
 
 def test_otlp_malformed_and_gzip(server):
@@ -397,3 +399,31 @@ def test_ops_files_reference_only_emitted_metrics(server):
         assert (name in src
                 or any(name.startswith(p) and p in src for p in
                        ("tempo_read_plane_", "tempo_distributor_"))), name
+
+
+def test_v2_api_endpoints(server):
+    """v2 surface parity (`pkg/api/http.go:76-88`): buildinfo, v2 trace
+    response, instant metrics query."""
+    import time
+    app, base = server
+    t0 = int((time.time() - 5) * 1e9)
+    body = json.dumps(OTLP).replace('"{t0}"', str(t0)) \
+                           .replace('"{t1}"', str(t0 + 50_000_000))
+    code, _ = _post(f"{base}/v1/traces", body.encode())
+    assert code == 200
+    # buildinfo needs no tenant
+    code, bi = _get(f"{base}/api/status/buildinfo")
+    assert code == 200 and bi["version"].startswith("tempo-tpu")
+    # v2 trace-by-id wraps the trace with a status
+    tid = OTLP["resourceSpans"][0]["scopeSpans"][0]["spans"][0]["traceId"]
+    code, tr = _get(f"{base}/api/v2/traces/{tid}")
+    assert code == 200 and tr["status"] == "COMPLETE"
+    assert tr["trace"]["spans"][0]["name"] == "checkout"
+    # instant metrics query: one value per series over [start, end)
+    now = time.time()
+    code, qi = _get(f"{base}/api/metrics/query?q=" +
+                    urllib.parse.quote("{ } | rate()") +
+                    f"&start={now - 300}&end={now}")
+    assert code == 200
+    assert any(s["value"] == s["value"] and s["value"] >= 0
+               for s in qi["series"])
