@@ -151,7 +151,6 @@ class Querier:
     def tag_values(self, tenant: str, name: str, limit: int = 1000) -> list[dict]:
         """Autocomplete values: ingester recent data + backend block scans,
         deduped (`ExecuteTagValues` fan-out, querier side)."""
-        from tempo_tpu.block.fetch import scan_views
         from tempo_tpu.traceql.engine import execute_tag_values, tag_values_request
 
         seen: dict[str, dict] = {}
@@ -163,8 +162,11 @@ class Querier:
                 for v in client.tag_values(tenant, name, limit):
                     seen.setdefault(v["value"], v)
         req = tag_values_request(name)
+        # ride the plane cache's retained views (autocomplete is the
+        # most repeat-heavy endpoint; re-reading parquet per keystroke
+        # was the old cost)
         views = (v for m in self.db.blocks(tenant)
-                 for v in scan_views(self.db.backend_block(m), req))
+                 for v in self.db._scan_source(m, req))
         for v in execute_tag_values(name, views, limit=limit):
             seen.setdefault(v["value"], v)
         return list(seen.values())[:limit]
