@@ -306,7 +306,12 @@ def _block_mask_kernel(n: int, pred_sig: tuple, extra_sig: tuple,
             mask = jnp.ones((n,), bool)
         for m in extra_masks:
             mask = mask & m
-        return mask
+        # bit-pack on device: the D2H is n/8 bytes instead of n (the
+        # transfer is the cost behind a network-attached device)
+        pad = (-n) % 8
+        mp = jnp.pad(mask, (0, pad)).reshape(-1, 8).astype(jnp.uint8)
+        weights = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], jnp.uint8)
+        return (mp * weights).sum(axis=1).astype(jnp.uint8)
 
     return jax.jit(fn)
 
@@ -824,8 +829,9 @@ class BlockScanPlane:
 
     def mask_async(self, preds: Sequence, all_conditions: bool,
                    time_range=None, row_groups=None):
-        """Launch the fused block mask; returns a device array (or None
-        when a predicate shape is unsupported). No sync, no D2H; a single
+        """Launch the fused block mask; returns a BIT-PACKED device array
+        (uint8, big-endian bit order — unpack with `unpack_mask`) or None
+        when a predicate shape is unsupported. No sync, no D2H; a single
         packed-literal H2D rides along with the call."""
         plan = self._plan(list(preds), all_conditions)
         if plan is None:
@@ -842,10 +848,15 @@ class BlockScanPlane:
     def mask(self, preds: Sequence, all_conditions: bool,
              time_range=None, row_groups=None) -> Optional[np.ndarray]:
         m = self.mask_async(preds, all_conditions, time_range, row_groups)
-        return None if m is None else np.asarray(m)
+        return None if m is None else self.unpack_mask(np.asarray(m))
 
-    def split_mask(self, mask: np.ndarray) -> list[np.ndarray]:
-        """Block-level mask → per-row-group candidate row arrays."""
+    def unpack_mask(self, packed: np.ndarray) -> np.ndarray:
+        """Bit-packed device mask → bool[n]."""
+        return np.unpackbits(np.asarray(packed, np.uint8))[:self.n]             .astype(bool)
+
+    def split_mask(self, packed: np.ndarray) -> list[np.ndarray]:
+        """Bit-packed block mask → per-row-group candidate row arrays."""
+        mask = self.unpack_mask(packed)
         return [np.flatnonzero(mask[self.offsets[i]:self.offsets[i + 1]])
                 for i in range(len(self.sizes))]
 

@@ -104,15 +104,26 @@ class TempoDB:
         if self.planes is not None:
             self.planes.drop_dead(tenant, live)
 
-    def _scan_source(self, meta: bm.BlockMeta, req,
-                     row_groups: Sequence[int] | None = None):
+    def scan_source(self, meta: bm.BlockMeta, req,
+                    row_groups: Sequence[int] | None = None,
+                    cached_only: bool = False):
         """(view, candidate_rows) stream for one block: the plane cache's
-        fused device first pass when enabled, else a direct parquet scan."""
+        fused device first pass when enabled, else a direct parquet scan.
+        The shared read path behind search, query_range, and tag
+        autocomplete. `cached_only` serves from the cache ONLY when the
+        block is already resident — metadata endpoints must not pay
+        full-block reads (or thrash the LRU) for a miss when a projected
+        one-column scan suffices."""
         from tempo_tpu.block.fetch import scan_views
 
         if self.planes is not None:
-            return self.planes.get(self.backend_block(meta)).scan(
-                req, row_groups)
+            if cached_only:
+                entry = self.planes.peek(meta.tenant_id, meta.block_id)
+                if entry is not None:
+                    return entry.scan(req, row_groups)
+            else:
+                return self.planes.get(self.backend_block(meta)).scan(
+                    req, row_groups)
         return scan_views(self.backend_block(meta), req,
                           row_groups=row_groups)
 
@@ -166,7 +177,7 @@ class TempoDB:
         if metas is None:
             metas = self.blocks(tenant, start_s, end_s)
         views = (v for m in metas
-                 for v in self._scan_source(m, req, row_groups))
+                 for v in self.scan_source(m, req, row_groups))
         return execute_search(q, views, limit=limit,
                               start_ns=int((start_s or 0) * 1e9),
                               end_ns=int((end_s or 0) * 1e9))
@@ -234,7 +245,7 @@ class TempoDB:
                 drain(MAX_INFLIGHT - 1)   # pipeline, bounded residency
             else:
                 self.plane_stats["host_metric_blocks"] += 1
-                for view, cand in self._scan_source(m, freq, row_groups):
+                for view, cand in self.scan_source(m, freq, row_groups):
                     if len(cand):
                         ev.observe(view)
         drain(0)

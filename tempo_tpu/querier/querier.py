@@ -162,11 +162,12 @@ class Querier:
                 for v in client.tag_values(tenant, name, limit):
                     seen.setdefault(v["value"], v)
         req = tag_values_request(name)
-        # ride the plane cache's retained views (autocomplete is the
-        # most repeat-heavy endpoint; re-reading parquet per keystroke
-        # was the old cost)
+        # ride the plane cache's retained views when a block is ALREADY
+        # resident (autocomplete repeats per keystroke); cold blocks take
+        # the projected one-column scan — a metadata endpoint must not
+        # trigger full-block reads or evict the query working set
         views = (v for m in self.db.blocks(tenant)
-                 for v in self.db._scan_source(m, req))
+                 for v in self.db.scan_source(m, req, cached_only=True))
         for v in execute_tag_values(name, views, limit=limit):
             seen.setdefault(v["value"], v)
         return list(seen.values())[:limit]
