@@ -484,6 +484,14 @@ class Handler(BaseHTTPRequestHandler):
             "estimated": res.estimated}))
 
     def _status(self, path: str) -> None:
+        if path == "/status/usage-stats":
+            # PathUsageStats (`http.go:77`): the report this cluster would
+            # send (leader-elected reporter, pkg/usagestats analog)
+            ur = getattr(self.app, "usage_reporter", None)
+            if ur is None:
+                return self._err(404, "usage-stats reporting not enabled")
+            return self._reply(200, _json_bytes(
+                ur.build_report(ur.cached_seed())))
         cfg_warnings = self.app.cfg.check()
         body = {
             "target": self.app.cfg.target,

@@ -89,6 +89,15 @@ class UsageReporter:
 
     # -- reporting ---------------------------------------------------------
 
+    def cached_seed(self) -> str:
+        """The cluster seed, resolved once and memoized: read paths
+        (the /status/usage-stats endpoint) must not pay a KV CAS — or
+        mutate cluster state — per poll."""
+        got = getattr(self, "_seed_cache", None)
+        if got is None:
+            got = self._seed_cache = self.get_or_create_seed()
+        return got
+
     def build_report(self, seed: str) -> dict:
         with self._lock:
             metrics = dict(self._metrics)

@@ -427,3 +427,25 @@ def test_v2_api_endpoints(server):
     assert code == 200
     assert any(s["value"] == s["value"] and s["value"] >= 0
                for s in qi["series"])
+
+
+def test_status_usage_stats_endpoint(server):
+    """PathUsageStats (`http.go:77`): the would-be-sent report, or 404
+    when reporting is disabled."""
+    app, base = server
+    assert app.usage_reporter is not None
+    code, rep = _get(f"{base}/status/usage-stats")
+    assert code == 200 and "clusterID" in rep
+    # a read poll must not mint a new seed per request
+    code2, rep2 = _get(f"{base}/status/usage-stats")
+    assert rep2["clusterID"] == rep["clusterID"]
+    # disabled path → 404
+    app.usage_reporter, saved = None, app.usage_reporter
+    try:
+        try:
+            code, _ = _get(f"{base}/status/usage-stats")
+        except urllib.error.HTTPError as e:
+            code = e.code
+        assert code == 404
+    finally:
+        app.usage_reporter = saved
