@@ -598,6 +598,35 @@ class BlockScanPlane:
             self._cols[key] = ent
             return ent
 
+    def _host_group_codes(self, expr):
+        """(codes[int32], labels, host_exists|None) for one by()-able key —
+        ONE factorization (arrow-dict fast path or host np.unique), cached
+        host-side (budget-accounted) and shared by the single-key upload
+        and the two-key composition."""
+        with self._lock:
+            key = ("hgroup", expr)
+            if key in self._cols:
+                return self._cols[key]
+            ent = None
+            if isinstance(expr, A.Attribute):
+                fast = self._arrow_dict_fast(expr)
+                if fast is not None:
+                    ent = (fast[0], fast[1], None)
+                else:
+                    c = self._host_col(expr)
+                    if c is not None and c.t in (STR, NUM, STATUS, KIND,
+                                                 BOOL):
+                        codes, labels = _fmt_group_labels(
+                            np.asarray(c.values), c.t)
+                        ent = (codes, labels,
+                               None if c.exists.all() else c.exists)
+            if ent is not None:
+                self.host_bytes += int(ent[0].nbytes)
+                if ent[2] is not None:
+                    self.host_bytes += int(ent[2].nbytes)
+            self._cols[key] = ent
+            return ent
+
     def _ensure_group(self, expr):
         """("group", codes_dev, labels, exists_dev|None) for any by()-able
         column type (STR dict, status/kind/num/bool factorized)."""
@@ -605,20 +634,49 @@ class BlockScanPlane:
             key = ("group", expr)
             if key in self._cols:
                 return self._cols[key]
+            h = self._host_group_codes(expr)
             ent = None
-            if isinstance(expr, A.Attribute):
-                fast = self._arrow_dict_fast(expr)
-                if fast is not None:
-                    codes, labels = fast
-                    ent = ("group", self._up(codes), labels, None)
-                else:
-                    c = self._host_col(expr)
-                    if c is not None and c.t in (STR, NUM, STATUS, KIND,
-                                                 BOOL):
-                        codes, labels = _fmt_group_labels(
-                            np.asarray(c.values), c.t)
-                        ex = None if c.exists.all() else self._up(c.exists)
-                        ent = ("group", self._up(codes), labels, ex)
+            if h is not None:
+                codes, labels, hex_ = h
+                ex = None if hex_ is None else self._up(hex_)
+                ent = ("group", self._up(codes), labels, ex)
+            self._cols[key] = ent
+            return ent
+
+    # hard construction bound for composed two-key grids: label lists and
+    # code composition stay sane; the caller's max_groups applies per query
+    _GROUP2_BUILD_CAP = 1 << 20
+
+    def _ensure_group2(self, e1, e2):
+        """("group2", codes_dev, labels, exists|None) for a two-key by():
+        codes compose as c1*|d2|+c2 on host at adoption (the engine's
+        `_group_slots` composition, engine_metrics.py), labels are
+        (v1, v2) tuples in the same slot order. Unobserved combos cost
+        grid rows but never emit (the obs-count gate). The whole
+        build runs under the plane lock like every other adoption (a
+        racing duplicate would double-count device_bytes)."""
+        with self._lock:
+            key = ("group2", e1, e2)
+            if key in self._cols:
+                return self._cols[key]
+            ent = None
+            h1 = self._host_group_codes(e1)
+            h2 = self._host_group_codes(e2)
+            if h1 is not None and h2 is not None:
+                n1, n2 = len(h1[1]), len(h2[1])
+                if 0 < n1 * n2 <= self._GROUP2_BUILD_CAP:
+                    codes = (h1[0].astype(np.int64) * n2
+                             + h2[0]).astype(np.int32)
+                    labels = [(l1, l2) for l1 in h1[1] for l2 in h2[1]]
+                    ex = None
+                    if h1[2] is not None or h2[2] is not None:
+                        both = np.ones(self.n, bool)
+                        if h1[2] is not None:
+                            both &= h1[2]
+                        if h2[2] is not None:
+                            both &= h2[2]
+                        ex = self._up(both)
+                    ent = ("group2", self._up(codes), labels, ex)
             self._cols[key] = ent
             return ent
 
@@ -905,7 +963,7 @@ class BlockScanPlane:
         }.get(m.kind)
         if kind_tag is None or step_ns <= 0 or end_ns <= start_ns:
             return None
-        if len(m.by) > 1:
+        if len(m.by) > 2:
             return None
         if not self._ensure_times():
             return None
@@ -921,7 +979,12 @@ class BlockScanPlane:
         sig, args, ints = plan
         esig, eargs, eints = extra
 
-        if m.by:
+        if len(m.by) == 2:
+            gent = self._ensure_group2(m.by[0], m.by[1])
+            if gent is None or len(gent[2]) > max_groups:
+                return None
+            _, gcodes, glabels, gex = gent
+        elif m.by:
             gent = self._ensure_group(m.by[0])
             if gent is None or len(gent[2]) > max_groups:
                 return None

@@ -57,6 +57,7 @@ class _MetricBase:
                                  budget=registry.budget)
         self.exemplars: dict[int, Exemplar] = {}  # slot -> last exemplar
         self._stale_pending: list[tuple[tuple[tuple[str, str], ...], float]] = []
+        self._ex_cursor = 0   # rotating exemplar-sampling window offset
 
     # -- staging helpers ---------------------------------------------------
 
@@ -86,10 +87,16 @@ class _MetricBase:
         ok = np.flatnonzero(slots >= 0)
         if len(ok) == 0:
             return
-        # dedupe over a bounded HEAD of the batch (a full-batch unique is
-        # a 16k sort per push — 1.3ms, costlier than what it saved); batch
-        # order, not slot order, so coverage rotates across pushes
-        head = ok[: max_new * 16]
+        # dedupe over a bounded ROTATING window (a full-batch unique is a
+        # 16k sort per push — 1.3ms, costlier than what it saved). The
+        # rotation guarantees tail series of a stably-ordered batch get
+        # their turn across pushes, which a fixed head would starve.
+        win = max_new * 16
+        start = self._ex_cursor % len(ok)
+        self._ex_cursor = start + win
+        head = ok[start:start + win]
+        if len(head) < win and start:
+            head = np.concatenate([head, ok[:win - len(head)]])
         _, first = np.unique(slots[head], return_index=True)
         for i in head[np.sort(first)[:max_new]].tolist():
             tid = trace_ids[i].tobytes().hex()

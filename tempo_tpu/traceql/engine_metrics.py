@@ -462,7 +462,7 @@ def grid_series(m: A.MetricsAggregate, labels: list, main: np.ndarray,
     bucket; avg emits the companion `__meta: count` series counting VALUED
     spans (vcnt). Labels ride pre-formatted from the plane's factorization
     (same `_fmt_label` path)."""
-    group_name = str(m.by[0]) if m.by else None
+    group_names = tuple(str(e) for e in m.by)
     k = m.kind
     hist = k in (A.MetricsKind.QUANTILE_OVER_TIME,
                  A.MetricsKind.HISTOGRAM_OVER_TIME)
@@ -470,7 +470,12 @@ def grid_series(m: A.MetricsAggregate, labels: list, main: np.ndarray,
     for gi, lbl in enumerate(labels):
         if not cnt[gi].any():
             continue
-        key = ((group_name, lbl),) if group_name is not None else ()
+        if not group_names:
+            key = ()
+        elif len(group_names) == 1:
+            key = ((group_names[0], lbl),)
+        else:   # multi-key: lbl is a value tuple in by() order
+            key = tuple(zip(group_names, lbl))
         if hist:
             for b in range(HBUCKETS):
                 col = main[gi, :, b]

@@ -98,6 +98,11 @@ QUERIES = [
     '{ } | avg_over_time(span.retries) by (resource.service.name)',
     '{ } | min_over_time(span.retries) by (resource.service.name)',
     '{ } | quantile_over_time(span.retries, .9) by (resource.service.name)',
+    # two-key group-by (the RED-dashboard shape) rides the fused plane
+    '{ } | rate() by (resource.service.name, name)',
+    '{ duration > 50ms } | quantile_over_time(duration, .9)'
+    ' by (resource.service.name, name)',
+    '{ } | avg_over_time(duration) by (name, span.region)',
     # unsupported shapes must still match via host fallback
     '{ name = "op-1" || duration > 400ms } | rate() by (name)',
 ]
