@@ -267,3 +267,35 @@ def test_nil_predicates_on_plane_path(dbs):
     assert set(a) == set(b)
     for k in b:
         np.testing.assert_allclose(a[k], b[k], rtol=1e-5)
+
+
+def test_many_blocks_bounded_grid_drain():
+    """More fused blocks than the in-flight grid window (8): the drain
+    path must still sum identically to the host engine."""
+    rng = np.random.default_rng(11)
+    be = MemBackend()
+    dev = _mk_db(be, True)
+    host = _mk_db(be, False)
+    for b in range(12):
+        traces = []
+        for i in range(40):
+            tid = rng.bytes(16)
+            start = int((T0 + b * 40 + i) * 1e9)
+            traces.append((tid, [{
+                "trace_id": tid, "span_id": rng.bytes(8),
+                "name": f"op-{i % 3}", "service": f"svc-{b % 2}",
+                "kind": 2, "status_code": 0,
+                "start_unix_nano": start,
+                "end_unix_nano": start + 5_000_000}]))
+        dev.write_block("t", traces, replication_factor=1)
+    dev.poll_now(); host.poll_now()
+    req = QueryRangeRequest(
+        query='{ } | quantile_over_time(duration, .9) by (name)',
+        start_ns=int(T0 * 1e9), end_ns=int((T0 + 500) * 1e9),
+        step_ns=int(100e9))
+    a = _series_map(dev.query_range("t", req))
+    b2 = _series_map(host.query_range("t", req))
+    assert dev.plane_stats["fused_metric_blocks"] >= 12
+    assert set(a) == set(b2)
+    for k in b2:
+        np.testing.assert_allclose(a[k], b2[k], rtol=1e-5)
