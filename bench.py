@@ -38,8 +38,14 @@ import time
 
 import numpy as np
 
-PROBE_TIMEOUT_S = 240      # TPU backend init alone can take ~60-90s
-STAGE_TIMEOUT_S = 900
+PROBE_TIMEOUT_S = float(os.environ.get("TEMPO_BENCH_PROBE_TIMEOUT_S", 360))
+REPROBE_TIMEOUT_S = float(
+    os.environ.get("TEMPO_BENCH_REPROBE_TIMEOUT_S", 240))
+STAGE_TIMEOUT_S = float(os.environ.get("TEMPO_BENCH_STAGE_TIMEOUT_S", 900))
+# soft deadline for OPTIONAL work (mid-run re-probes, accelerator re-runs
+# of stages that already have a CPU number). Mandatory work — one probe
+# pass + one run of every stage — always happens regardless.
+SOFT_DEADLINE_S = float(os.environ.get("TEMPO_BENCH_DEADLINE_S", 4200))
 
 
 def bench_kernel() -> dict:
@@ -413,7 +419,18 @@ def _cpu_env(env: dict) -> dict:
     return env
 
 
-def _run_child(args: list[str], env: dict, timeout_s: int) -> tuple[dict | None, str]:
+def _last_json(stdout: str) -> dict | None:
+    """Parse the last JSON-object line of a child's stdout."""
+    for line in reversed((stdout or "").strip().splitlines()):
+        try:
+            got = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        return got if isinstance(got, dict) else None
+    return None
+
+
+def _run_child(args: list[str], env: dict, timeout_s: float) -> tuple[dict | None, str]:
     """Run `python bench.py <args>`; return (parsed-last-JSON-line, err)."""
     try:
         proc = subprocess.run(
@@ -425,36 +442,37 @@ def _run_child(args: list[str], env: dict, timeout_s: int) -> tuple[dict | None,
     if proc.returncode != 0:
         tail = (proc.stderr or proc.stdout or "")[-800:]
         return None, f"rc={proc.returncode}: {tail}"
-    for line in reversed(proc.stdout.strip().splitlines()):
-        try:
-            return json.loads(line), ""
-        except (json.JSONDecodeError, ValueError):
-            continue
-    return None, f"no JSON in output: {(proc.stdout or '')[-400:]}"
+    out = _last_json(proc.stdout)
+    if out is None:
+        return None, f"no JSON in output: {(proc.stdout or '')[-400:]}"
+    return out, ""
 
 
-def _probe_platform() -> tuple[str, dict]:
-    """Bounded probe of the accelerator backend; never wedges the bench.
+def _probe_once(base: dict, timeout_s: float, tag: str) -> str | None:
+    """One bounded probe of the accelerator backend in a killable child.
 
-    Returns (platform_name, env_for_stages). Tries the default (axon/TPU)
-    backend in a killable child, retries once, then falls back to CPU.
+    Returns the platform name ("tpu"/"cpu"/...) or None on timeout/error.
     """
-    base = dict(os.environ)
-    if os.environ.get("TEMPO_BENCH_FORCE_CPU"):
-        return "cpu", _cpu_env(base)
-    for attempt in range(2):
-        out, err = _run_child(["--probe"], base, PROBE_TIMEOUT_S)
-        if out and out.get("platform"):
-            return str(out["platform"]), base
-        print(f"bench: platform probe attempt {attempt + 1} failed: {err}",
-              file=sys.stderr)
-    return "cpu", _cpu_env(base)
+    out, err = _run_child(["--probe"], base, timeout_s)
+    if out and out.get("platform"):
+        return str(out["platform"])
+    print(f"bench: platform probe ({tag}) failed: {err}", file=sys.stderr)
+    return None
 
 
 def main() -> int:
     if "--probe" in sys.argv:
         if os.environ.get("TEMPO_BENCH_PROBE_HANG"):   # fault-injection hook
             time.sleep(10_000)
+        # fault injection: probe hangs until the given epoch (models a
+        # wedged tunnel that recovers mid-run)
+        until = float(os.environ.get("TEMPO_BENCH_PROBE_HANG_UNTIL", 0))
+        if until and time.time() < until:
+            time.sleep(10_000)
+        fake = os.environ.get("TEMPO_BENCH_PROBE_FAKE")
+        if fake:                                       # fault-injection hook
+            print(json.dumps({"platform": fake, "device": "fake"}))
+            return 0
         import jax
         d = jax.devices()[0]
         x = jax.numpy.ones((4, 4)) @ jax.numpy.ones((4, 4))
@@ -464,27 +482,155 @@ def main() -> int:
         return 0
     for name, fn in STAGES.items():
         if f"--stage={name}" in sys.argv:
+            if os.environ.get("TEMPO_BENCH_STAGE_STUB"):  # orchestration test
+                print(json.dumps({f"stub_{name}": 1, "e2e_spans_per_sec": 1.0}
+                                 if name == "e2e" else {f"stub_{name}": 1}))
+                return 0
             print(json.dumps(fn()))
             return 0
 
-    platform, env = _probe_platform()
+    # Platform handling (round-5 rework): the round-4 failure mode was a
+    # tunnel that timed out during the first 8 minutes and a bench that
+    # then NEVER looked at the accelerator again — the whole round's
+    # record fell back to a CPU diagnostic. Now the probe is retried
+    # between stages, and any stage that had to run on CPU is re-run on
+    # the accelerator if it comes back before the soft deadline.
+    t_start = time.time()
+    base = dict(os.environ)
+    forced_cpu = bool(os.environ.get("TEMPO_BENCH_FORCE_CPU"))
+    accel: str | None = None        # accelerator platform name once seen
+    cpu_confirmed = False  # a probe RETURNED "cpu": default backend IS cpu,
+    #                        no accelerator will ever appear — stop probing
+    if not forced_cpu:
+        for attempt in range(2):
+            p = _probe_once(base, PROBE_TIMEOUT_S, f"startup {attempt + 1}")
+            if p is not None:
+                if p != "cpu":
+                    accel = p
+                else:
+                    cpu_confirmed = True
+                break
+
+    def soft_time_left() -> bool:
+        return (time.time() - t_start) < SOFT_DEADLINE_S
+
     results: dict = {}
     errors: dict = {}
     stage_platform: dict = {}
-    for name in STAGES:
+
+    # Background re-probe: while stages run on CPU (their children drop the
+    # relay env entirely, so they never contend for the accelerator lease),
+    # a single probe child keeps watching for the tunnel to come back —
+    # zero added wall time vs the serial probe-then-stage shape.
+    probe_proc: subprocess.Popen | None = None
+    probe_t0 = 0.0
+    reprobes_left = 4
+
+    def start_bg_probe() -> None:
+        nonlocal probe_proc, probe_t0, reprobes_left
+        if (probe_proc is not None or accel is not None or cpu_confirmed
+                or forced_cpu or reprobes_left <= 0 or not soft_time_left()):
+            return
+        reprobes_left -= 1
+        probe_proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--probe"],
+            env=base, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, cwd=os.path.dirname(os.path.abspath(__file__)))
+        probe_t0 = time.time()
+
+    def harvest_bg_probe(wait: bool = False) -> None:
+        """Collect a finished (or overdue) background probe, non-blocking
+        unless `wait` — then block up to the probe's remaining budget."""
+        nonlocal probe_proc, accel, cpu_confirmed
+        if probe_proc is None:
+            return
+        left = REPROBE_TIMEOUT_S - (time.time() - probe_t0)
+        try:
+            out = probe_proc.communicate(
+                timeout=max(left, 0.1) if wait else 0.01)[0]
+        except subprocess.TimeoutExpired:
+            if wait or left <= 0:
+                probe_proc.kill()
+                probe_proc.communicate()
+                probe_proc = None
+                print("bench: background probe timed out", file=sys.stderr)
+            return
+        probe_proc = None
+        got = _last_json(out)
+        p = got.get("platform") if got else None
+        if p and p != "cpu":
+            accel = str(p)
+            print(f"bench: background probe found {accel}", file=sys.stderr)
+        elif p == "cpu":
+            cpu_confirmed = True
+
+    def run_stage(name: str, want_accel: bool) -> None:
+        """Run one stage; on accelerator failure fall back to CPU."""
+        nonlocal accel
+        used = accel if (want_accel and accel) else "cpu"
+        env = base if used != "cpu" else _cpu_env(base)
         out, err = _run_child([f"--stage={name}"], env, STAGE_TIMEOUT_S)
-        used = platform
-        if out is None and platform != "cpu":
-            print(f"bench: stage {name} failed on {platform} ({err}); "
+        if out is None and used != "cpu":
+            print(f"bench: stage {name} failed on {used} ({err}); "
                   "retrying on cpu", file=sys.stderr)
-            out, err = _run_child([f"--stage={name}"], _cpu_env(env),
-                                  STAGE_TIMEOUT_S)
             used = "cpu"
+            out, err = _run_child([f"--stage={name}"], _cpu_env(base),
+                                  STAGE_TIMEOUT_S)
         if out is None:
             errors[name] = err
         else:
+            errors.pop(name, None)
             results.update(out)
             stage_platform[name] = used
+
+    for name in STAGES:
+        # a tunnel that recovers minutes after a cold start is still worth
+        # using: keep a background probe alive while stages run on CPU
+        start_bg_probe()
+        run_stage(name, want_accel=True)
+        harvest_bg_probe()
+        start_bg_probe()          # relaunch if the last one timed out
+
+    # the accelerator may have appeared mid-run; re-run any stage whose
+    # number was captured on CPU (e2e first — it is the headline metric)
+    if not forced_cpu:
+        cpu_stages = [n for n in STAGES if stage_platform.get(n) != accel
+                      or n in errors]
+        if cpu_stages and accel is None and soft_time_left():
+            harvest_bg_probe(wait=True)     # give the in-flight probe its
+            start_bg_probe()                # remaining budget, then one
+            harvest_bg_probe(wait=True)     # last fresh attempt
+        if accel is not None:
+            for name in cpu_stages:
+                if not soft_time_left():
+                    print("bench: soft deadline reached; keeping cpu "
+                          f"numbers for {cpu_stages}", file=sys.stderr)
+                    break
+                print(f"bench: re-running stage {name} on {accel}",
+                      file=sys.stderr)
+                used = accel
+                out, err = _run_child([f"--stage={name}"], base,
+                                      STAGE_TIMEOUT_S)
+                if out is not None:
+                    errors.pop(name, None)
+                    results.update(out)
+                    stage_platform[name] = used
+                else:
+                    print(f"bench: re-run of {name} on {accel} failed "
+                          f"({err}); keeping cpu number", file=sys.stderr)
+
+    if probe_proc is not None:
+        # never leak a probe child past exit: a wedged one can hold the
+        # accelerator tunnel lease into the NEXT bench run
+        probe_proc.kill()
+        probe_proc.communicate()
+        probe_proc = None
+
+    # headline platform = the platform the headline (e2e) number was
+    # captured on; fall back to the best any stage achieved
+    platform = stage_platform.get("e2e") or (
+        accel if accel in stage_platform.values() else None) or (
+        next(iter(stage_platform.values()), "cpu"))
 
     e2e_sps = results.get("e2e_spans_per_sec")
     kernel_sps = results.get("kernel_spans_per_sec")

@@ -790,12 +790,20 @@ class BlockScanPlane:
                 (kind, _, neg), lut = term
                 lut_dev = self._up(lut)
                 with self._lock:
-                    pluts = [k for k in self._cols if k[0] == "plut"]
-                    if len(pluts) >= 256:
-                        for k in pluts[:128]:
-                            arr = self._cols.pop(k)[1]
-                            self.device_bytes -= int(arr.nbytes)
-                    self._cols[lkey] = (neg, lut_dev)
+                    # re-check under the lock: a racing thread may have
+                    # inserted the same key while we uploaded — keep its
+                    # entry and refund our duplicate's budget accounting
+                    again = self._cols.get(lkey)
+                    if again is not None:
+                        self.device_bytes -= int(lut.nbytes)
+                        neg, lut_dev = again
+                    else:
+                        pluts = [k for k in self._cols if k[0] == "plut"]
+                        if len(pluts) >= 256:
+                            for k in pluts[:128]:
+                                arr = self._cols.pop(k)[1]
+                                self.device_bytes -= int(arr.nbytes)
+                        self._cols[lkey] = (neg, lut_dev)
             else:
                 neg, lut_dev = cached
             has_ex = ent[3] is not None
@@ -817,7 +825,14 @@ class BlockScanPlane:
             return (("const", False), [], [])
         norm = _int_literal(c.op, v if not isinstance(v, bool) else int(v))
         if norm[0] == "const":
-            return (("const", norm[1]), [], [])
+            if not norm[1] or ex is None:
+                return (("const", norm[1]), [], [])
+            # the literal-compare is constant-TRUE for every present value
+            # (e.g. `.x != 1.5` on an int column), but spans missing the
+            # attribute must still be excluded — the host plane ANDs
+            # l.exists (eval._compare) — so emit the existence mask, not
+            # a bare const
+            return (("nil", True, True), [ex], [])
         _, op2, lit = norm
         lh, ll = _split_lit(lit)
         has_ex = ex is not None
@@ -848,11 +863,16 @@ class BlockScanPlane:
                 lut[np.asarray(sel)] = True
             got = self._up(lut)               # budget-accounted like all uploads
             with self._lock:
-                rgluts = [k for k in self._cols if k[0] == "rglut"]
-                if len(rgluts) >= 64:
-                    for k in rgluts[:32]:
-                        self.device_bytes -= int(self._cols.pop(k).nbytes)
-                self._cols[key] = got
+                again = self._cols.get(key)
+                if again is not None:         # lost an upload race: refund
+                    self.device_bytes -= int(lut.nbytes)
+                    got = again
+                else:
+                    rgluts = [k for k in self._cols if k[0] == "rglut"]
+                    if len(rgluts) >= 64:
+                        for k in rgluts[:32]:
+                            self.device_bytes -= int(self._cols.pop(k).nbytes)
+                    self._cols[key] = got
         return got
 
     def _extra_terms(self, time_range, row_groups):
@@ -1019,14 +1039,22 @@ class BlockScanPlane:
         if abs(q_steps) > 1 << 30:
             return None
 
+        # exact step bucketing is available when the grid is small enough
+        # that 16-bit limb products stay in int32 and the f32 estimate is
+        # provably within one step of the truth (guard below); outside it
+        # the f32 path applies with a documented boundary tolerance
+        exact = (n_steps <= (1 << 14) and abs(q_steps) <= (1 << 20)
+                 and start_ns >= 0 and step_ns > 0
+                 and start_ns + (n_steps + 1) * step_ns < (1 << 63))
         key = (sig, esig, all_conditions, kind_tag, n_groups, n_steps,
-               gcodes is not None, gex is not None, v_has_ex)
+               gcodes is not None, gex is not None, v_has_ex, exact)
         with self._lock:
             fn = self._qr_cache.get(key)
         if fn is None:
             n = self.n
 
-            def build(rel, ivec, fvec, gcodes, gex, vcol, vex, *margs):
+            def build(rel, thi, tlo, ivec, fvec, gcodes, gex, vcol, vex,
+                      *margs):
                 q_steps = ivec[0]
                 frac_s, step_s = fvec[0], fvec[1]
                 pred_masks, used, k = _term_masks(jnp, sig, margs, n,
@@ -1044,11 +1072,50 @@ class BlockScanPlane:
                 # step index split for precision: the whole-step offset
                 # between window start and block base is EXACT int host
                 # math; f32 only covers the sub-step fraction + intra-
-                # block offsets (small however far the window sits). The
-                # end/start clips are exact int compares in extra_masks.
+                # block offsets. The f32 estimate is then snapped to the
+                # EXACT integer floor((t_ns - start_ns) / step_ns) by
+                # comparing the resident (hi, lo) int timestamps against
+                # the limb-computed boundaries start_ns + q*step_ns — the
+                # host engine's float64 bucketing is exact for ns < 2^53,
+                # so boundary spans classify identically on both planes.
                 local = rel + frac_s
                 step_idx = q_steps + jnp.floor(local / step_s
                                                ).astype(jnp.int32)
+                if exact:
+                    # ivec tail: step_ns 16-bit limbs (4), start_ns 16-bit
+                    # limbs (4), low-to-high; the guard (n_steps <= 2^14,
+                    # |q_steps| <= 2^20) bounds the f32 error under one
+                    # step and keeps every limb product inside int32
+                    sl = [ivec[-8 + i] for i in range(4)]
+                    ul = [ivec[-4 + i] for i in range(4)]
+                    # t_ns = thi * 2^31 + tlo (the 33/31 _split_i64 form;
+                    # tlo is non-negative) → 16-bit limbs low-to-high
+                    w = [tlo & 0xffff,
+                         ((tlo >> 16) & 0x7fff) | ((thi & 1) << 15),
+                         (thi >> 1) & 0xffff,
+                         (thi >> 17) & 0xffff]
+
+                    def ge_boundary(q):
+                        # t_ns >= start_ns + q*step_ns, via 16-bit limbs
+                        carry = 0
+                        r = []
+                        for i in range(4):
+                            v = ul[i] + q * sl[i] + carry
+                            r.append(v & 0xffff)
+                            carry = v >> 16
+                        ge = w[0] >= r[0]
+                        for wi, ri in zip(w[1:], r[1:]):
+                            ge = jnp.where(wi == ri, ge, wi > ri)
+                        return ge
+
+                    qc = jnp.clip(step_idx, 0, n_steps)
+                    # the guard bounds |estimate - truth| <= 1, so the
+                    # true index is qc+1, qc, or qc-1 (qc-1 is -1 when
+                    # the span truly precedes the window, since qc
+                    # clips at 0 — the ok mask drops it)
+                    step_idx = jnp.where(
+                        ge_boundary(qc + 1), qc + 1,
+                        jnp.where(ge_boundary(qc), qc, qc - 1))
                 ok = mask & (step_idx >= 0) & (step_idx < n_steps)
                 if gcodes is not None:
                     slots = gcodes
@@ -1106,9 +1173,14 @@ class BlockScanPlane:
                     self._qr_cache.pop(next(iter(self._qr_cache)))
                 fn = self._qr_cache.setdefault(key, fn)
 
-        ivec = np.asarray([q_steps] + ints + eints, np.int32)
+        ivals = [q_steps] + ints + eints
+        if exact:
+            ivals += [(step_ns >> s) & 0xffff for s in (0, 16, 32, 48)]
+            ivals += [(start_ns >> s) & 0xffff for s in (0, 16, 32, 48)]
+        ivec = np.asarray(ivals, np.int32)
         fvec = np.asarray([frac_ns / 1e9, step_ns / 1e9], np.float32)
-        packed = fn(self._cols[("times",)][0], ivec, fvec,
+        trel, thi, tlo = self._cols[("times",)]
+        packed = fn(trel, thi, tlo, ivec, fvec,
                     gcodes, gex, vargs[0] if vargs else None,
                     vargs[1] if len(vargs) > 1 else None,
                     *args, *eargs)

@@ -109,6 +109,15 @@ def _extract_expr(e, req: FetchSpansRequest, top_level: bool = False) -> None:
     clears the flag but still registers column fetches.
     """
     if isinstance(e, A.Static):
+        # a literal `true` is an AND-identity (and a bare `{ true }` arm
+        # registers via has_unconditioned_arm); anything else — `false`,
+        # or a non-boolean literal — cannot be expressed as a pushed-down
+        # condition, so the condition set is no longer exhaustive: clear
+        # all_conditions to force the engine's exact second pass (and the
+        # fused-metrics gate off) instead of silently matching everything
+        if not (getattr(e, "type", None) == A.StaticType.BOOL
+                and e.value is True):
+            req.all_conditions = False
         return
     if isinstance(e, A.BinaryOp):
         if e.op == A.Op.AND:

@@ -1,0 +1,74 @@
+"""bench.py orchestration: the platform probe/re-probe/re-run machinery.
+
+Round-4 postmortem: the bench probed the accelerator twice at startup and
+then NEVER looked again, so a tunnel that wedged for 8 minutes cost the
+whole round its TPU record (BENCH_r04: platform "cpu"). These tests drive
+the round-5 orchestrator through its fault-injection hooks — stages are
+stubbed (TEMPO_BENCH_STAGE_STUB), the probe can hang until a chosen epoch
+(TEMPO_BENCH_PROBE_HANG_UNTIL) and report a fake platform
+(TEMPO_BENCH_PROBE_FAKE) — asserting the mid-run recovery, permanent-
+failure, and healthy-startup paths without any accelerator.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+BENCH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench.py")
+
+
+def _run(hang_s: float | None = None, fake: str = "tpu",
+         probe_timeout: float = 3, reprobe_timeout: float = 6,
+         timeout: float = 120) -> tuple[dict, str]:
+    env = dict(os.environ)
+    env.update({
+        "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+        "TEMPO_BENCH_STAGE_STUB": "1",
+        "TEMPO_BENCH_PROBE_FAKE": fake,
+        "TEMPO_BENCH_PROBE_TIMEOUT_S": str(probe_timeout),
+        "TEMPO_BENCH_REPROBE_TIMEOUT_S": str(reprobe_timeout),
+    })
+    if hang_s is not None:
+        env["TEMPO_BENCH_PROBE_HANG_UNTIL"] = str(time.time() + hang_s)
+    proc = subprocess.run([sys.executable, BENCH], env=env,
+                          capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    return line, proc.stderr
+
+
+def test_healthy_startup_probe_uses_accelerator():
+    line, err = _run(hang_s=None)
+    assert line["extra"]["platform"] == "tpu"
+    assert set(line["extra"]["stage_platform"].values()) == {"tpu"}
+    assert "re-running" not in err          # nothing captured on cpu
+
+
+def test_probe_recovers_mid_run_rereuns_cpu_stages():
+    # startup probes (2 x 3s) fail; the background probe finds the fake
+    # accelerator ~10s in; every cpu-captured stage must be re-run on it
+    line, err = _run(hang_s=10)
+    assert line["extra"]["platform"] == "tpu"
+    assert set(line["extra"]["stage_platform"].values()) == {"tpu"}
+    assert "background probe found tpu" in err
+
+
+def test_probe_never_recovers_keeps_cpu_numbers():
+    line, err = _run(hang_s=3600)
+    assert line["extra"]["platform"] == "cpu"
+    assert set(line["extra"]["stage_platform"].values()) == {"cpu"}
+    # the bench still emitted a full record (rc 0, headline value present)
+    assert line["value"] == 1.0
+
+
+def test_confirmed_cpu_platform_stops_reprobing():
+    # probe SUCCEEDS but reports cpu: the orchestrator must accept that
+    # no accelerator exists and not burn re-probe budget
+    line, err = _run(hang_s=None, fake="cpu")
+    assert line["extra"]["platform"] == "cpu"
+    assert "background probe" not in err

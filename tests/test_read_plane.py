@@ -105,6 +105,17 @@ QUERIES = [
     '{ } | avg_over_time(duration) by (name, span.region)',
     # unsupported shapes must still match via host fallback
     '{ name = "op-1" || duration > 400ms } | rate() by (name)',
+    # NEQ with a non-integral literal on an int column is constant-true
+    # for present values but must still exclude spans MISSING the attr
+    # (advisor r4 medium: the ("const", True) plan dropped the exists
+    # mask; svc-2 spans carry no retries)
+    '{ span.retries != 1.5 } | rate() by (resource.service.name)',
+    # boolean literal filters: `false` matches nothing, `x && false`
+    # matches nothing, `true` matches all — the extractor must not treat
+    # the dropped literal as absent on the fused path (advisor r4 low)
+    '{ false } | rate() by (name)',
+    '{ name = "op-1" && false } | count_over_time() by (name)',
+    '{ true } | rate() by (name)',
 ]
 
 
@@ -299,3 +310,98 @@ def test_many_blocks_bounded_grid_drain():
     assert set(a) == set(b2)
     for k in b2:
         np.testing.assert_allclose(a[k], b2[k], rtol=1e-5)
+
+
+def test_step_boundary_exact_bucketing():
+    """Spans landing just either side of a step boundary — hours from the
+    block base, where float32 seconds carry ~0.5ms of error — must bucket
+    identically on the fused and host planes (advisor r4 low: the f32
+    `rel + frac` path put boundary spans into the adjacent bucket; the
+    limb-exact path snaps the estimate to the true integer floor in BOTH
+    directions). Offsets are ±300ns: large enough to survive the float64
+    `__startTime` quantization (ulp = 256ns at epoch 1.7e18) that erases
+    ±1ns before either plane sees it, small enough that f32 rounds them
+    onto the boundary."""
+    be = MemBackend()
+    dev = _mk_db(be, True)
+    host = _mk_db(be, False)
+    rng = np.random.default_rng(5)
+    base_ns = int(T0 * 1e9)
+    step_ns = int(60e9)
+    traces = []
+    # an anchor span AT base keeps time_base_ns == base_ns
+    for k in range(1, 200):
+        for off in (-300, 0, 300):
+            tid = rng.bytes(16)
+            start = base_ns + k * step_ns + off
+            traces.append((tid, [{
+                "trace_id": tid, "span_id": rng.bytes(8),
+                "name": f"op-{k % 3}", "service": "svc",
+                "kind": 2, "status_code": 0,
+                "start_unix_nano": start,
+                "end_unix_nano": start + 1_000_000}]))
+    traces.append((rng.bytes(16), [{
+        "trace_id": rng.bytes(16), "span_id": rng.bytes(8),
+        "name": "op-0", "service": "svc", "kind": 2, "status_code": 0,
+        "start_unix_nano": base_ns, "end_unix_nano": base_ns + 1_000_000}]))
+    dev.write_block("t", traces, replication_factor=1)
+    dev.poll_now(); host.poll_now()
+    req = QueryRangeRequest(
+        query='{ } | count_over_time() by (name)',
+        start_ns=base_ns, end_ns=base_ns + 200 * step_ns, step_ns=step_ns)
+    a = _series_map(dev.query_range("t", req))
+    b = _series_map(host.query_range("t", req))
+    assert dev.plane_stats["fused_metric_blocks"] >= 1
+    assert set(a) == set(b)
+    for k in b:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=str(k))
+
+
+def test_plane_upload_race_refunds_budget():
+    """A racing duplicate LUT upload must keep one entry and refund the
+    loser's device_bytes (advisor r4 low: both uploads were counted, one
+    entry overwritten, the eviction budget permanently over-counted)."""
+    dev, _ = _race_dbs()
+    meta = dev.blocklist.metas("t")[0]
+    plane = dev.planes.get(dev.backend_block(meta)).plane
+    before = plane.device_bytes
+    # simulate the race: insert the key mid-upload via a patched _up
+    key = ("rglut", (0,))
+    real_up = plane._up
+
+    def racing_up(arr):
+        out = real_up(arr)                  # our upload (accounted)
+        if key not in plane._cols:
+            plane._cols[key] = real_up(np.asarray(arr))  # rival's insert
+        return out
+
+    plane._up = racing_up
+    try:
+        got = plane._ensure_rg_lut([0])
+    finally:
+        plane._up = real_up
+    rival = plane._cols[key]
+    assert got is rival                     # the first insert won
+    # exactly ONE surviving entry is accounted for
+    assert plane.device_bytes == before + int(np.zeros(
+        len(plane.sizes), bool).nbytes)
+
+
+def _race_dbs():
+    rng = np.random.default_rng(13)
+    be = MemBackend()
+    dev = _mk_db(be, True)
+    traces = []
+    for i in range(20):
+        tid = rng.bytes(16)
+        start = int((T0 + i) * 1e9)
+        traces.append((tid, [{
+            "trace_id": tid, "span_id": rng.bytes(8),
+            "name": f"op-{i % 3}", "service": "svc", "kind": 2,
+            "status_code": 0, "start_unix_nano": start,
+            "end_unix_nano": start + 1_000_000}]))
+    dev.write_block("t", traces, replication_factor=1)
+    dev.poll_now()
+    # a first query adopts the columns so the plane is resident
+    dev.search("t", '{ name = "op-1" }', limit=10)
+    return dev, None
