@@ -1,0 +1,193 @@
+#!/usr/bin/env python3
+"""Single-source dashboard generator (tempo-mixin `dashboards.libsonnet`
+analog — the reference generates its Grafana dashboards from jsonnet so
+panels and recording rules cannot drift; here one Python spec generates
+the four dashboards under operations/dashboards/, and a CI test
+regenerates them and fails on drift, the same guarantee without a jsonnet
+toolchain).
+
+Usage: python operations/gen_dashboards.py [--check]
+  --check: exit 1 if any committed dashboard differs from the generated
+  output (the drift gate tests/test_aux.py runs).
+
+Every metric name referenced here is also covered by
+tests/test_app.py::test_ops_files_reference_only_emitted_metrics, so a
+panel can neither drift from this spec nor reference a metric the server
+does not emit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+OUT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "dashboards")
+
+SERIES_BUDGET = 65536      # default max_active_series (overrides.py)
+
+
+def p(title: str, *exprs: str, kind: str = "timeseries",
+      unit: str | None = None, legend: str | None = None) -> dict:
+    """One panel; grid position is assigned by `dash` (3 per row)."""
+    panel: dict = {"title": title, "type": kind,
+                   "targets": [{"expr": e} for e in exprs]}
+    if legend:
+        for t in panel["targets"]:
+            t["legendFormat"] = legend
+    if unit:
+        panel["fieldConfig"] = {"defaults": {"max": 1, "min": 0,
+                                             "unit": unit}}
+    return panel
+
+
+def dash(title: str, description: str, panels: list[dict]) -> dict:
+    for i, panel in enumerate(panels):
+        panel["gridPos"] = {"x": (i % 3) * 8, "y": (i // 3) * 8,
+                            "w": 8, "h": 8}
+    return {"title": title, "description": description,
+            "schemaVersion": 39, "panels": panels}
+
+
+def _rate(metric: str, by: str | None = None, win: str = "5m") -> str:
+    e = f"rate({metric}[{win}])"
+    return f"sum({e}) by ({by})" if by else f"sum({e})"
+
+
+def _ratio(hit: str, miss: str, win: str = "5m") -> str:
+    return (f"rate({hit}[{win}]) / (rate({hit}[{win}])"
+            f" + rate({miss}[{win}]))")
+
+
+def dashboards() -> dict[str, dict]:
+    slo_ratio = (
+        "sum(rate(tempo_query_frontend_queries_within_slo_total[5m])) by (op)"
+        " / sum(rate(tempo_query_frontend_queries_total[5m])) by (op)")
+    return {
+        "tempo-tpu-overview.json": dash(
+            "tempo-tpu / overview",
+            "Operational overview over tempo_tpu self-metrics (tempo-mixin"
+            " dashboard analog, rewritten for this build's metric names).",
+            [
+                p("Spans received /s",
+                  _rate("tempo_distributor_spans_received_total")),
+                p("Bytes received /s",
+                  _rate("tempo_distributor_bytes_received_total")),
+                p("Discarded spans /s by reason",
+                  _rate("tempo_discarded_spans_total", "reason"),
+                  legend="{{reason}}"),
+                p("Live traces per ingester tenant",
+                  "sum(tempo_ingester_live_traces) by (tenant)",
+                  legend="{{tenant}}"),
+                p("Generator spans /s",
+                  _rate("tempo_metrics_generator_spans_received_total",
+                        "tenant"), legend="{{tenant}}"),
+                p("Generator active series",
+                  "sum(tempo_metrics_generator_registry_active_series)"
+                  " by (tenant)", legend="{{tenant}}"),
+                p("Queries /s by op",
+                  _rate("tempo_query_frontend_queries_total", "op"),
+                  legend="{{op}}"),
+                p("Within-SLO ratio by op", slo_ratio,
+                  unit="percentunit", legend="{{op}}"),
+                p("Data-quality warnings /s",
+                  _rate("tempo_warnings_total", "reason"),
+                  legend="{{reason}}"),
+            ]),
+        "tempo-tpu-reads.json": dash(
+            "Tempo-TPU / Reads",
+            "Read path: frontend SLOs, response cache, device read plane"
+            " routing (tempo-mixin tempo-reads.json analog)",
+            [
+                p("Queries /s by op",
+                  _rate("tempo_query_frontend_queries_total", "op")),
+                p("Within-SLO ratio by op", slo_ratio),
+                p("Frontend cache hit ratio",
+                  _ratio("tempo_query_frontend_cache_hits_total",
+                         "tempo_query_frontend_cache_misses_total")),
+                p("Device-plane fused blocks /s",
+                  "rate(tempo_read_plane_fused_metric_blocks_total[5m])"),
+                p("Host-fallback blocks /s",
+                  "rate(tempo_read_plane_host_metric_blocks_total[5m])"),
+                p("Plane cache hit ratio",
+                  _ratio("tempo_read_plane_cache_hits_total",
+                         "tempo_read_plane_cache_misses_total")),
+                p("Plane cache device bytes",
+                  "tempo_read_plane_cache_device_bytes"),
+                p("Plane cache host bytes",
+                  "tempo_read_plane_cache_host_bytes"),
+                p("Plane cache entries", "tempo_read_plane_cache_entries"),
+            ]),
+        "tempo-tpu-writes.json": dash(
+            "Tempo-TPU / Writes",
+            "Write path: receivers -> distributor -> ingester/generator"
+            " (operations/tempo-mixin tempo-writes.json analog, on this"
+            " build's metric names)",
+            [
+                p("Spans received /s",
+                  _rate("tempo_distributor_spans_received_total")),
+                p("Bytes received /s",
+                  _rate("tempo_distributor_bytes_received_total")),
+                p("Traces pushed /s",
+                  _rate("tempo_distributor_traces_pushed_total")),
+                p("Discarded spans /s by reason",
+                  _rate("tempo_discarded_spans_total", "reason")),
+                p("Push failures /s (quorum)",
+                  "rate(tempo_distributor_push_failures_total[5m])"),
+                p("Ingester live traces",
+                  "sum(tempo_ingester_live_traces) by (tenant)"),
+                p("Ingester discards /s",
+                  _rate("tempo_ingester_discarded_traces_total", "reason")),
+                p("Generator spans /s",
+                  _rate("tempo_metrics_generator_spans_received_total",
+                        "tenant")),
+                p("Data-quality warnings /s",
+                  _rate("tempo_warnings_total", "reason")),
+            ]),
+        "tempo-tpu-resources.json": dash(
+            "Tempo-TPU / Resources",
+            "Capacity: series budgets, cache residency, usage accounting"
+            " (tempo-mixin tempo-resources.json analog)",
+            [
+                p("Generator active series by tenant",
+                  "tempo_metrics_generator_registry_active_series"),
+                p("Series budget headroom",
+                  "1 - max(tempo_metrics_generator_registry_active_series)"
+                  f" / {SERIES_BUDGET}", kind="stat"),
+                p("Device-plane memory (bytes)",
+                  "tempo_read_plane_cache_device_bytes",
+                  "tempo_read_plane_cache_host_bytes"),
+                p("Live traces (memory proxy)",
+                  "sum(tempo_ingester_live_traces)"),
+                p("Ingest bytes /s (capacity driver)",
+                  _rate("tempo_distributor_bytes_received_total")),
+                p("Usage-stats reports written",
+                  "tempo_usage_stats_reports_written_total", kind="stat"),
+            ]),
+    }
+
+
+def main() -> int:
+    check = "--check" in sys.argv
+    drift = []
+    for fname, spec in dashboards().items():
+        path = os.path.join(OUT_DIR, fname)
+        text = json.dumps(spec, indent=1) + "\n"
+        if check:
+            on_disk = open(path).read() if os.path.exists(path) else ""
+            if on_disk != text:
+                drift.append(fname)
+        else:
+            with open(path, "w") as f:
+                f.write(text)
+            print(f"wrote {path}")
+    if drift:
+        print(f"DRIFT: {drift} — run python operations/gen_dashboards.py",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -583,7 +583,12 @@ class Handler(BaseHTTPRequestHandler):
         db = getattr(self.app, "db", None)
         if db is not None:
             for k, v in db.plane_stats.items():
-                lines.append(f"tempo_read_plane_{k}_total {v}")
+                if k.startswith("fallback_"):
+                    # per-cause host-fallback counters (round-4 weak #4)
+                    lines.append(f'tempo_read_plane_fallback_total'
+                                 f'{{cause="{k[9:]}"}} {v}')
+                else:
+                    lines.append(f"tempo_read_plane_{k}_total {v}")
             if db.planes is not None:
                 ps = db.planes.stats()
                 for k in ("entries", "device_bytes", "host_bytes",

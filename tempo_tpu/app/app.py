@@ -194,8 +194,9 @@ class App:
         if self.bus is None:
             raise ValueError(
                 "target=block-builder requires ingest.enabled: true")
-        parts = tuple(self.cfg.ingest.partitions) or \
-            tuple(range(self.cfg.ingest.n_partitions))
+        parts: "tuple | None" = tuple(self.cfg.ingest.partitions) or None
+        if parts is None and not hasattr(self.bus, "group_request"):
+            parts = tuple(range(self.cfg.ingest.n_partitions))
         self.blockbuilder = BlockBuilder(
             self.bus, self.backend,
             BlockBuilderConfig(partitions=parts), now=self.now)
@@ -433,7 +434,12 @@ class App:
         if self.bus is not None and (self.blockbuilder is not None
                                      or self.generator is not None):
             ic = self.cfg.ingest
-            parts = tuple(ic.partitions) or tuple(range(ic.n_partitions))
+            # explicit partitions pin a static assignment; otherwise a
+            # Kafka bus runs in consumer-group mode (None) and an
+            # in-process bus consumes everything
+            parts: "tuple | None" = tuple(ic.partitions) or None
+            if parts is None and not hasattr(self.bus, "group_request"):
+                parts = tuple(range(ic.n_partitions))
             self.bus_consume_errors = 0
 
             def consume_loop():

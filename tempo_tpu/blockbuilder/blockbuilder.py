@@ -18,7 +18,9 @@ CONSUMER_GROUP = "blockbuilder"
 
 @dataclasses.dataclass
 class BlockBuilderConfig:
-    partitions: tuple[int, ...] = (0,)       # owned partitions
+    # owned partitions; None = consumer-group mode on a Kafka bus (the
+    # group protocol assigns + re-assigns partitions across replicas)
+    partitions: "tuple[int, ...] | None" = (0,)
     consume_cycle_records: int = 1000        # per-cycle fetch budget
     max_block_objects: int = 100_000
     dedicated_columns: tuple = ()
@@ -34,16 +36,32 @@ class BlockBuilder:
         self.now = now
         self.blocks_flushed = 0
         self.records_consumed = 0
+        self._cg = None                      # lazy ConsumerGroup
+
+    def _owned(self):
+        """(partitions, group) for this cycle: static assignment, or the
+        consumer-group's current assignment (rebalances between cycles
+        as replicas come and go — reader_client.go's franz-go group)."""
+        if self.cfg.partitions is not None:
+            return list(self.cfg.partitions), None
+        if hasattr(self.bus, "group_request"):
+            if self._cg is None:
+                from tempo_tpu.ingest.kafka import ConsumerGroup
+                self._cg = ConsumerGroup(self.bus, CONSUMER_GROUP,
+                                         now=self.now)
+            return self._cg.ensure_active(), self._cg
+        return list(range(getattr(self.bus, "n_partitions", 1))), None
 
     def consume_cycle(self) -> int:
         """One cycle: per owned partition, drain from the committed offset,
         build+flush one block per tenant, then commit. Returns records."""
         total = 0
-        for p in self.cfg.partitions:
-            total += self._consume_partition(p)
+        parts, cg = self._owned()
+        for p in parts:
+            total += self._consume_partition(p, cg)
         return total
 
-    def _consume_partition(self, partition: int) -> int:
+    def _consume_partition(self, partition: int, cg=None) -> int:
         start = self.bus.committed(CONSUMER_GROUP, partition)
         recs = self.bus.fetch(partition, start, self.cfg.consume_cycle_records)
         if not recs:
@@ -67,7 +85,10 @@ class BlockBuilder:
                             replication_factor=1)
                 self.blocks_flushed += 1
         next_offset = recs[-1].offset + 1
-        self.bus.commit(CONSUMER_GROUP, partition, next_offset)
+        if cg is not None:
+            cg.commit(partition, next_offset)    # generation-fenced
+        else:
+            self.bus.commit(CONSUMER_GROUP, partition, next_offset)
         n = len(recs)
         self.records_consumed += n
         return n

@@ -245,6 +245,13 @@ class TempoDB:
                 drain(MAX_INFLIGHT - 1)   # pipeline, bounded residency
             else:
                 self.plane_stats["host_metric_blocks"] += 1
+                # distinguish WHY (round-4 weak #4: a float-attr workload
+                # silently lost the fused win with no visible cause)
+                cause = (cb.plane.last_fallback or "unknown") if fusable \
+                    else ("disabled" if self.planes is None
+                          else "query_shape")
+                k = f"fallback_{cause}"
+                self.plane_stats[k] = self.plane_stats.get(k, 0) + 1
                 for view, cand in self.scan_source(m, freq, row_groups):
                     if len(cand):
                         ev.observe(view)

@@ -95,6 +95,9 @@ class Distributor:
         from tempo_tpu.utils.usage import UsageTracker
         self.usage = UsageTracker()
         self.dataquality = DataQuality(now=now)
+        # resource-bytes -> service.name memo (usage attribution): steady
+        # traffic repeats the same few Resource messages every push
+        self._svc_cache: dict[bytes, str] = {}
         self.forwarders = ForwarderManager()
         for tenant, fwd_cfgs in (self.cfg.forwarders or {}).items():
             for fc in fwd_cfgs:
@@ -172,6 +175,16 @@ class Distributor:
         return self.push_spans(tenant, spans, size_bytes=len(raw),
                                raw_otlp=raw, raw_recs=recs2)
 
+    def _service_cached(self, raw: bytes, off: int, ln: int) -> str:
+        """Memoized `_resource_service` keyed by the resource BYTES."""
+        key = raw[off:off + ln] if ln > 0 else b""
+        got = self._svc_cache.get(key)
+        if got is None:
+            if len(self._svc_cache) >= 4096:
+                self._svc_cache.clear()
+            got = self._svc_cache[key] = _resource_service(raw, off, ln)
+        return got
+
     def _push_otlp_columnar(self, tenant: str, raw: bytes,
                             recs: np.ndarray, lim) -> dict[str, int]:
         n = len(recs)
@@ -187,22 +200,26 @@ class Distributor:
         self.metrics["bytes_received_total"] += sz
         self.dataquality.observe_start_ns(tenant, recs["start_ns"])
 
-        # usage attribution by service: parse each UNIQUE Resource once
-        # (the wire offset alone identifies a Resource message)
-        uniq_off, first_r, inv_res = np.unique(
-            recs["res_off"].astype(np.int64), return_index=True,
-            return_inverse=True)
-        services = [_resource_service(raw, int(o), int(recs["res_len"][i]))
-                    for o, i in zip(uniq_off, first_r)]
-        if self.usage.cfg.dimensions == ("service",):
+        # usage attribution by service: scan records arrive grouped by
+        # ResourceSpans, so each distinct res_off is ONE contiguous run —
+        # run detection replaces the sorting np.unique, and the resource
+        # parse is memoized on the resource BYTES (payload shapes repeat
+        # push to push; same attributed result, no per-push re-parse)
+        if n and self.usage.cfg.dimensions == ("service",):
+            ro = recs["res_off"]
+            change = np.empty(n, bool)
+            change[0] = True
+            np.not_equal(ro[1:], ro[:-1], out=change[1:])
+            first_r = np.flatnonzero(change)
+            run_lens = np.diff(np.append(first_r, n))
             # even split of the wire size, matching observe(size_bytes=..)
             # so path choice cannot shift a tenant's attributed bytes
-            counts = np.bincount(inv_res, minlength=len(uniq_off))
             per_span = sz / max(n, 1)
             self.usage.observe_grouped(tenant, [
-                ((services[i],), int(counts[i]),
-                 float(counts[i]) * per_span)
-                for i in range(len(uniq_off)) if counts[i]])
+                ((self._service_cached(raw, int(ro[i]),
+                                       int(recs["res_len"][i])),),
+                 int(c), float(c) * per_span)
+                for i, c in zip(first_r.tolist(), run_lens.tolist())])
 
         # validation: vectorized trace-id check (pkg/validation)
         errs: dict[str, int] = {}
@@ -218,14 +235,21 @@ class Distributor:
         # wire length) — the length disambiguates a short id from the
         # 16-byte id that shares its zero-padded form (the dict path keys
         # on exact bytes). `requestsByTraceID` distributor.go:694 without
-        # the O(n log n) sort numpy's void unique would pay.
-        tids = np.ascontiguousarray(recs["trace_id"])
+        # the O(n log n) sort numpy's void unique would pay — and read
+        # straight from the records, skipping the key-matrix copies.
+        from tempo_tpu import native as _native
+
         vrows = np.flatnonzero(valid)
-        keys = np.concatenate(
-            [tids[vrows], recs["tid_len"][vrows, None].astype(np.uint8)],
-            axis=1)
-        first, inverse = group_keys(keys)
-        uniq_mat = tids[vrows[first]]
+        got = _native.group_keys_recs(recs, valid)
+        if got is not None:
+            first, inverse = got
+        else:
+            tids_all = np.ascontiguousarray(recs["trace_id"])
+            keys = np.concatenate(
+                [tids_all[vrows],
+                 recs["tid_len"][vrows, None].astype(np.uint8)], axis=1)
+            first, inverse = group_keys(keys)
+        uniq_mat = np.ascontiguousarray(recs["trace_id"][vrows[first]])
         uniq_len = recs["tid_len"][vrows[first]]
         tokens = token_for(tenant, uniq_mat)
         n_traces = len(first)
@@ -303,6 +327,13 @@ class Distributor:
         # generator tee (RF1, best-effort, raw slices)
         if self.generator_ring is not None and self.generator_clients \
                 and lim.generator.processors:
+            def recs_for(items: list[int]) -> np.ndarray:
+                if len(items) == n_traces and len(vrows) == len(recs):
+                    return recs
+                pick = np.zeros(n_traces, bool)
+                pick[np.asarray(items, np.int64)] = True
+                return recs[vrows[pick[inverse]]]
+
             def send_gen(inst: InstanceDesc, items: list[int]) -> None:
                 client = self.generator_clients[inst.id]
                 if getattr(client, "accepts_local_trust", False):
@@ -310,6 +341,13 @@ class Distributor:
                     # inferred): these bytes already passed this process's
                     # scan validation, so the stage may trust them. Remote
                     # clients re-validate at their own process boundary.
+                    # Fastest route: hand over the scan RECORDS (subset
+                    # for sharded tees) + the original payload — the
+                    # generator resolves without re-parsing or slicing.
+                    fn = getattr(client, "push_otlp_recs", None)
+                    if fn is not None and \
+                            fn(tenant, raw, recs_for(items)) is not None:
+                        return
                     client.push_otlp(tenant, payload_for(items),
                                      trusted=True)
                 else:

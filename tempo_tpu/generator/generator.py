@@ -34,6 +34,7 @@ class Generator:
         self.id = instance_id
         self.now = now
         self.instances: dict[str, GeneratorInstance] = {}
+        self._cgroups: dict = {}      # group name → ConsumerGroup (kafka)
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -91,6 +92,9 @@ class Generator:
         from tempo_tpu.model.otlp_batch import batch_from_otlp
 
         inst = self.instance(tenant)
+        got = inst.push_otlp_staged(data, trusted=trusted)
+        if got is not None:
+            return got
         need_span, need_res = inst.needs_attr_columns()
         sb, sizes = batch_from_otlp(data, inst.registry.interner,
                                     return_sizes=True,
@@ -99,6 +103,13 @@ class Generator:
                                     trusted=trusted)
         inst.push_batch(sb, span_sizes=sizes)
         return sb.n
+
+    def push_otlp_recs(self, tenant: str, raw: bytes, recs) -> int | None:
+        """In-process distributor tee: scan records (any ring-sharded
+        subset) + the ORIGINAL payload — no re-parse, no re-encode.
+        Returns span count or None when this tenant needs the full
+        staging path (caller sends payload bytes instead)."""
+        return self.instance(tenant).push_otlp_recs(raw, recs)
 
     # -- reads (frontend generator_query_range hook) -----------------------
 
@@ -119,16 +130,34 @@ class Generator:
 
     # -- bus consumption (generator_kafka.go:25-110 analog) ----------------
 
-    def consume_bus(self, bus, partitions, group: str = "metrics-generator",
+    def consume_bus(self, bus, partitions=None,
+                    group: str = "metrics-generator",
                     max_records: int = 1000) -> int:
         """Drain owned partitions from the last committed offset into the
         tenant instances; commit AFTER processing (replayable). Spans batch
         per tenant across the fetched records, and tenants with metrics
         generation disabled are skipped — the same gate the direct RPC tee
         applies (`distributor.go:563` + overrides), since the bus carries
-        every trace for the blockbuilder's sake."""
+        every trace for the blockbuilder's sake.
+
+        `partitions=None` on a Kafka bus enters CONSUMER-GROUP mode: the
+        group protocol (JoinGroup/SyncGroup/Heartbeat) assigns partitions
+        and re-assigns them when replicas join or die; commits are
+        generation-fenced. With a static bus (or explicit partitions) the
+        token→partition assignment stays as configured."""
         from tempo_tpu.ingest.encoding import decode_push
 
+        cg = None
+        if partitions is None:
+            if hasattr(bus, "group_request"):
+                cg = self._cgroups.get(group)
+                if cg is None:
+                    from tempo_tpu.ingest.kafka import ConsumerGroup
+                    cg = self._cgroups[group] = ConsumerGroup(
+                        bus, group, now=self.now)
+                partitions = cg.ensure_active()
+            else:
+                partitions = range(getattr(bus, "n_partitions", 1))
         total = 0
         skip: set[str] = set()
         for p in partitions:
@@ -150,7 +179,10 @@ class Generator:
                     by_tenant.setdefault(rec.tenant, []).extend(spans)
             for tenant, spans in by_tenant.items():
                 self.push_spans(tenant, spans)
-            bus.commit(group, p, recs[-1].offset + 1)
+            if cg is not None:
+                cg.commit(p, recs[-1].offset + 1)    # generation-fenced
+            else:
+                bus.commit(group, p, recs[-1].offset + 1)
             total += len(recs)
         return total
 

@@ -106,6 +106,69 @@ class GeneratorInstance:
             need_res |= r
         return need_span, need_res
 
+    def _fast_spanmetrics(self) -> "SpanMetricsProcessor | None":
+        """The single eligible spanmetrics processor for the staged fast
+        routes, or None when full SpanBatch staging is required."""
+        procs = list(self.processors.values())
+        if len(procs) != 1 or not isinstance(procs[0], SpanMetricsProcessor):
+            return None
+        return procs[0] if procs[0].supports_staged_fast_path() else None
+
+    def _slack_bounds(self) -> tuple[int, int]:
+        slack = self.cfg.ingestion_time_range_slack_s
+        if slack <= 0:
+            return 0, 0
+        now_ns = int(self.now() * 1e9)
+        return now_ns - int(slack * 1e9), now_ns + int(slack * 1e9)
+
+    def push_otlp_recs(self, raw: bytes, recs) -> int | None:
+        """In-process tee fast route: distributor scan records + original
+        payload → fused resolve → device. Returns span count or None when
+        ineligible (caller falls back to the payload-bytes path)."""
+        proc = self._fast_spanmetrics()
+        if proc is None:
+            return None
+        lo, hi = self._slack_bounds()
+        got = proc.push_from_recs(raw, recs, lo, hi)
+        if got is None:
+            return None
+        self.spans_received += len(recs)
+        self.spans_filtered_slack += got[1]
+        return len(recs)
+
+    def push_otlp_staged(self, data: bytes, trusted: bool = False
+                         ) -> int | None:
+        """Dedicated-spanmetrics fast route: OTLP bytes → C++ stage →
+        fused resolve → device, with no SpanBatch materialization.
+        Returns the span count, or None when this instance isn't eligible
+        (caller takes the full staging path). Eligibility is checked
+        BEFORE any row-table mutation so a fallback never leaves pending
+        entries behind."""
+        from tempo_tpu import native
+
+        proc = self._fast_spanmetrics()
+        if proc is None:
+            return None
+        nat = getattr(self.registry.interner, "native_handle", lambda: None)()
+        if nat is None:
+            return None
+        staged = native.otlp_stage(nat, data, skip_span_attrs=True,
+                                   trust_attrs=trusted)
+        if staged is None:
+            return None
+        spans, _sattrs, rattrs, _res = staged
+        # non-string service.name values need the Python stringify fixup
+        # (_batch_from_staged); bail to the full path for those payloads
+        svc_key = self.registry.interner.intern("service.name")
+        hits = rattrs["key_id"] == svc_key
+        if hits.any() and (rattrs["typ"][hits] != 1).any():
+            return None
+        lo, hi = self._slack_bounds()
+        n_valid, n_filtered = proc.push_staged(spans, lo, hi)
+        self.spans_received += len(spans)
+        self.spans_filtered_slack += n_filtered
+        return len(spans)
+
     def push_batch(self, sb: SpanBatch, span_sizes: np.ndarray | None = None) -> None:
         self.spans_received += sb.n
         sb = self._apply_slack(sb)

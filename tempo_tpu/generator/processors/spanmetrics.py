@@ -95,6 +95,9 @@ class SpanMetricsProcessor:
                             if self.cfg.enable_target_info else None)
         self._policies = compile_policies(self.cfg.filter_policies)
         self.spans_discarded = 0
+        self._dims_arr: np.ndarray | None = None   # staged-path caches
+        self._kind_lut = self._status_lut = None
+        self._ones_cache: dict[int, np.ndarray] = {}
 
     def name(self) -> str:
         return "span-metrics"
@@ -107,6 +110,94 @@ class SpanMetricsProcessor:
         need = bool(c.dimensions or c.filter_policies
                     or c.span_multiplier_key)
         return need, need
+
+    # -- fused staged fast path (dedicated-spanmetrics generators) ---------
+
+    _DIM_CODES = {"service": 0, "span_name": 1, "span_kind": 2,
+                  "status_code": 3}
+
+    def supports_staged_fast_path(self) -> bool:
+        """True when push can go StageRec → device directly: intrinsic
+        dims only (the default config), no policies/multiplier/target_info
+        — and the native row table is live. Anything else needs the full
+        SpanBatch staging."""
+        c = self.cfg
+        return (not c.dimensions and not c.filter_policies
+                and not c.span_multiplier_key and not c.enable_target_info
+                and all(d in self._DIM_CODES for d in c.intrinsic_dimensions)
+                and self.calls.table._nat is not None)
+
+    def _staged_dims(self):
+        if self._dims_arr is None:
+            it = self.registry.interner
+            self._dims_arr = np.asarray(
+                [self._DIM_CODES[d] for d in self.cfg.intrinsic_dimensions],
+                np.int32)
+            self._kind_lut = np.asarray(it.intern_many(_KIND_STRS), np.int32)
+            self._status_lut = np.asarray(it.intern_many(_STATUS_STRS),
+                                          np.int32)
+        return self._dims_arr, self._kind_lut, self._status_lut
+
+    def push_staged(self, spans: np.ndarray, slack_lo: int,
+                    slack_hi: int) -> tuple[int, int]:
+        """One fused pass: staged StageRec[:n] → slots/durations/sizes in
+        C++ (label build + rowtable resolve + slack filter + last_seen
+        stamp) → ONE device scatter update. The Python cost per push is
+        the native call, the (rare) new-series misses, and the jit
+        dispatch — no SpanBatch, no numpy label stack, no second hash
+        pass. Returns (n_valid, n_filtered)."""
+        from tempo_tpu import native
+        from tempo_tpu.model.span_batch import _pad_rows
+
+        n = len(spans)
+        cap = _pad_rows(max(n, 1))
+        dims, klut, slut = self._staged_dims()
+        now = self.registry.now()
+        got = native.spanmetrics_resolve(
+            self.calls.table._nat, spans, dims, klut, slut,
+            slack_lo, slack_hi, now, self.calls.table.last_seen, cap)
+        return self._push_resolved(got, spans["trace_id"], n, now)
+
+    def push_from_recs(self, raw: bytes, recs: np.ndarray, slack_lo: int,
+                       slack_hi: int) -> "tuple[int, int] | None":
+        """The in-process tee route: the distributor's otlp_scan records +
+        the ORIGINAL payload bytes go straight to slots — no second
+        protobuf walk, no payload re-encode for ring-sharded subsets.
+        None when the payload needs the Python service.name fixup."""
+        from tempo_tpu import native
+        from tempo_tpu.model.span_batch import _pad_rows
+
+        nat_it = self.registry.interner.native_handle()
+        if nat_it is None:
+            return None
+        n = len(recs)
+        cap = _pad_rows(max(n, 1))
+        dims, klut, slut = self._staged_dims()
+        now = self.registry.now()
+        got = native.spanmetrics_from_recs(
+            self.calls.table._nat, nat_it._h, raw, recs, dims, klut, slut,
+            slack_lo, slack_hi, now, self.calls.table.last_seen, cap)
+        if got is None:
+            return None
+        return self._push_resolved(got, recs["trace_id"], n, now)
+
+    def _push_resolved(self, got, trace_ids, n: int,
+                       now: float) -> tuple[int, int]:
+        slots, dur_s, sizes, rows, valid, miss, n_valid, n_filtered = got
+        if miss.size:
+            self.calls.table.apply_misses(rows, slots, miss, valid, now)
+        cap = len(slots)
+        ones = self._ones_cache.get(cap)
+        if ones is None:
+            ones = self._ones_cache[cap] = np.ones(cap, np.float32)
+        (self.calls.state, self.latency.state, self.sizes.state,
+         self.dd) = _fused_update(
+            self.calls.state, self.latency.state, self.sizes.state,
+            self.dd, slots, dur_s, sizes, ones)
+        self.calls.note_exemplars(slots[:n], trace_ids, dur_s,
+                                  int(now * 1000))
+        self.latency.exemplars = self.calls.exemplars
+        return n_valid, n_filtered
 
     # -- staging -----------------------------------------------------------
 

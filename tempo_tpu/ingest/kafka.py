@@ -30,6 +30,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 
 from tempo_tpu.ingest.bus import Record
 
@@ -601,6 +602,257 @@ class KafkaBus:
         for c in conns:
             c.close()
 
+    # -- consumer-group seam (used by ConsumerGroup; coordinator-routed) ---
 
-__all__ = ["KafkaBus", "KafkaError", "crc32c",
+    def group_request(self, group: str, api_key: int, api_version: int,
+                      body: bytes) -> bytes:
+        """One coordinator-routed request with a single re-discovery retry
+        (the same healing commit/committed use)."""
+        for attempt in (0, 1):
+            conn = self._coord_conn(group, force=bool(attempt))
+            try:
+                return conn.request(api_key, api_version, body)
+            except Exception:
+                if attempt:
+                    raise
+        raise AssertionError("unreachable")
+
+
+# error codes the group state machine reacts to
+_E_ILLEGAL_GENERATION = 22
+_E_UNKNOWN_MEMBER = 25
+_E_REBALANCE_IN_PROGRESS = 27
+_E_MEMBER_ID_REQUIRED = 79
+_REJOIN_CODES = {_E_ILLEGAL_GENERATION, _E_UNKNOWN_MEMBER,
+                 _E_REBALANCE_IN_PROGRESS}
+
+
+class ConsumerGroup:
+    """Kafka consumer-group membership over the SDK-free wire client:
+    JoinGroup v5 / SyncGroup v3 / Heartbeat v3 / LeaveGroup v1, with
+    range assignment computed client-side by the elected leader — the
+    franz-go group management the reference consumes via
+    `pkg/ingest/reader_client.go` + partition balancing `balancer.go`,
+    rebuilt on the raw protocol.
+
+    Drive it with `ensure_active()` from the consume loop: it (re)joins
+    when needed, heartbeats at half the session timeout, and returns the
+    CURRENT partition assignment (possibly [] mid-rebalance — the loop
+    simply owns nothing that tick; offsets replay on the next owner, so a
+    member death moves partitions without message loss). Commits carry
+    the generation + member id so zombies are fenced
+    (ILLEGAL_GENERATION)."""
+
+    def __init__(self, bus: KafkaBus, group: str, *,
+                 session_timeout_ms: int = 30_000,
+                 rebalance_timeout_ms: int = 60_000,
+                 now=time.time) -> None:
+        self.bus = bus
+        self.group = group
+        self.session_timeout_ms = session_timeout_ms
+        self.rebalance_timeout_ms = rebalance_timeout_ms
+        self.now = now
+        self.member_id = ""
+        self.generation = -1
+        self.assignment: list[int] = []
+        self._joined = False
+        self._last_hb = 0.0
+
+    # -- wire bodies -------------------------------------------------------
+
+    def _subscription(self) -> bytes:
+        # ConsumerProtocolSubscription v0: topics + user data
+        return (_i16(0) + _i32(1) + _string(self.bus.topic) + _bytes(None))
+
+    @staticmethod
+    def _parse_subscription(meta: bytes) -> list[str]:
+        r = _R(meta)
+        r.i16()                                  # version
+        return [r.string() or "" for _ in range(max(r.i32(), 0))]
+
+    def _assignment_bytes(self, parts: list[int]) -> bytes:
+        return (_i16(0) + _i32(1) + _string(self.bus.topic) +
+                _i32(len(parts)) + b"".join(_i32(p) for p in parts) +
+                _bytes(None))
+
+    @staticmethod
+    def _parse_assignment(body: bytes) -> list[int]:
+        if not body:
+            return []
+        r = _R(body)
+        r.i16()                                  # version
+        parts: list[int] = []
+        for _t in range(max(r.i32(), 0)):
+            r.string()                           # topic
+            for _p in range(max(r.i32(), 0)):
+                parts.append(r.i32())
+        return sorted(parts)
+
+    # -- protocol steps ----------------------------------------------------
+
+    def _coord_call(self, api_key: int, api_version: int,
+                    body: bytes) -> bytes:
+        """Coordinator-routed exchange healing BOTH failure shapes: dead
+        connections (group_request re-discovers on transport errors) and
+        NOT_COORDINATOR/LOAD_IN_PROGRESS responses after the coordinator
+        MOVES to another broker — the join/sync/heartbeat/leave responses
+        all carry (throttle i32, error i16) up front, so one peek decides
+        the forced re-discovery retry."""
+        for attempt in (0, 1):
+            raw = self.bus.group_request(self.group, api_key, api_version,
+                                         body)
+            if attempt == 0 and len(raw) >= 6 and \
+                    struct.unpack(">h", raw[4:6])[0] in _STALE_COORD:
+                self.bus._coord_conn(self.group, force=True)
+                continue
+            return raw
+        raise AssertionError("unreachable")
+
+    def _join_once(self) -> "tuple[int, str, list[tuple[str, bytes]]] | None":
+        """One JoinGroup v5 exchange. Returns (error, leader, members) —
+        members only for the leader; None-equivalent via error code."""
+        body = (_string(self.group) + _i32(self.session_timeout_ms) +
+                _i32(self.rebalance_timeout_ms) + _string(self.member_id) +
+                _string(None) +                  # group instance id
+                _string("consumer") +
+                _i32(1) + _string("range") + _bytes(self._subscription()))
+        r = _R(self._coord_call(11, 5, body))
+        r.i32()                                  # throttle
+        err = r.i16()
+        gen = r.i32()
+        r.string()                               # protocol
+        leader = r.string() or ""
+        member_id = r.string() or ""
+        members: list[tuple[str, bytes]] = []
+        for _m in range(max(r.i32(), 0)):
+            mid = r.string() or ""
+            r.string()                           # instance id
+            members.append((mid, r.bytes_() or b""))
+        if member_id:
+            self.member_id = member_id
+        if err == 0:
+            self.generation = gen
+        return err, leader, members
+
+    def _sync(self, assignments: "list[tuple[str, bytes]]") -> int:
+        body = (_string(self.group) + _i32(self.generation) +
+                _string(self.member_id) + _string(None) +
+                _i32(len(assignments)) +
+                b"".join(_string(m) + _bytes(a) for m, a in assignments))
+        r = _R(self._coord_call(14, 3, body))
+        r.i32()                                  # throttle
+        err = r.i16()
+        assignment = r.bytes_() or b""
+        if err == 0:
+            self.assignment = self._parse_assignment(assignment)
+            self._joined = True
+            self._last_hb = self.now()
+        return err
+
+    def _range_assign(self, members: "list[tuple[str, bytes]]"
+                      ) -> "list[tuple[str, bytes]]":
+        """Range assignment over the topic's partitions (balancer.go's
+        default shape): contiguous runs, first members get the remainder.
+        Members whose subscription metadata names other topics only get
+        nothing (the group may mix consumers of different topics)."""
+        n = self.bus.n_partitions
+        ids = sorted(m for m, meta in members
+                     if not meta
+                     or self.bus.topic in self._parse_subscription(meta))
+        out = []
+        base, rem = divmod(n, max(len(ids), 1))
+        start = 0
+        for i, mid in enumerate(ids):
+            take = base + (1 if i < rem else 0)
+            out.append((mid, self._assignment_bytes(
+                list(range(start, start + take)))))
+            start += take
+        return out
+
+    def _rejoin(self) -> None:
+        self._joined = False
+        self.assignment = []
+        for _attempt in range(3):
+            err, leader, members = self._join_once()
+            if err == _E_MEMBER_ID_REQUIRED:
+                continue                         # retry WITH the new id
+            if err != 0:
+                return                           # next tick retries
+            if leader == self.member_id:
+                self._sync(self._range_assign(members))
+            else:
+                self._sync([])
+            return
+
+    def heartbeat(self) -> bool:
+        """One Heartbeat v3; False = membership lost/rebalancing (caller's
+        next ensure_active rejoins)."""
+        body = (_string(self.group) + _i32(self.generation) +
+                _string(self.member_id) + _string(None))
+        r = _R(self._coord_call(12, 3, body))
+        r.i32()
+        err = r.i16()
+        if err in _REJOIN_CODES:
+            self._joined = False
+            if err == _E_UNKNOWN_MEMBER:
+                self.member_id = ""
+            return False
+        self._last_hb = self.now()
+        return err == 0
+
+    def ensure_active(self) -> list[int]:
+        """Join/heartbeat as needed; returns the current assignment."""
+        if not self._joined:
+            self._rejoin()
+        elif (self.now() - self._last_hb) * 1000 >= \
+                self.session_timeout_ms / 2:
+            if not self.heartbeat():
+                self._rejoin()
+        return list(self.assignment)
+
+    def leave(self) -> None:
+        if not self.member_id:
+            return
+        body = _string(self.group) + _string(self.member_id)
+        try:
+            self._coord_call(13, 1, body)
+        except Exception:
+            pass
+        self._joined = False
+        self.assignment = []
+        self.member_id = ""
+        self.generation = -1
+
+    # -- generation-fenced offsets ----------------------------------------
+
+    def commit(self, partition: int, offset: int) -> None:
+        """OffsetCommit v2 carrying generation + member id: a commit from
+        a fenced zombie (dead member, stale generation) is REJECTED by
+        the coordinator instead of clobbering the new owner's offsets."""
+        body = (_string(self.group) + _i32(self.generation) +
+                _string(self.member_id) + _i64(-1) +
+                _i32(1) + _string(self.bus.topic) +
+                _i32(1) + _i32(partition % self.bus.n_partitions) +
+                _i64(offset) + _string(None))
+        for attempt in (0, 1):
+            r = _R(self.bus.group_request(self.group, 8, 2, body))
+            try:
+                for _t in range(r.i32()):
+                    r.string()
+                    for _p in range(r.i32()):
+                        r.i32()
+                        _check(r.i16(), "group offset commit")
+                return
+            except KafkaError as e:
+                # coordinator moved: per-partition NOT_COORDINATOR —
+                # re-discover and retry once (same healing bus.commit has)
+                if attempt or e.code not in _STALE_COORD:
+                    raise
+                self.bus._coord_conn(self.group, force=True)
+
+    def committed(self, partition: int) -> int:
+        return self.bus.committed(self.group, partition)
+
+
+__all__ = ["KafkaBus", "KafkaError", "ConsumerGroup", "crc32c",
            "encode_record_batch", "decode_record_batches"]

@@ -247,6 +247,26 @@ def _load():
                 c.c_void_p, c.c_int64,
                 c.c_int32, i64p, c.c_int32]
             lib.otlp_stage_mt.restype = c.c_int32
+            lib.spanmetrics_resolve.argtypes = [
+                c.c_void_p, c.c_void_p, c.c_int64,      # table, spans, n
+                i32p, c.c_int32, i32p, i32p,            # dims, kind/status
+                c.c_int64, c.c_int64, c.c_double,       # slack lo/hi, now
+                c.POINTER(c.c_double),                  # last_seen
+                i32p, c.c_void_p, c.c_void_p,           # slots, dur, size
+                i32p, u8p, i64p, c.c_int64, i64p]       # rows, valid, miss
+            lib.spanmetrics_resolve.restype = c.c_int64
+            lib.spanmetrics_from_recs.argtypes = [
+                c.c_void_p, c.c_void_p, u8p, c.c_int64,  # table, it, buf
+                c.c_void_p, c.c_int64,                   # recs, n
+                i32p, c.c_int32, i32p, i32p,             # dims, kind/status
+                c.c_int64, c.c_int64, c.c_double,        # slack, now
+                c.POINTER(c.c_double),                   # last_seen
+                i32p, c.c_void_p, c.c_void_p,            # slots, dur, size
+                i32p, u8p, i64p, c.c_int64, i64p]        # rows, valid, miss
+            lib.spanmetrics_from_recs.restype = c.c_int64
+            lib.group_keys_recs.argtypes = [
+                c.c_void_p, c.c_int64, u8p, i32p, i32p]
+            lib.group_keys_recs.restype = c.c_int64
             _LIB = lib
         except Exception:
             _LIB = None
@@ -706,3 +726,125 @@ def spans_from_otlp_proto_native(data: bytes, return_recs: bool = False):
                 "trace_id": l_tid[j * 16: j * 16 + min(l_tl[j], 16)],
                 "span_id": l_sid[j * 8: j * 8 + min(l_sl[j], 8)]})
     return (out, recs) if return_recs else out
+
+
+def spanmetrics_resolve(table: "NativeRowTable", spans: np.ndarray,
+                        dims: np.ndarray, kind_lut: np.ndarray,
+                        status_lut: np.ndarray, slack_lo: int, slack_hi: int,
+                        now: float, last_seen: "np.ndarray | None",
+                        cap: int):
+    """Fused staged-records → device-ready arrays (see native.cpp
+    `spanmetrics_resolve`). Returns (slots, dur_s, sizes, rows, valid,
+    miss_idx, n_valid, n_filtered) with the first five sized/padded to
+    `cap` (slots tail -1 → masked out of the scatter); rows is [n, L] for
+    the miss-resolution pass. None when the library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(spans)
+    if cap < n:
+        raise ValueError("cap must be >= len(spans)")
+    spans = np.ascontiguousarray(spans)
+    dims = np.ascontiguousarray(dims, np.int32)
+    kind_lut = np.ascontiguousarray(kind_lut, np.int32)
+    status_lut = np.ascontiguousarray(status_lut, np.int32)
+    slots = np.full(cap, -1, np.int32)
+    dur = np.zeros(cap, np.float32)
+    sizes = np.zeros(cap, np.float32)
+    rows = np.empty((max(n, 1), int(dims.shape[0])), np.int32)
+    valid = np.zeros(cap, np.uint8)
+    miss = np.empty(max(n, 1), np.int64)
+    counts = np.zeros(2, np.int64)
+    i32 = ctypes.POINTER(ctypes.c_int32)
+    lsp = None
+    if last_seen is not None:
+        assert last_seen.dtype == np.float64 and last_seen.flags.c_contiguous
+        lsp = last_seen.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+    nm = lib.spanmetrics_resolve(
+        table._h, spans.ctypes.data, n,
+        dims.ctypes.data_as(i32), int(dims.shape[0]),
+        kind_lut.ctypes.data_as(i32), status_lut.ctypes.data_as(i32),
+        slack_lo, slack_hi, now, lsp,
+        slots.ctypes.data_as(i32), dur.ctypes.data, sizes.ctypes.data,
+        rows.ctypes.data_as(i32),
+        valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        miss.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(miss),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    return (slots, dur, sizes, rows, valid, miss[:nm],
+            int(counts[0]), int(counts[1]))
+
+
+def spanmetrics_from_recs(table: "NativeRowTable", interner_h, data: bytes,
+                          recs: np.ndarray, dims: np.ndarray,
+                          kind_lut: np.ndarray, status_lut: np.ndarray,
+                          slack_lo: int, slack_hi: int, now: float,
+                          last_seen: "np.ndarray | None", cap: int):
+    """Distributor scan records → device-ready spanmetrics arrays (see
+    native.cpp `spanmetrics_from_recs`): the tee path skips the second
+    protobuf walk entirely. Same return shape as `spanmetrics_resolve`;
+    None when the library is unavailable OR the payload needs the Python
+    service.name fixup (caller falls back to the full staging path)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(recs)
+    if cap < n:
+        raise ValueError("cap must be >= len(recs)")
+    recs = np.ascontiguousarray(recs)
+    buf = np.frombuffer(data, np.uint8)
+    dims = np.ascontiguousarray(dims, np.int32)
+    kind_lut = np.ascontiguousarray(kind_lut, np.int32)
+    status_lut = np.ascontiguousarray(status_lut, np.int32)
+    slots = np.full(cap, -1, np.int32)
+    dur = np.zeros(cap, np.float32)
+    sizes = np.zeros(cap, np.float32)
+    rows = np.empty((max(n, 1), int(dims.shape[0])), np.int32)
+    valid = np.zeros(cap, np.uint8)
+    miss = np.empty(max(n, 1), np.int64)
+    counts = np.zeros(2, np.int64)
+    i32 = ctypes.POINTER(ctypes.c_int32)
+    lsp = None
+    if last_seen is not None:
+        assert last_seen.dtype == np.float64 and last_seen.flags.c_contiguous
+        lsp = last_seen.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+    nm = lib.spanmetrics_from_recs(
+        table._h, interner_h, buf.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_uint8)), len(data),
+        recs.ctypes.data, n,
+        dims.ctypes.data_as(i32), int(dims.shape[0]),
+        kind_lut.ctypes.data_as(i32), status_lut.ctypes.data_as(i32),
+        slack_lo, slack_hi, now, lsp,
+        slots.ctypes.data_as(i32), dur.ctypes.data, sizes.ctypes.data,
+        rows.ctypes.data_as(i32),
+        valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        miss.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(miss),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    if nm < 0:
+        return None      # -1 malformed / -2 fixup: full path re-validates
+    return (slots, dur, sizes, rows, valid, miss[:nm],
+            int(counts[0]), int(counts[1]))
+
+
+def group_keys_recs(recs: np.ndarray, valid: "np.ndarray | None"
+                    ) -> "tuple[np.ndarray, np.ndarray] | None":
+    """`group_keys` over (trace_id ‖ tid_len) read straight from SpanRec
+    rows — no key-matrix materialization. inverse/first index over the
+    sequence of VALID rows (the caller's vrows order). None without the
+    native library (caller builds keys and uses group_keys)."""
+    lib = _load()
+    if lib is None:
+        return None
+    recs = np.ascontiguousarray(recs)
+    n = len(recs)
+    nv = n if valid is None else int(valid.sum())
+    inverse = np.empty(max(nv, 1), np.int32)
+    first = np.empty(max(nv, 1), np.int32)
+    vp = None
+    if valid is not None:
+        vbuf = np.ascontiguousarray(valid, np.uint8)
+        vp = vbuf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    i32 = ctypes.POINTER(ctypes.c_int32)
+    ng = lib.group_keys_recs(recs.ctypes.data, n, vp,
+                             inverse.ctypes.data_as(i32),
+                             first.ctypes.data_as(i32))
+    return first[:ng], inverse[:nv]

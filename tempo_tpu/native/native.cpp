@@ -1248,3 +1248,371 @@ int32_t otlp_stage_mt(void* interner, const uint8_t* buf, int64_t buflen,
 }
 
 }  // extern "C"
+
+// --- fused spanmetrics resolution (staged records -> device-ready arrays) ----
+//
+// The generator's dedicated-spanmetrics hot path (the PushSpans shape of
+// `modules/generator/generator.go:275` with only the spanmetrics processor
+// enabled): one pass over the staged records builds the intrinsic label
+// row, resolves it against the persistent RowTable, applies the ingestion
+// slack filter, and emits the scatter-ready arrays (slots, duration
+// seconds, wire sizes) the fused device update consumes directly. This
+// replaces four Python/numpy passes (SpanBatch materialization, label-row
+// stacking, separate rowtable lookup, duration math) with one C loop —
+// on a 1-core host the Python staging was the e2e throughput bound.
+//
+// dims: per-label field selector (0=service_id 1=name_id 2=kind->lut
+// 3=status_code->lut). kind_lut[6]/status_lut[3] carry the intern ids of
+// the SPAN_KIND_* / STATUS_CODE_* strings so rows match the generic
+// `_label_rows` path bit-for-bit (same table serves both paths).
+// slack_hi == 0 disables the slack filter. last_seen (may be null) is
+// stamped with `now` for every resolved slot. Misses get PENDING entries
+// (first occurrence appended to miss_idx, rows all emitted to rows_out);
+// Python resolves them exactly like rowtable_lookup's contract requires.
+// counts_out: [0]=n_valid (post-slack), [1]=n_filtered.
+
+extern "C" {
+
+int64_t spanmetrics_resolve(
+    void* rowtable_h, const StageRec* spans, int64_t n,
+    const int32_t* dims, int32_t n_dims,
+    const int32_t* kind_lut, const int32_t* status_lut,
+    int64_t slack_lo, int64_t slack_hi, double now, double* last_seen,
+    int32_t* slots_out, float* dur_out, float* size_out,
+    int32_t* rows_out, uint8_t* valid_out,
+    int64_t* miss_idx, int64_t miss_cap, int64_t* counts_out) {
+    RowTable* t = (RowTable*)rowtable_h;
+    std::lock_guard<std::mutex> g(t->mu);
+    int64_t miss = 0, n_valid = 0, n_filtered = 0;
+    // one-entry memo: consecutive spans of one service/op resolve without
+    // re-probing (payloads arrive grouped by resource and often by name)
+    uint64_t last_h = 0;
+    int32_t last_slot = -1;
+    bool have_last = false;
+    int32_t prev_row[8];
+    const bool memo_ok = n_dims <= 8;
+    for (int64_t i = 0; i < n; i++) {
+        const StageRec& r = spans[i];
+        int32_t* row = rows_out + i * n_dims;
+        for (int32_t d = 0; d < n_dims; d++) {
+            switch (dims[d]) {
+                case 0: row[d] = r.service_id; break;
+                case 1: row[d] = r.name_id; break;
+                case 2: {
+                    int32_t k = r.kind;
+                    row[d] = kind_lut[k < 0 ? 0 : (k > 5 ? 5 : k)];
+                    break;
+                }
+                default: {
+                    int32_t s = r.status_code;
+                    row[d] = status_lut[s < 0 ? 0 : (s > 2 ? 2 : s)];
+                }
+            }
+        }
+        int64_t end = (int64_t)r.end_ns;
+        bool ok = slack_hi == 0 || (end >= slack_lo && end <= slack_hi);
+        valid_out[i] = ok ? 1 : 0;
+        dur_out[i] = (float)((double)(end - (int64_t)r.start_ns) * 1e-9);
+        size_out[i] = (float)r.span_len;
+        if (!ok) {
+            slots_out[i] = -1;
+            n_filtered++;
+            continue;
+        }
+        n_valid++;
+        uint64_t hh = t->rhash(row);
+        if (memo_ok && have_last && hh == last_h &&
+            memcmp(prev_row, row, n_dims * 4) == 0) {
+            slots_out[i] = last_slot;
+            continue;
+        }
+        int32_t e = t->find_entry(row, hh);
+        int32_t slot;
+        if (e == -1) {
+            t->add_entry(row, hh, kPending);
+            if (miss < miss_cap) miss_idx[miss] = i;
+            miss++;
+            slot = -1;
+        } else if (t->slots[e] == kPending) {
+            slot = -1;
+        } else {
+            slot = t->slots[e];
+            if (last_seen) last_seen[slot] = now;
+        }
+        slots_out[i] = slot;
+        last_h = hh;
+        last_slot = slot;
+        have_last = memo_ok && slot >= 0;
+        if (memo_ok) memcpy(prev_row, row, n_dims * 4);
+    }
+    counts_out[0] = n_valid;
+    counts_out[1] = n_filtered;
+    return miss;
+}
+
+}  // extern "C"
+
+// --- tee-path fusion: distributor scan records -> spanmetrics arrays --------
+//
+// The in-process generator tee (`modules/distributor/distributor.go:563`
+// metrics-generator forwarding) previously re-parsed the OTLP payload the
+// distributor had ALREADY scanned: otlp_scan in the distributor, then
+// otlp_stage in the generator — two full protobuf walks per push. This
+// kernel consumes the distributor's SpanRec offsets directly: names are
+// interned by gathering their recorded byte ranges (no varint walking),
+// resources resolve service.name once per distinct res_off, and the row
+// resolves against the RowTable exactly like spanmetrics_resolve. The
+// caller passes any SUBSET of records (ring-sharded tees) while `buf`
+// stays the original payload — the re-encode slice disappears entirely.
+//
+// Returns miss count, -1 on malformed resource bytes, or -2 when the
+// LAST service.name occurrence of some resource is non-string (the
+// Python stringify fixup owns that case; caller falls back). A -2 bail
+// happens BEFORE any row-table mutation (resources are pre-resolved), so
+// no pending entries leak.
+
+namespace {
+
+// memo for byte-range interning with the interner lock already held
+struct HeldIntern {
+    struct E { uint64_t h; int64_t off; int32_t len; int32_t id; };
+    std::vector<E> tab;
+    uint64_t mask;
+    Interner* it;
+    const uint8_t* base;
+
+    HeldIntern(Interner* i, const uint8_t* b) : it(i), base(b) {
+        tab.assign(1 << 10, E{0, 0, 0, -1});
+        mask = tab.size() - 1;
+    }
+
+    int32_t get(int64_t off, int32_t len) {
+        const uint8_t* s = base + off;
+        uint64_t h = fnv1a64(s, len);
+        uint64_t i = h & mask;
+        int probes = 0;
+        while (probes++ < 32) {
+            E& e = tab[i];
+            if (e.id == -1) {
+                e = E{h, off, len, it->intern_locked(s, len)};
+                return e.id;
+            }
+            if (e.h == h && e.len == len &&
+                memcmp(base + e.off, s, len) == 0)
+                return e.id;
+            i = (i + 1) & mask;
+        }
+        return it->intern_locked(s, len);      // memo full: direct
+    }
+};
+
+// service.name of one Resource message; 0 ok, -1 malformed, -2 needs the
+// Python fixup (last occurrence non-string).
+static int resolve_service(const uint8_t* buf, int64_t off, int32_t len,
+                           HeldIntern& hi, int32_t empty_id,
+                           int32_t* out_id) {
+    *out_id = empty_id;
+    if (len <= 0) return 0;
+    int last_typ = -1;                      // of the last service.name
+    int64_t last_off = 0; int32_t last_len = 0;
+    Cursor cur{buf + off, buf + off + len, true};
+    uint32_t f, w; uint64_t v, l; const uint8_t* s;
+    while (read_field(cur, f, w, v, s, l)) {
+        if (f != 1 || w != 2) continue;     // Resource.attributes KeyValue
+        Cursor kv{s, s + l, true};
+        uint32_t f2, w2; uint64_t v2, l2; const uint8_t* s2;
+        bool is_svc = false;
+        int typ = -1; int64_t voff = 0; int32_t vlen = 0;
+        while (read_field(kv, f2, w2, v2, s2, l2)) {
+            if (f2 == 1 && w2 == 2) {
+                is_svc = (l2 == 12 && memcmp(s2, "service.name", 12) == 0);
+            } else if (f2 == 2 && w2 == 2) {
+                Cursor av{s2, s2 + l2, true};
+                uint32_t f3, w3; uint64_t v3, l3; const uint8_t* s3;
+                while (read_field(av, f3, w3, v3, s3, l3)) {
+                    if (f3 == 1 && w3 == 2) {
+                        typ = 1; voff = s3 - buf; vlen = (int32_t)l3;
+                    } else {
+                        typ = 0;            // any non-string kind
+                    }
+                }
+                if (!av.ok) return -1;
+            }
+        }
+        if (!kv.ok) return -1;
+        if (is_svc) { last_typ = typ; last_off = voff; last_len = vlen; }
+    }
+    if (!cur.ok) return -1;
+    if (last_typ == -1) return 0;
+    if (last_typ != 1) return -2;
+    *out_id = hi.get(last_off, last_len);
+    return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t spanmetrics_from_recs(
+    void* rowtable_h, void* interner_h, const uint8_t* buf, int64_t buflen,
+    const SpanRec* recs, int64_t n,
+    const int32_t* dims, int32_t n_dims,
+    const int32_t* kind_lut, const int32_t* status_lut,
+    int64_t slack_lo, int64_t slack_hi, double now, double* last_seen,
+    int32_t* slots_out, float* dur_out, float* size_out,
+    int32_t* rows_out, uint8_t* valid_out,
+    int64_t* miss_idx, int64_t miss_cap, int64_t* counts_out) {
+    (void)buflen;
+    Interner* it = (Interner*)interner_h;
+    std::lock_guard<std::mutex> gi(it->mu);
+    static const uint8_t kEmpty = 0;
+    int32_t empty_id = it->intern_locked(&kEmpty, 0);
+    HeldIntern hi(it, buf);
+
+    // pass 1: resolve every distinct resource's service id (consecutive
+    // records share resources, so the last-seen fast path covers almost
+    // every record; bail on the fixup case before touching the row table)
+    std::vector<int32_t> svc(n);
+    std::vector<std::pair<int64_t, int32_t>> seen;   // res_off -> id
+    int64_t cur_off = -1; int32_t cur_id = empty_id;
+    for (int64_t i = 0; i < n; i++) {
+        int64_t ro = recs[i].res_off;
+        if (ro != cur_off) {
+            cur_off = ro;
+            int32_t id = empty_id;
+            bool found = false;
+            for (auto& p : seen)
+                if (p.first == ro) { id = p.second; found = true; break; }
+            if (!found) {
+                int rc = resolve_service(buf, ro, recs[i].res_len, hi,
+                                         empty_id, &id);
+                if (rc != 0) return rc;
+                seen.emplace_back(ro, id);
+            }
+            cur_id = id;
+        }
+        svc[i] = cur_id;
+    }
+
+    RowTable* t = (RowTable*)rowtable_h;
+    std::lock_guard<std::mutex> g(t->mu);
+    int64_t miss = 0, n_valid = 0, n_filtered = 0;
+    uint64_t last_h = 0;
+    int32_t last_slot = -1;
+    bool have_last = false;
+    int32_t prev_row[8];
+    const bool memo_ok = n_dims <= 8;
+    for (int64_t i = 0; i < n; i++) {
+        const SpanRec& r = recs[i];
+        int32_t* row = rows_out + i * n_dims;
+        for (int32_t d = 0; d < n_dims; d++) {
+            switch (dims[d]) {
+                case 0: row[d] = svc[i]; break;
+                case 1: row[d] = hi.get(r.name_off, r.name_len); break;
+                case 2: {
+                    int32_t k = r.kind;
+                    row[d] = kind_lut[k < 0 ? 0 : (k > 5 ? 5 : k)];
+                    break;
+                }
+                default: {
+                    int32_t s = r.status_code;
+                    row[d] = status_lut[s < 0 ? 0 : (s > 2 ? 2 : s)];
+                }
+            }
+        }
+        int64_t end = (int64_t)r.end_ns;
+        bool ok = slack_hi == 0 || (end >= slack_lo && end <= slack_hi);
+        valid_out[i] = ok ? 1 : 0;
+        dur_out[i] = (float)((double)(end - (int64_t)r.start_ns) * 1e-9);
+        size_out[i] = (float)r.span_len;
+        if (!ok) {
+            slots_out[i] = -1;
+            n_filtered++;
+            continue;
+        }
+        n_valid++;
+        uint64_t hh = t->rhash(row);
+        if (memo_ok && have_last && hh == last_h &&
+            memcmp(prev_row, row, n_dims * 4) == 0) {
+            slots_out[i] = last_slot;
+            continue;
+        }
+        int32_t e = t->find_entry(row, hh);
+        int32_t slot;
+        if (e == -1) {
+            t->add_entry(row, hh, kPending);
+            if (miss < miss_cap) miss_idx[miss] = i;
+            miss++;
+            slot = -1;
+        } else if (t->slots[e] == kPending) {
+            slot = -1;
+        } else {
+            slot = t->slots[e];
+            if (last_seen) last_seen[slot] = now;
+        }
+        slots_out[i] = slot;
+        last_h = hh;
+        last_slot = slot;
+        have_last = memo_ok && slot >= 0;
+        if (memo_ok) memcpy(prev_row, row, n_dims * 4);
+    }
+    counts_out[0] = n_valid;
+    counts_out[1] = n_filtered;
+    return miss;
+}
+
+}  // extern "C"
+
+// --- trace grouping straight off the scan records ---------------------------
+//
+// group_keys over (trace_id ‖ tid_len) WITHOUT materializing the key
+// matrix: the tee path previously copied trace ids twice (contiguous
+// gather + length-column concat) per push just to feed group_keys. Reads
+// SpanRec rows directly, skipping invalid ones; inverse/first index over
+// the SEQUENCE of valid rows (the caller's vrows order), preserving
+// `requestsByTraceID` semantics (distributor.go:694).
+
+extern "C" {
+
+int64_t group_keys_recs(const void* recs_p, int64_t n, const uint8_t* valid,
+                        int32_t* inverse, int32_t* first_idx) {
+    const SpanRec* recs = (const SpanRec*)recs_p;
+    if (n <= 0) return 0;
+    uint64_t cap = 64;
+    while (cap < (uint64_t)n * 2) cap <<= 1;
+    std::vector<int32_t> table(cap, -1);
+    std::vector<int64_t> grec;                     // group -> rec row
+    uint64_t mask = cap - 1;
+    int64_t n_groups = 0, vi = 0;
+    uint8_t key[17];
+    for (int64_t r = 0; r < n; r++) {
+        if (valid && !valid[r]) continue;
+        const SpanRec& rec = recs[r];
+        memcpy(key, rec.trace_id, 16);
+        key[16] = (uint8_t)rec.tid_len;
+        uint64_t h = fnv1a64(key, 17);
+        uint64_t i = h & mask;
+        while (true) {
+            int32_t g = table[i];
+            if (g == -1) {
+                table[i] = (int32_t)n_groups;
+                first_idx[n_groups] = (int32_t)vi;
+                grec.push_back(r);
+                inverse[vi] = (int32_t)n_groups;
+                n_groups++;
+                break;
+            }
+            const SpanRec& fr = recs[grec[g]];
+            if (memcmp(fr.trace_id, rec.trace_id, 16) == 0 &&
+                fr.tid_len == rec.tid_len) {
+                inverse[vi] = g;
+                break;
+            }
+            i = (i + 1) & mask;
+        }
+        vi++;
+    }
+    return n_groups;
+}
+
+}  // extern "C"

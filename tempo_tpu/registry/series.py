@@ -133,30 +133,41 @@ class SeriesTable:
         rows = np.ascontiguousarray(rows, np.int32)
         out, miss = self._nat.lookup(rows, valid)
         if miss.size:
-            pend: dict[bytes, int] = {}
-            for i in miss.tolist():
-                row = rows[i]
-                key = row.tobytes()
-                if not self._free or (self.budget is not None
-                                      and not self.budget.take()):
-                    self.discarded += 1
-                    self._nat.remove(row)   # pending entry must not linger
-                    pend[key] = -1
-                    continue
-                slot = self._free.pop()
-                self._nat.insert(row, slot)
-                self.slot_keys[slot] = row
-                self.active[slot] = True
-                pend[key] = slot
-                out[i] = slot
-            # duplicates of new combos within this batch resolved host-side
-            unres = np.flatnonzero((out < 0) & valid)
-            for i in unres.tolist():
-                out[i] = pend.get(rows[i].tobytes(), -1)
+            self.apply_misses(rows, out, miss, valid, now)
         live = out[out >= 0]
         if live.size:
             self.last_seen[live] = now
         return out
+
+    def apply_misses(self, rows: np.ndarray, out: np.ndarray,
+                     miss: np.ndarray, valid: np.ndarray,
+                     now: float) -> None:
+        """Resolve the PENDING entries a native lookup reported: allocate
+        slots (budget-gated) for first occurrences, then fix in-batch
+        duplicates host-side. `out` is updated in place; `rows`/`valid`
+        cover out[:len(rows)] (out may be padded longer)."""
+        n = len(rows)
+        pend: dict[bytes, int] = {}
+        for i in miss.tolist():
+            row = rows[i]
+            key = row.tobytes()
+            if not self._free or (self.budget is not None
+                                  and not self.budget.take()):
+                self.discarded += 1
+                self._nat.remove(row)   # pending entry must not linger
+                pend[key] = -1
+                continue
+            slot = self._free.pop()
+            self._nat.insert(row, slot)
+            self.slot_keys[slot] = row
+            self.active[slot] = True
+            self.last_seen[slot] = now
+            pend[key] = slot
+            out[i] = slot
+        # duplicates of new combos within this batch resolved host-side
+        unres = np.flatnonzero((out[:n] < 0) & valid[:n])
+        for i in unres.tolist():
+            out[i] = pend.get(rows[i].tobytes(), -1)
 
     def purge_stale(self, older_than: float) -> np.ndarray:
         """Evict series idle since before `older_than`; returns evicted slots."""

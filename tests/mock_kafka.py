@@ -100,10 +100,37 @@ class MockCluster:
         self.leaders = {p: p % n_brokers for p in range(n_partitions)}
         self.coordinator = 0
         self.addrs: dict[int, tuple[str, int]] = {}   # set after bind
+        # consumer groups: group -> state dict (members, generation,
+        # per-generation sync barrier, leader-provided assignments)
+        self.groups: dict[str, dict] = {}
 
     def move_leader(self, partition: int, broker_id: int) -> None:
         with self.lock:
             self.leaders[partition] = broker_id
+
+    def move_coordinator(self, broker_id: int) -> None:
+        """Coordinator failover: group state migrates (Kafka replicates
+        __consumer_offsets); the OLD broker starts answering
+        NOT_COORDINATOR, which clients must heal by re-discovery."""
+        with self.lock:
+            self.coordinator = broker_id
+
+    def group_state(self, group: str) -> dict:
+        # lock held by callers where it matters
+        return self.groups.setdefault(group, {
+            "members": {}, "generation": 0, "synced_gen": -1,
+            "assignments": {}, "next_member": 0})
+
+    def expire_member(self, group: str, member_id: str) -> None:
+        """Session-timeout simulation: the coordinator drops the member
+        and forces a rebalance (the live members learn via heartbeat)."""
+        with self.lock:
+            g = self.group_state(group)
+            if member_id in g["members"]:
+                del g["members"][member_id]
+                g["generation"] += 1
+                g["assignments"].clear()
+                g["synced_gen"] = -1
 
 
 class MockKafkaBroker:
@@ -209,7 +236,118 @@ class MockKafkaBroker:
             return self._offset_fetch(body)
         if api_key == 10:
             return self._find_coordinator(body)
+        if api_key == 11:
+            return self._join_group(body)
+        if api_key == 12:
+            return self._heartbeat(body)
+        if api_key == 13:
+            return self._leave_group(body)
+        if api_key == 14:
+            return self._sync_group(body)
         raise ValueError(f"unsupported api key {api_key}")
+
+    # -- consumer groups (JoinGroup v5 / SyncGroup v3 / Heartbeat v3 /
+    #    LeaveGroup v1) — the coordinator-side state machine a group
+    #    client must drive: MEMBER_ID_REQUIRED on first contact, a
+    #    generation bump + sync barrier on every membership change,
+    #    REBALANCE_IN_PROGRESS heartbeats until the leader re-syncs ------
+
+    def _join_group(self, body: bytes) -> bytes:
+        r = _R(body)
+        group = r.string()
+        r.take(">i"); r.take(">i")              # session/rebalance timeout
+        member = r.string() or ""
+        r.string()                              # group instance id
+        r.string()                              # protocol type
+        protos = []
+        for _ in range(max(r.take(">i"), 0)):
+            protos.append((r.string(), r.bytes_()))
+        meta = protos[0][1] if protos else b""
+        resp_members = b""
+        with self.lock:
+            if not self._is_coordinator():
+                return (_i32(0) + _i16(16) + _i32(-1) + _str("") +
+                        _str("") + _str("") + _i32(0))
+            g = self.cluster.group_state(group)
+            if not member:
+                g["next_member"] += 1
+                member = f"{group}-m{g['next_member']}"
+                # v4+ contract: park the id, demand a re-join with it
+                return (_i32(0) + _i16(79) + _i32(-1) + _str("") +
+                        _str("") + _str(member) + _i32(0))
+            if member not in g["members"]:
+                g["generation"] += 1
+                g["assignments"].clear()
+                g["synced_gen"] = -1
+            g["members"][member] = meta
+            leader = sorted(g["members"])[0]
+            gen = g["generation"]
+            if member == leader:
+                resp_members = b"".join(
+                    _str(m) + _i16(-1) +        # null instance id
+                    _i32(len(mm)) + mm
+                    for m, mm in sorted(g["members"].items()))
+                n_members = len(g["members"])
+            else:
+                n_members = 0
+        return (_i32(0) + _i16(0) + _i32(gen) + _str("range") +
+                _str(leader) + _str(member) + _i32(n_members) +
+                resp_members)
+
+    def _sync_group(self, body: bytes) -> bytes:
+        r = _R(body)
+        group = r.string()
+        gen = r.take(">i")
+        member = r.string() or ""
+        r.string()                              # instance id
+        assigns = []
+        for _ in range(max(r.take(">i"), 0)):
+            assigns.append((r.string() or "", r.bytes_() or b""))
+        with self.lock:
+            if not self._is_coordinator():
+                return _i32(0) + _i16(16) + _i32(0)
+            g = self.cluster.group_state(group)
+            if member not in g["members"]:
+                return _i32(0) + _i16(25) + _i32(0)   # UNKNOWN_MEMBER
+            if gen != g["generation"]:
+                return _i32(0) + _i16(22) + _i32(0)   # ILLEGAL_GENERATION
+            if assigns:                         # the leader's sync
+                g["assignments"] = dict(assigns)
+                g["synced_gen"] = gen
+            if g["synced_gen"] != g["generation"]:
+                return _i32(0) + _i16(27) + _i32(0)   # REBALANCE_IN_PROG
+            mine = g["assignments"].get(member, b"")
+        return _i32(0) + _i16(0) + _i32(len(mine)) + mine
+
+    def _heartbeat(self, body: bytes) -> bytes:
+        r = _R(body)
+        group = r.string()
+        gen = r.take(">i")
+        member = r.string() or ""
+        with self.lock:
+            if not self._is_coordinator():
+                return _i32(0) + _i16(16)
+            g = self.cluster.group_state(group)
+            if member not in g["members"]:
+                return _i32(0) + _i16(25)
+            if gen != g["generation"] or g["synced_gen"] != g["generation"]:
+                return _i32(0) + _i16(27)
+        return _i32(0) + _i16(0)
+
+    def _leave_group(self, body: bytes) -> bytes:
+        r = _R(body)
+        group = r.string()
+        member = r.string() or ""
+        with self.lock:
+            if not self._is_coordinator():
+                return _i32(0) + _i16(16)
+            g = self.cluster.group_state(group)
+            if member in g["members"]:
+                del g["members"][member]
+                g["generation"] += 1
+                g["assignments"].clear()
+                g["synced_gen"] = -1
+        return _i32(0) + _i16(0)
 
     def _metadata(self, body: bytes) -> bytes:
         # Metadata v1 response: brokers, controller, topics w/ leaders
@@ -306,8 +444,8 @@ class MockKafkaBroker:
         self.offset_reqs += 1
         r = _R(body)
         group = r.string()
-        r.take(">i")                            # generation
-        r.string()                              # member id
+        gen = r.take(">i")                      # generation
+        member = r.string() or ""               # member id
         r.take(">q")                            # retention
         out_topics = []
         for _t in range(r.take(">i")):
@@ -321,6 +459,15 @@ class MockKafkaBroker:
                     parts.append(_i32(part) + _i16(16))   # NOT_COORDINATOR
                     continue
                 with self.lock:
+                    # generation fencing: a group-mode commit (gen >= 0)
+                    # from a dead member or stale generation is rejected
+                    # (simple bus commits pass gen -1 and stay ungated)
+                    if gen >= 0 and group in self.cluster.groups:
+                        g = self.cluster.group_state(group)
+                        if member not in g["members"] or \
+                                gen != g["generation"]:
+                            parts.append(_i32(part) + _i16(22))
+                            continue
                     self.offsets[(group, topic, part)] = off
                 parts.append(_i32(part) + _i16(0))
             out_topics.append(

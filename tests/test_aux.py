@@ -361,3 +361,40 @@ def test_debug_profile_endpoints(tmp_path):
     finally:
         srv.shutdown()
         app.shutdown()
+
+
+def test_dashboards_generated_from_single_source():
+    """The four ops dashboards are GENERATED (operations/gen_dashboards.py,
+    the tempo-mixin dashboards.libsonnet analog) — committed JSON must
+    match the generator exactly so panels cannot drift from the spec."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "operations",
+                                      "gen_dashboards.py"), "--check"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr or proc.stdout
+
+
+def test_runbook_covers_every_alert():
+    """Every alert in operations/alerts.yaml has a matching `## <Alert>`
+    runbook section AND a runbook_url annotation pointing at it
+    (reference: operations/tempo-mixin/runbook.md maps alerts to operator
+    actions)."""
+    import os
+    import re
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    alerts_text = open(os.path.join(root, "operations",
+                                    "alerts.yaml")).read()
+    runbook = open(os.path.join(root, "operations", "runbook.md")).read()
+    alerts = re.findall(r"- alert: (\w+)", alerts_text)
+    assert len(alerts) >= 9
+    sections = set(re.findall(r"^## (\w+)", runbook, re.M))
+    urls = set(re.findall(r"runbook_url: \S*#(\w+)", alerts_text))
+    for a in alerts:
+        assert a in sections, f"runbook section missing for alert {a}"
+        assert a.lower() in urls, f"runbook_url missing for alert {a}"

@@ -253,3 +253,110 @@ def test_native_histogram_encoding_with_offset():
     f = pw.decode_fields(body)
     spans = [pw.decode_fields(bytes(s)) for s in f[11]]
     assert pw.zigzag_decode(spans[0][1][0]) == 0 and spans[0][2][0] == 1
+
+
+# -- staged fast paths (round-5 e2e throughput work) -------------------------
+#
+# The dedicated-spanmetrics generator resolves staged records straight to
+# device arrays in C++ (`native.spanmetrics_resolve`), and the in-process
+# distributor tee hands over scan RECORDS without re-parsing or slicing
+# (`native.spanmetrics_from_recs`). Both must be bit-identical to the full
+# SpanBatch staging path — same series table, same device states.
+
+def _fast_slow_pair(n_spans=4096):
+    import bench as _bench
+    from tempo_tpu.generator.generator import Generator
+    from tempo_tpu.generator.instance import GeneratorConfig
+    from tempo_tpu.overrides import Overrides
+
+    payload = _bench._make_otlp_payload(n_spans, seed=3)
+
+    def mk():
+        cfg = GeneratorConfig(processors=("span-metrics",))
+        cfg.registry.disable_collection = True
+        return Generator(cfg, overrides=Overrides())
+
+    return payload, mk(), mk()
+
+
+def _assert_state_equal(pa, pb):
+    for a, b, what in (
+            (pa.calls.state.values, pb.calls.state.values, "calls"),
+            (pa.latency.state.bucket_counts, pb.latency.state.bucket_counts,
+             "latency"),
+            (pa.sizes.state.values, pb.sizes.state.values, "sizes"),
+            (pa.dd.counts, pb.dd.counts, "ddsketch")):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=what)
+
+
+def test_staged_fast_path_matches_full_staging():
+    payload, fast, slow = _fast_slow_pair()
+    slow.instance("t").push_otlp_staged = lambda *a, **k: None  # force full
+    for _ in range(2):                      # second push hits warm tables
+        n1 = fast.push_otlp("t", payload)
+        n2 = slow.push_otlp("t", payload)
+    assert n1 == n2 == 4096
+    pf = fast.instance("t").processors["span-metrics"]
+    ps = slow.instance("t").processors["span-metrics"]
+    _assert_state_equal(pf, ps)
+    # collected samples agree (labels resolve through the same interner)
+    sa = sorted((s.name, s.labels, s.value)
+                for s in fast.instance("t").registry.collect(1000))
+    sb = sorted((s.name, s.labels, s.value)
+                for s in slow.instance("t").registry.collect(1000))
+    assert sa == sb and sa
+
+
+def test_tee_recs_route_matches_payload_route():
+    from tempo_tpu import native
+    payload, ga, gb = _fast_slow_pair()
+    recs = native.otlp_scan(payload)
+    if recs is None:
+        pytest.skip("native layer unavailable")
+    gb.push_otlp_recs = lambda *a, **k: None    # force payload-bytes route
+    for _ in range(2):
+        got = ga.push_otlp_recs("t", payload, recs)
+        assert got == 4096
+        gb.push_otlp("t", payload, trusted=True)
+    _assert_state_equal(ga.instance("t").processors["span-metrics"],
+                        gb.instance("t").processors["span-metrics"])
+
+
+def test_tee_recs_route_sharded_subset():
+    """A ring-sharded tee passes a record SUBSET with the ORIGINAL payload;
+    series must match pushing the equivalent sliced payload."""
+    from tempo_tpu import native
+    from tempo_tpu.model.otlp import slice_otlp_payload
+    payload, ga, gb = _fast_slow_pair()
+    recs = native.otlp_scan(payload)
+    if recs is None:
+        pytest.skip("native layer unavailable")
+    pick = np.arange(len(recs)) % 3 == 0
+    sub = recs[pick]
+    assert ga.push_otlp_recs("t", payload, sub) == int(pick.sum())
+    sliced = slice_otlp_payload(payload, recs,
+                                np.flatnonzero(pick).tolist())
+    gb.push_otlp("t", sliced, trusted=True)
+    _assert_state_equal(ga.instance("t").processors["span-metrics"],
+                        gb.instance("t").processors["span-metrics"])
+
+
+def test_staged_fast_path_slack_filter_counts():
+    import bench as _bench
+    from tempo_tpu.generator.generator import Generator
+    from tempo_tpu.generator.instance import GeneratorConfig
+    from tempo_tpu.overrides import Overrides
+
+    cfg = GeneratorConfig(processors=("span-metrics",))
+    cfg.registry.disable_collection = True
+    cfg.ingestion_time_range_slack_s = 30.0
+    gen = Generator(cfg, overrides=Overrides())
+    payload = _bench._make_otlp_payload(512, seed=9)
+    import time as _time
+    inst = gen.instance("t")
+    # make every span stale: pushes far in the "future" slide the window
+    inst.now = lambda: _time.time() + 10_000
+    gen.push_otlp("t", payload)
+    assert inst.spans_filtered_slack == 512
+    assert inst.spans_received == 512

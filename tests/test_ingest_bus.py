@@ -502,3 +502,141 @@ def test_kafka_dead_broker_failover():
     finally:
         for s in servers:
             s.shutdown()
+
+
+# -- consumer groups (round 5: JoinGroup/SyncGroup/Heartbeat rebalance) ------
+
+def _mk_group_bus(ports, n_partitions=4):
+    from tempo_tpu.ingest.kafka import KafkaBus
+    return KafkaBus(f"127.0.0.1:{ports[0]}", n_partitions=n_partitions,
+                    timeout_s=5.0)
+
+
+def test_consumer_group_join_and_range_assignment():
+    """Two members split 4 partitions via the group protocol: the first
+    member owns everything alone, then hands half over after the second
+    joins (the rebalance dance: heartbeat → REBALANCE_IN_PROGRESS →
+    rejoin → leader re-syncs)."""
+    from tempo_tpu.ingest.kafka import ConsumerGroup
+    from tests.mock_kafka import start_mock_kafka
+
+    srv, port, broker = start_mock_kafka(n_partitions=4)
+    try:
+        bus = _mk_group_bus([port])
+        fake_now = [1000.0]
+        c1 = ConsumerGroup(bus, "bb", now=lambda: fake_now[0])
+        c2 = ConsumerGroup(bus, "bb", now=lambda: fake_now[0])
+        assert c1.ensure_active() == [0, 1, 2, 3]     # sole member
+        # second member joins: its first sync is mid-rebalance (empty)
+        assert c2.ensure_active() == []
+        # c1's next heartbeat sees the rebalance and rejoins as leader
+        fake_now[0] += 3600
+        a1 = c1.ensure_active()
+        a2 = c2.ensure_active()
+        assert sorted(a1 + a2) == [0, 1, 2, 3]
+        assert a1 and a2, (a1, a2)                    # both own something
+        bus.close()
+    finally:
+        srv.shutdown()
+
+
+def test_consumer_group_member_death_rebalances_without_loss():
+    """A member dies (session expiry): its partitions move to the
+    survivor, which resumes from the COMMITTED offsets — records the dead
+    member had not committed are replayed, none are lost. Zombie commits
+    from the dead member are fenced (ILLEGAL_GENERATION)."""
+    import pytest
+    from tempo_tpu.ingest.kafka import ConsumerGroup, KafkaError
+    from tests.mock_kafka import start_mock_kafka
+
+    srv, port, broker = start_mock_kafka(n_partitions=4)
+    try:
+        bus = _mk_group_bus([port])
+        for p in range(4):
+            for i in range(3):
+                bus.produce(p, "t", b"p%d-%d" % (p, i))
+        fake_now = [1000.0]
+        c1 = ConsumerGroup(bus, "bb", now=lambda: fake_now[0])
+        c2 = ConsumerGroup(bus, "bb", now=lambda: fake_now[0])
+        c1.ensure_active()
+        c2.ensure_active()
+        fake_now[0] += 3600
+        a1, a2 = c1.ensure_active(), c2.ensure_active()
+        assert sorted(a1 + a2) == [0, 1, 2, 3]
+        # both consume + commit part of their partitions
+        c1.commit(a1[0], 2)
+        c2.commit(a2[0], 1)          # c2 read 1 of 3 records, then dies
+        broker.cluster.expire_member("bb", c2.member_id)
+        # survivor heartbeats into the rebalance and takes everything
+        fake_now[0] += 3600
+        a1b = c1.ensure_active()
+        if not a1b:                  # mid-rebalance tick → next tick owns
+            a1b = c1.ensure_active()
+        assert a1b == [0, 1, 2, 3]
+        # offsets replay from the dead member's last COMMIT (no loss):
+        assert bus.committed("bb", a2[0]) == 1
+        recs = bus.fetch(a2[0], bus.committed("bb", a2[0]))
+        assert len(recs) == 2        # the uncommitted tail replays
+        # the zombie's generation-fenced commit is REJECTED
+        with pytest.raises(KafkaError):
+            c2.commit(a2[0], 3)
+        assert bus.committed("bb", a2[0]) == 1
+        bus.close()
+    finally:
+        srv.shutdown()
+
+
+def test_blockbuilder_and_generator_group_mode():
+    """partitions=None on a Kafka bus → the consume loops run in group
+    mode end-to-end: blockbuilder flushes blocks from its ASSIGNED
+    partitions and commits with the group generation."""
+    from tempo_tpu.backend.mem import MemBackend
+    from tempo_tpu.blockbuilder import BlockBuilder, BlockBuilderConfig
+    from tempo_tpu.ingest.encoding import encode_push
+    from tests.mock_kafka import start_mock_kafka
+
+    srv, port, broker = start_mock_kafka(n_partitions=2)
+    try:
+        bus = _mk_group_bus([port], n_partitions=2)
+        for p in range(2):
+            bus.produce(p, "t", encode_push(
+                [(b"\x01" * 16, [{"trace_id": b"\x01" * 16,
+                                  "span_id": b"\x02" * 8,
+                                  "name": f"op{p}", "service": "svc",
+                                  "start_unix_nano": 1, "end_unix_nano": 2,
+                                  "kind": 2, "status_code": 0}])])[0])
+        be = MemBackend()
+        bb = BlockBuilder(bus, be, BlockBuilderConfig(partitions=None))
+        assert bb.consume_cycle() == 2           # group assigned both
+        assert bb.blocks_flushed >= 1
+        assert bb._cg is not None and bb._cg.generation >= 0
+        assert bus.committed("blockbuilder", 0) == 1
+        assert bus.committed("blockbuilder", 1) == 1
+        bus.close()
+    finally:
+        srv.shutdown()
+
+
+def test_consumer_group_survives_coordinator_move():
+    """The group coordinator moves to another broker mid-membership
+    (normal Kafka operation): heartbeats start answering NOT_COORDINATOR
+    and the member must re-discover + retry — NOT go permanently dead."""
+    from tempo_tpu.ingest.kafka import ConsumerGroup
+    from tests.mock_kafka import start_mock_kafka_cluster
+
+    servers, ports, brokers, cluster = start_mock_kafka_cluster(
+        n_partitions=4, n_brokers=2)
+    try:
+        bus = _mk_group_bus(ports)
+        fake_now = [1000.0]
+        cg = ConsumerGroup(bus, "bb", now=lambda: fake_now[0])
+        assert cg.ensure_active() == [0, 1, 2, 3]
+        cluster.move_coordinator(1)
+        fake_now[0] += 3600                      # next tick heartbeats
+        assert cg.ensure_active() == [0, 1, 2, 3]
+        cg.commit(0, 5)                          # commits heal too
+        assert bus.committed("bb", 0) == 5
+        bus.close()
+    finally:
+        for s in servers:
+            s.shutdown()

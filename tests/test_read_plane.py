@@ -405,3 +405,94 @@ def _race_dbs():
     # a first query adopts the columns so the plane is resident
     dev.search("t", '{ name = "op-1" }', limit=10)
     return dev, None
+
+
+def test_float_attribute_columns_on_fused_path():
+    """Float-valued attribute columns ride the fused plane via the
+    order-preserving sortable-int64 encoding (round-4 weak #4: they used
+    to refuse and silently lose the whole fused win). Device must match
+    host bit-for-bit on boundary literals, and the routing counters must
+    show FUSED service, not a predicate fallback."""
+    rng = np.random.default_rng(21)
+    be = MemBackend()
+    dev = _mk_db(be, True)
+    host = _mk_db(be, False)
+    # values engineered onto compare boundaries incl. negatives, exact
+    # halves, and f32-unrepresentable doubles; svc-1 spans carry NO ratio
+    vals = [0.5, 1.5, -2.25, 0.1, 16777217.5, -0.0, 3.0, 1e300]
+    traces = []
+    for i in range(400):
+        tid = rng.bytes(16)
+        start = int((T0 + i) * 1e9)
+        attrs = {"ratio": vals[i % len(vals)]} if i % 3 != 1 else {}
+        traces.append((tid, [{
+            "trace_id": tid, "span_id": rng.bytes(8),
+            "name": f"op-{i % 3}", "service": f"svc-{i % 2}",
+            "kind": 2, "status_code": 0,
+            "start_unix_nano": start,
+            "end_unix_nano": start + 2_000_000,
+            "attrs": attrs}]))
+    dev.write_block("t", traces, replication_factor=1)
+    dev.poll_now(); host.poll_now()
+    queries = [
+        '{ span.ratio > 0.5 } | rate() by (name)',
+        '{ span.ratio >= 1.5 } | count_over_time()',
+        '{ span.ratio < 0 } | rate() by (name)',
+        '{ span.ratio = -2.25 } | count_over_time()',
+        '{ span.ratio = 0.0 } | rate()',          # matches -0.0 rows too
+        '{ span.ratio != 0.1 } | rate() by (name)',   # exists-gated NEQ
+        '{ span.ratio = 16777217.5 } | count_over_time()',
+        '{ span.ratio > 2 } | rate()',            # int literal, float col
+    ]
+    for q in queries:
+        req = QueryRangeRequest(query=q, start_ns=int(T0 * 1e9),
+                                end_ns=int((T0 + 500) * 1e9),
+                                step_ns=int(100e9))
+        a = _series_map(dev.query_range("t", req))
+        b = _series_map(host.query_range("t", req))
+        assert set(a) == set(b), q
+        for k in b:
+            np.testing.assert_array_equal(a[k], b[k], err_msg=f"{q} {k}")
+        sa = sorted(m.trace_id for m in dev.search("t", q.split("|")[0].strip(),
+                                                   limit=1000))
+        sb = sorted(m.trace_id for m in host.search("t", q.split("|")[0].strip(),
+                                                    limit=1000))
+        assert sa == sb, q
+    # every query above must have taken the fused path
+    assert dev.plane_stats["fused_metric_blocks"] >= len(queries)
+    assert not any(k.startswith("fallback_") for k in dev.plane_stats), \
+        dev.plane_stats
+
+
+def test_fallback_cause_counters():
+    """Host fallbacks carry a cause in plane_stats (round-4 weak #4) and
+    surface as tempo_read_plane_fallback_total{cause=...}."""
+    dev, _ = _race_dbs()
+    req = QueryRangeRequest(
+        query='{ name = "op-1" || name = "op-2" } | rate() by (name)',
+        start_ns=int(T0 * 1e9), end_ns=int((T0 + 100) * 1e9),
+        step_ns=int(50e9))
+    dev.query_range("t", req)       # OR filter → not fusable (query shape)
+    assert dev.plane_stats.get("fallback_query_shape", 0) >= 1
+    # NaN column values have no consistent order → predicate cause
+    rng = np.random.default_rng(23)
+    be2 = MemBackend()
+    dev2 = _mk_db(be2, True)
+    traces = []
+    for i in range(20):
+        tid = rng.bytes(16)
+        start = int((T0 + i) * 1e9)
+        traces.append((tid, [{
+            "trace_id": tid, "span_id": rng.bytes(8),
+            "name": "op", "service": "svc", "kind": 2, "status_code": 0,
+            "start_unix_nano": start, "end_unix_nano": start + 1_000_000,
+            "attrs": {"x": float("nan") if i % 2 else 1.5}}]))
+    dev2.write_block("t", traces, replication_factor=1)
+    dev2.poll_now()
+    req2 = QueryRangeRequest(
+        query='{ span.x > 1.0 } | rate() by (name)',
+        start_ns=int(T0 * 1e9), end_ns=int((T0 + 100) * 1e9),
+        step_ns=int(50e9))
+    dev2.query_range("t", req2)
+    assert dev2.plane_stats.get("fallback_predicate", 0) >= 1, \
+        dev2.plane_stats
