@@ -227,8 +227,16 @@ class App:
                                   self.cfg.storage.hedge_max)
         if self.cfg.storage.cache_enabled:
             from tempo_tpu.backend.cache import CacheProvider, CachingReader
+            sc = self.cfg.storage
+            caches = {}
+            if sc.memcached_addrs:
+                from tempo_tpu.backend.memcached import MemcachedCache
+                shared = MemcachedCache(
+                    sc.memcached_addrs, timeout_s=sc.memcached_timeout_s,
+                    expiration_s=sc.memcached_expiration_s)
+                caches = {role: shared for role in sc.memcached_roles}
             self.cache_provider = CacheProvider(
-                default_bytes=self.cfg.storage.cache_bytes_per_role)
+                caches=caches, default_bytes=sc.cache_bytes_per_role)
             reader = CachingReader(reader, self.cache_provider)
         self.db = TempoDB(reader, self.backend, TempoDBConfig(
             compactor=self.cfg.compactor,

@@ -54,6 +54,14 @@ class StorageConfig:
     pool_workers: int = 30
     cache_enabled: bool = True          # bloom/footer/page role caches
     cache_bytes_per_role: int = 64 << 20
+    # shared external cache tier (pkg/cache/memcached_client.go analog):
+    # "host:port[,host:port...]" — when set, the listed roles ride the
+    # SDK-free memcached client (write-behind) so every querier/frontend
+    # replica shares one working set; empty = in-process LRUs only
+    memcached_addrs: str = ""
+    memcached_roles: tuple = ("bloom", "parquet-footer", "frontend-search")
+    memcached_timeout_s: float = 0.5
+    memcached_expiration_s: int = 0
     hedge_delay_s: float = 0.0          # >0: hedge slow object reads
     hedge_max: int = 1
 

@@ -1,0 +1,227 @@
+"""SDK-free memcached client + write-behind queue: the SHARED cache tier.
+
+The in-process role LRUs (`backend/cache.py`) keep one replica warm; the
+reference additionally parks bloom/footer/page/frontend-search entries in
+memcached or redis so N queriers/frontends share one working set
+(`pkg/cache/memcached_client.go`, `redis_client.go`). This module speaks
+the memcached TEXT protocol directly (get/set/touch semantics — the same
+subset the reference's client uses through gomemcache), with:
+
+- a server LIST and FNV-keyed server selection
+  (`memcached_client.go:74` ServerList semantics: a key lives on exactly
+  one server, so replicas agree without coordination),
+- key sanitization: memcached keys are ≤250 printable bytes; longer or
+  unsafe keys are replaced by their sha1 (the reference hashes through
+  its `cache.HashKey`),
+- a WRITE-BEHIND queue (`pkg/cache/background.go`): puts enqueue and
+  return; worker threads drain to the network, and a full queue DROPS the
+  write (counted) instead of stalling the read path.
+
+`MemcachedCache` matches the LRUCache get/put surface, so a CacheProvider
+can map any role to the shared tier (`app/config.py
+storage.memcached_addrs`); misses simply fall through to the backend.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import queue
+import socket
+import threading
+
+_FNV_OFF = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+
+
+def _fnv64(b: bytes) -> int:
+    h = _FNV_OFF
+    for c in b:
+        h = ((h ^ c) * _FNV_PRIME) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def sanitize_key(key: str) -> bytes:
+    """Memcached-legal key: ≤250 bytes, no spaces/control chars."""
+    b = key.encode()
+    if len(b) <= 250 and all(33 <= c <= 126 for c in b):
+        return b
+    return hashlib.sha1(b).hexdigest().encode()
+
+
+class _ServerConn:
+    """Connections to one memcached server, ONE PER CALLING THREAD (via
+    threading.local): a 30-worker read pool must not head-of-line block
+    on a single mutex-serialized socket — the reference client pools
+    connections for the same reason."""
+
+    def __init__(self, addr: str, timeout_s: float) -> None:
+        host, _, port = addr.rpartition(":")
+        self.addr = (host or "127.0.0.1", int(port))
+        self.timeout_s = timeout_s
+        self._tls = threading.local()
+        self._all: list[socket.socket] = []     # for close()
+        self._all_lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        t = self._tls
+        if getattr(t, "sock", None) is None:
+            s = socket.create_connection(self.addr, timeout=self.timeout_s)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t.sock = s
+            t.buf = b""
+            with self._all_lock:
+                self._all.append(s)
+        return t.sock
+
+    def _reset(self) -> None:
+        t = self._tls
+        s = getattr(t, "sock", None)
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+            with self._all_lock:
+                if s in self._all:
+                    self._all.remove(s)
+            t.sock = None
+        t.buf = b""
+
+    def _read_line(self, s: socket.socket) -> bytes:
+        t = self._tls
+        while b"\r\n" not in t.buf:
+            chunk = s.recv(65536)
+            if not chunk:
+                raise ConnectionError("memcached closed")
+            t.buf += chunk
+        line, t.buf = t.buf.split(b"\r\n", 1)
+        return line
+
+    def _read_n(self, s: socket.socket, n: int) -> bytes:
+        t = self._tls
+        while len(t.buf) < n:
+            chunk = s.recv(65536)
+            if not chunk:
+                raise ConnectionError("memcached closed")
+            t.buf += chunk
+        out, t.buf = t.buf[:n], t.buf[n:]
+        return out
+
+    def get(self, key: bytes) -> bytes | None:
+        try:
+            s = self._connect()
+            s.sendall(b"get " + key + b"\r\n")
+            line = self._read_line(s)
+            if line == b"END":
+                return None
+            if not line.startswith(b"VALUE "):
+                raise ConnectionError(f"bad get response {line[:80]!r}")
+            n = int(line.rsplit(b" ", 1)[1])
+            val = self._read_n(s, n)
+            self._read_n(s, 2)              # trailing \r\n
+            if self._read_line(s) != b"END":
+                raise ConnectionError("missing END")
+            return val
+        except (OSError, ValueError, ConnectionError):
+            self._reset()
+            return None
+
+    def set(self, key: bytes, value: bytes, exp_s: int) -> bool:
+        try:
+            s = self._connect()
+            s.sendall(b"set " + key + b" 0 " +
+                      str(exp_s).encode() + b" " +
+                      str(len(value)).encode() + b"\r\n" +
+                      value + b"\r\n")
+            return self._read_line(s) == b"STORED"
+        except (OSError, ConnectionError):
+            self._reset()
+            return False
+
+    def close(self) -> None:
+        with self._all_lock:
+            socks, self._all = list(self._all), []
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class MemcachedCache:
+    """LRUCache-shaped client over a memcached server list with a
+    write-behind queue. Network failures degrade to misses — the cache
+    tier must never take the read path down."""
+
+    def __init__(self, servers: "list[str] | str",
+                 timeout_s: float = 0.5, expiration_s: int = 0,
+                 write_back_buffer: int = 1024,
+                 write_back_workers: int = 1) -> None:
+        if isinstance(servers, str):
+            servers = [s for s in servers.split(",") if s]
+        self._conns = [_ServerConn(a, timeout_s) for a in servers]
+        self.expiration_s = expiration_s
+        self.hits = 0
+        self.misses = 0
+        self.dropped_writes = 0          # background.go droppedWriteBack
+        self.stored = 0
+        self._q: "queue.Queue[tuple[bytes, bytes] | None]" = queue.Queue(
+            maxsize=write_back_buffer)
+        self._workers = []
+        for _ in range(max(write_back_workers, 1)):
+            t = threading.Thread(target=self._drain, daemon=True)
+            t.start()
+            self._workers.append(t)
+
+    def _conn_for(self, key: bytes) -> _ServerConn:
+        if len(self._conns) == 1:
+            return self._conns[0]
+        return self._conns[_fnv64(key) % len(self._conns)]
+
+    def get(self, key: str) -> bytes | None:
+        k = sanitize_key(key)
+        v = self._conn_for(k).get(k)
+        if v is None:
+            self.misses += 1
+        else:
+            self.hits += 1
+        return v
+
+    def put(self, key: str, value: bytes) -> None:
+        """Write-behind: enqueue and return; a full queue drops (counted)
+        rather than blocking the caller (`background.go:45-60`)."""
+        try:
+            self._q.put_nowait((sanitize_key(key), bytes(value)))
+        except queue.Full:
+            self.dropped_writes += 1
+
+    def _drain(self) -> None:
+        while True:
+            item = self._q.get()
+            try:
+                if item is None:
+                    return
+                k, v = item
+                if self._conn_for(k).set(k, v, self.expiration_s):
+                    self.stored += 1
+            finally:
+                self._q.task_done()
+
+    def flush(self, timeout_s: float = 5.0) -> None:
+        """Test/shutdown helper: wait until every enqueued write has
+        COMPLETED (task_done-tracked — q.empty() turns true while the
+        last write is still on the socket)."""
+        import time
+
+        deadline = time.time() + timeout_s
+        while self._q.unfinished_tasks and time.time() < deadline:
+            time.sleep(0.01)
+
+    def close(self) -> None:
+        for _ in self._workers:
+            try:
+                self._q.put_nowait(None)
+            except queue.Full:
+                break
+        for c in self._conns:
+            c.close()

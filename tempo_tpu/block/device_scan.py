@@ -510,12 +510,18 @@ class BlockScanPlane:
     demanded before this became the default path.
     """
 
-    def __init__(self, views: Sequence) -> None:
+    def __init__(self, views: Sequence, mesh=None) -> None:
         self.views = list(views)
         self.sizes = [int(v.n) for v in self.views]
         self.offsets = np.concatenate(
             [[0], np.cumsum(self.sizes)]).astype(np.int64)
         self.n = int(self.offsets[-1])
+        # optional multi-device mesh: span-dim columns shard over its
+        # 'data' axis; LUTs/grids replicate, and XLA's SPMD partitioner
+        # inserts the cross-device reduce for the grid scatters — the SAME
+        # fused kernels run single- or multi-chip (scaling-book recipe:
+        # annotate shardings, let the compiler place collectives)
+        self.mesh = mesh
         self.time_base_ns = 0
         self._cols: dict = {}          # (kind, key) → entry | None
         self._qr_cache: dict = {}
@@ -539,9 +545,21 @@ class BlockScanPlane:
     # -- adoption ----------------------------------------------------------
 
     def _up(self, arr: np.ndarray):
+        import jax
         import jax.numpy as jnp
 
-        d = jnp.asarray(arr)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            # span-dim arrays shard over 'data'; everything else (dict
+            # LUTs, row-group tables) replicates
+            spec = P("data") if (getattr(arr, "ndim", 0) >= 1
+                                 and arr.shape[0] == self.n) else P()
+            d = jax.device_put(np.asarray(arr),
+                               NamedSharding(self.mesh, spec))
+        else:
+            d = jnp.asarray(arr)
         self.device_bytes += int(arr.nbytes)
         return d
 

@@ -73,6 +73,89 @@ def cmd_list_block(args) -> int:
     return 0
 
 
+def cmd_cache_summary(args) -> int:
+    """Bloom-filter bytes by age (days) × compaction level — the cache
+    sizing view (`cmd-list-cachesummary.go`: operators size the bloom
+    cache role from this table)."""
+    import time as _time
+
+    from tempo_tpu.backend.raw import block_keypath
+    from tempo_tpu.block.bloom import shard_name
+
+    db = _db(args)
+    now = _time.time()
+    # (level, age_days) -> [shard_count, bloom_bytes]
+    table: dict[tuple[int, int], list[int]] = {}
+    max_lvl = max_age = 0
+    for m in db.blocklist.metas(args.tenant):
+        age = max(int((now - m.start_time) / 86400), 0)
+        lvl = int(m.compaction_level)
+        max_lvl, max_age = max(max_lvl, lvl), max(max_age, age)
+        cell = table.setdefault((lvl, age), [0, 0])
+        kp = block_keypath(m.block_id, args.tenant)
+        for i in range(max(m.bloom_shard_count, 1)):
+            try:
+                cell[1] += db.r.size(shard_name(i), kp)
+                cell[0] += 1
+            except Exception:
+                pass
+    print("bloom filter shards by age (days) x compaction level:")
+    hdr = "lvl " + "".join(f"{f'{d}d':>12}" for d in range(max_age + 1))
+    print(hdr)
+    total = 0
+    for lvl in range(max_lvl + 1):
+        row = [table.get((lvl, d), [0, 0]) for d in range(max_age + 1)]
+        total += sum(c[1] for c in row)
+        print(f"{lvl:>3} " + "".join(
+            f"{f'{c[0]}/{c[1]}B':>12}" for c in row))
+    print(f"total bloom bytes: {total}")
+    return 0
+
+
+def cmd_trace_summary(args) -> int:
+    """Cross-block summary of one trace: block/span counts, duration,
+    root span, service breakdown (`cmd-query-trace-summary.go`)."""
+    db = _db(args)
+    tid = bytes.fromhex(args.trace_id)
+    n_blocks = 0
+    spans: list[dict] = []
+    size = 0
+    for m in db.blocks(args.tenant):
+        got = db.backend_block(m).find_trace_by_id(tid)
+        if got:
+            n_blocks += 1
+            spans.extend(got)
+            size += sum(len(s.get("name", "")) + 64 for s in got)
+    if not spans:
+        print("trace not found")
+        return 1
+    from tempo_tpu.model.combine import combine_spans
+    spans = combine_spans(spans)
+    start = min(s["start_unix_nano"] for s in spans)
+    end = max(s["end_unix_nano"] for s in spans)
+    by_svc: dict[str, int] = {}
+    root = None
+    for s in spans:
+        by_svc[s.get("service", "")] = by_svc.get(s.get("service", ""), 0) + 1
+        if not s.get("parent_span_id", b"").rstrip(b"\0"):
+            root = s
+    print(f"number of blocks: {n_blocks}")
+    print(f"span count: {len(spans)}")
+    print(f"trace size: ~{size} B")
+    print(f"trace duration: {(end - start) / 1e9:.3f} seconds")
+    print(f"root service name: {root.get('service', '') if root else '-'}")
+    if root is not None:
+        print(f"root span: name={root.get('name')!r} "
+              f"kind={root.get('kind')} status={root.get('status_code')} "
+              f"dur={(root['end_unix_nano'] - root['start_unix_nano']) / 1e6:.1f}ms")
+    else:
+        print("no root span found")
+    print("top service.names:")
+    for svc, n in sorted(by_svc.items(), key=lambda kv: -kv[1])[:10]:
+        print(f"  {n:>6} {svc}")
+    return 0
+
+
 def cmd_compaction_summary(args) -> int:
     db = _db(args)
     levels: dict[int, list] = {}
@@ -503,6 +586,8 @@ def main(argv: list[str] | None = None) -> int:
     q = ls.add_parser("column-sizes"); q.add_argument("tenant"); q.add_argument("block")
     q.set_defaults(fn=cmd_list_column_sizes)
     q = ls.add_parser("wal"); q.add_argument("dir"); q.set_defaults(fn=cmd_list_wal)
+    q = ls.add_parser("cachesummary"); q.add_argument("tenant")
+    q.set_defaults(fn=cmd_cache_summary)
 
     p = sub.add_parser("analyse")
     an = p.add_subparsers(dest="what", required=True)
@@ -525,6 +610,8 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("query")
     qs = p.add_subparsers(dest="what", required=True)
     q = qs.add_parser("trace"); q.add_argument("tenant"); q.add_argument("trace_id"); q.set_defaults(fn=cmd_query_trace)
+    q = qs.add_parser("trace-summary"); q.add_argument("tenant")
+    q.add_argument("trace_id"); q.set_defaults(fn=cmd_trace_summary)
     q = qs.add_parser("search"); q.add_argument("tenant"); q.add_argument("query")
     q.add_argument("--limit", type=int, default=20); q.set_defaults(fn=cmd_query_search)
     q = qs.add_parser("metrics"); q.add_argument("tenant"); q.add_argument("query")

@@ -32,12 +32,12 @@ from tempo_tpu.traceql.conditions import FetchSpansRequest
 class CachedBlock:
     """Host views + device plane for one immutable block."""
 
-    def __init__(self, block: BackendBlock):
+    def __init__(self, block: BackendBlock, mesh=None):
         from tempo_tpu.block.fetch import scan_views
 
         self.block = block
         self.views = [v for v, _ in scan_views(block, None)]
-        self.plane = BlockScanPlane(self.views)
+        self.plane = BlockScanPlane(self.views, mesh=mesh)
         # device path usage counters (tests + /metrics)
         self.device_scans = 0
         self.host_scans = 0
@@ -107,10 +107,11 @@ class PlaneCache:
     pinned decoded views from growing to max_blocks full blocks)."""
 
     def __init__(self, budget_bytes: int = 1 << 30, max_blocks: int = 64,
-                 host_budget_bytes: int = 4 << 30):
+                 host_budget_bytes: int = 4 << 30, mesh=None):
         self.budget_bytes = budget_bytes
         self.max_blocks = max_blocks
         self.host_budget_bytes = host_budget_bytes
+        self.mesh = mesh              # multi-device planes (see BlockScanPlane)
         self._entries: "OrderedDict[tuple, CachedBlock]" = OrderedDict()
         self._lock = threading.Lock()
         self.hits = 0
@@ -130,7 +131,7 @@ class PlaneCache:
                 return entry
         # build outside the lock (full-block read); a racing duplicate
         # build is wasted work, not a correctness problem — last one wins
-        entry = CachedBlock(block)
+        entry = CachedBlock(block, mesh=self.mesh)
         with self._lock:
             self.misses += 1
             self._entries[key] = entry

@@ -42,6 +42,9 @@ class TempoDBConfig:
     plane_budget_bytes: int = 1 << 30
     plane_max_blocks: int = 64
     plane_host_budget_bytes: int = 4 << 30
+    # optional jax Mesh: fused plane kernels run sharded over its 'data'
+    # axis (XLA SPMD inserts the grid reduce) — the multi-chip read path
+    plane_mesh: object = None
 
 
 class TempoDB:
@@ -65,7 +68,8 @@ class TempoDB:
 
             self.planes = PlaneCache(self.cfg.plane_budget_bytes,
                                      self.cfg.plane_max_blocks,
-                                     self.cfg.plane_host_budget_bytes)
+                                     self.cfg.plane_host_budget_bytes,
+                                     mesh=self.cfg.plane_mesh)
         # read-plane routing counters: how many block scans took the fused
         # device path vs the host engine (tests + /metrics)
         self.plane_stats = {"fused_metric_blocks": 0, "host_metric_blocks": 0}

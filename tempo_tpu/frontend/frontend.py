@@ -371,12 +371,17 @@ class Frontend:
         return sort_spans(combine_spans(spans)) if spans else None
 
     def query_range(self, tenant: str, query: str, *,
-                    start_s: float, end_s: float, step_s: float = 60.0
+                    start_s: float, end_s: float, step_s: float = 60.0,
+                    on_partial: Callable[[list], None] | None = None
                     ) -> list[TimeSeries]:
         """TraceQL metrics: recent window from generators (RF1 local
         blocks), older from backend jobs; job series merge via
         SeriesCombiner then final quantile/rate pass
-        (`metrics_query_range_sharder.go` + `combiner/metrics_query_range.go`)."""
+        (`metrics_query_range_sharder.go` + `combiner/metrics_query_range.go`).
+
+        `on_partial` (optional) receives the current FINALIZED series set
+        after each contributing sub-result — the incremental feed behind
+        the streaming MetricsQueryRange endpoint (diffed there)."""
         from tempo_tpu.utils import tracing
         tenants = split_tenants(tenant)
         if len(tenants) > 1:
@@ -387,10 +392,12 @@ class Frontend:
         with tracing.span_for_tenant("frontend.QueryRange", tenants[0],
                                      query=query):
             return self._query_range(tenants[0], query, start_s=start_s,
-                                     end_s=end_s, step_s=step_s)
+                                     end_s=end_s, step_s=step_s,
+                                     on_partial=on_partial)
 
     def _query_range(self, tenant: str, query: str, *,
-                     start_s: float, end_s: float, step_s: float = 60.0
+                     start_s: float, end_s: float, step_s: float = 60.0,
+                     on_partial: Callable[[list], None] | None = None
                      ) -> list[TimeSeries]:
         t0 = self.now()
         req = QueryRangeRequest(query=query,
@@ -409,6 +416,8 @@ class Frontend:
         if end_s > cutoff_s and self.generator_query_range is not None:
             comb.add_all(self.generator_query_range(
                 tenant, req, clip_start_ns=cutoff_ns))
+            if on_partial is not None:
+                on_partial(comb.final(req))
         if start_s < cutoff_s:
             # metrics read ONLY RF1 blocks (generator localblocks /
             # blockbuilder output) — ingester RF3 blocks hold every trace 3x
@@ -423,6 +432,8 @@ class Frontend:
 
             def fold(res) -> bool:
                 comb.add_all(res)
+                if on_partial is not None:   # folds run on THIS thread
+                    on_partial(comb.final(req))
                 return True
 
             def qr_key(j) -> "str | None":
@@ -465,13 +476,26 @@ class Frontend:
             return _decode_series(json.dumps(result or []).encode())
         raise ValueError(f"unknown job kind {spec['kind']!r}")
 
-    def tag_names(self, tenant: str) -> dict[str, list[str]]:
+    def tag_names(self, tenant: str,
+                  on_partial: Callable[[dict], None] | None = None
+                  ) -> dict[str, list[str]]:
         t0 = self.now()
         merged: dict[str, list[str]] = {}
-        for t in split_tenants(tenant):
-            for scope, names in self.querier.tag_names(t).items():
+
+        def fold(partial: dict[str, list[str]]) -> None:
+            for scope, names in partial.items():
                 cur = merged.setdefault(scope, [])
                 cur.extend(n for n in names if n not in cur)
+
+        def hook(partial: dict[str, list[str]]) -> None:
+            # partial snapshots are cumulative; fold dedupes, so re-folding
+            # a superset later (the final return) is idempotent
+            fold(partial)
+            on_partial({k: sorted(v) for k, v in merged.items()})
+
+        for t in split_tenants(tenant):
+            fold(self.querier.tag_names(
+                t, on_partial=hook if on_partial is not None else None))
         for scope in merged:
             merged[scope] = sorted(merged[scope])
         self.slos.record("metadata", tenant, self.now() - t0, 0)

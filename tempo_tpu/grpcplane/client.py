@@ -147,6 +147,40 @@ def streaming_search(target: str, tenant: str, query: str, *,
             yield mds, final
 
 
+def streaming_metrics_query_range(target: str, tenant: str, query: str, *,
+                                  start_s: float, end_s: float,
+                                  step_s: float = 60.0,
+                                  timeout_s: float = 60.0):
+    """Client for `tempopb.StreamingQuerier/MetricsQueryRange`: yields one
+    series list per message — diff batches while sub-results fold in,
+    then the complete final set (last message)."""
+    if target.startswith("grpc://"):
+        target = target[len("grpc://"):]
+    from tempo_tpu.model import tempopb
+
+    with grpc.insecure_channel(target) as ch:
+        fn = ch.unary_stream("/tempopb.StreamingQuerier/MetricsQueryRange")
+        body = {"query": query, "start": start_s, "end": end_s,
+                "step": step_s}
+        for msg in fn(_jdump(body), timeout=timeout_s,
+                      metadata=(("x-scope-orgid", tenant),)):
+            yield tempopb.dec_query_range_response(msg)
+
+
+def streaming_search_tags(target: str, tenant: str, *,
+                          timeout_s: float = 60.0):
+    """Client for `tempopb.StreamingQuerier/SearchTags`: yields
+    (scopes_dict, final) as scope diffs stream in."""
+    if target.startswith("grpc://"):
+        target = target[len("grpc://"):]
+    with grpc.insecure_channel(target) as ch:
+        fn = ch.unary_stream("/tempopb.StreamingQuerier/SearchTags")
+        for msg in fn(b"{}", timeout=timeout_s,
+                      metadata=(("x-scope-orgid", tenant),)):
+            d = _jload(msg)
+            yield d.get("scopes", {}), bool(d.get("final"))
+
+
 class FrontendWorker:
     """Querier-side worker: dial the frontend, pull jobs, execute, reply.
 

@@ -226,37 +226,23 @@ class _Services:
 
     # -- StreamingQuerier ---------------------------------------------------
 
-    def streaming_search(self, request: bytes, context):
-        """Server-streaming search: partial diff responses while sub-queries
-        complete, then the final message (`combiner/search.go` diffs)."""
-        tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
-        d = _jload(request)
+    def _stream_partials(self, context, run_fn, enc_diff, enc_final):
+        """Shared server-streaming scaffold (`combiner/*.go` diff shape):
+        `run_fn(emit)` executes the frontend call on a worker thread,
+        calling `emit(batch)` for each diff the endpoint's filter kept;
+        batches are encoded + yielded as they arrive, then the final
+        result ends the stream (or the error aborts it)."""
         import queue as _q
 
         diffs: _q.Queue = _q.Queue()
-        sent: set[str] = set()
-
-        def on_partial(results) -> None:
-            fresh = [md for md in results if md.trace_id not in sent]
-            if fresh:
-                sent.update(md.trace_id for md in fresh)
-                diffs.put(fresh)
-
         out: dict = {}
 
         def run() -> None:
             try:
-                out["res"] = self.app.frontend.search(
-                    tenant, d.get("q", "{ }"),
-                    limit=int(d.get("limit", 20)),
-                    start_s=float(d["start"]) if "start" in d else None,
-                    end_s=float(d["end"]) if "end" in d else None,
-                    on_partial=on_partial)
-            except Exception as e:  # surfaced as the final stream message
+                out["res"] = run_fn(diffs.put)
+            except Exception as e:  # surfaced as the stream's final state
                 out["err"] = e
             diffs.put(None)
-
-        from tempo_tpu.model import tempopb
 
         t = threading.Thread(target=run, daemon=True)
         t.start()
@@ -264,13 +250,98 @@ class _Services:
             batch = diffs.get()
             if batch is None:
                 break
-            yield tempopb.enc_search_response(batch, final=False)
+            yield enc_diff(batch)
         t.join()
         if "err" in out:
             context.abort(grpc.StatusCode.INTERNAL, str(out["err"]))
-        res = out.get("res", [])
-        yield tempopb.enc_search_response(res, inspected=len(res),
-                                          final=True)
+        yield enc_final(out.get("res"))
+
+    def streaming_search(self, request: bytes, context):
+        """Server-streaming search: partial diff responses while sub-queries
+        complete, then the final message (`combiner/search.go` diffs)."""
+        tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
+        d = _jload(request)
+        from tempo_tpu.model import tempopb
+
+        sent: set[str] = set()
+
+        def run_fn(emit):
+            def on_partial(results) -> None:
+                fresh = [md for md in results if md.trace_id not in sent]
+                if fresh:
+                    sent.update(md.trace_id for md in fresh)
+                    emit(fresh)
+
+            return self.app.frontend.search(
+                tenant, d.get("q", "{ }"), limit=int(d.get("limit", 20)),
+                start_s=float(d["start"]) if "start" in d else None,
+                end_s=float(d["end"]) if "end" in d else None,
+                on_partial=on_partial)
+
+        yield from self._stream_partials(
+            context, run_fn,
+            lambda batch: tempopb.enc_search_response(batch, final=False),
+            lambda res: tempopb.enc_search_response(
+                res or [], inspected=len(res or []), final=True))
+
+    def streaming_metrics_query_range(self, request: bytes, context):
+        """Server-streaming TraceQL metrics: series-DIFF messages as
+        sub-results (generator recent window, per-block backend jobs)
+        fold in, then the complete final series set
+        (`tempo.proto` StreamingQuerier/MetricsQueryRange; diff shape
+        mirrors the search stream). Each message carries only series whose
+        samples CHANGED since the last message — a high-cardinality
+        `by()` no longer buffers the whole set in one response."""
+        tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
+        d = _jload(request)
+        import numpy as np
+
+        from tempo_tpu.model import tempopb
+
+        last: dict = {}
+
+        def run_fn(emit):
+            def on_partial(series) -> None:
+                fresh = []
+                for s in series:
+                    sig = np.asarray(s.samples).tobytes()
+                    if last.get(s.labels) != sig:
+                        last[s.labels] = sig
+                        fresh.append(s)
+                if fresh:
+                    emit(fresh)
+
+            return self.app.frontend.query_range(
+                tenant, d["query"], start_s=float(d["start"]),
+                end_s=float(d["end"]), step_s=float(d.get("step", 60.0)),
+                on_partial=on_partial)
+
+        yield from self._stream_partials(
+            context, run_fn, tempopb.enc_query_range_response,
+            lambda res: tempopb.enc_query_range_response(res or []))
+
+    def streaming_search_tags(self, request: bytes, context):
+        """Server-streaming tag-name autocomplete: scope-diff messages as
+        the ingester pass and each contributing backend block merge in,
+        then the final scopes map (`StreamingQuerier/SearchTags`)."""
+        tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
+        last: dict = {}
+
+        def run_fn(emit):
+            def on_partial(scopes: dict) -> None:
+                fresh = {k: v for k, v in scopes.items()
+                         if last.get(k) != v}
+                if fresh:
+                    last.update(fresh)
+                    emit(fresh)
+
+            return self.app.frontend.tag_names(tenant,
+                                               on_partial=on_partial)
+
+        yield from self._stream_partials(
+            context, run_fn,
+            lambda batch: _jdump({"scopes": batch, "final": False}),
+            lambda res: _jdump({"scopes": res or {}, "final": True}))
 
     # -- Frontend worker-pull dispatch --------------------------------------
 
@@ -416,7 +487,9 @@ def build_grpc_server(app, address: str = "127.0.0.1:0",
     if app.frontend is not None:
         server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
             "tempopb.StreamingQuerier",
-            {"Search": sstream(svc.streaming_search)}),))
+            {"Search": sstream(svc.streaming_search),
+             "MetricsQueryRange": sstream(svc.streaming_metrics_query_range),
+             "SearchTags": sstream(svc.streaming_search_tags)}),))
         server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
             "tempopb.Frontend", {"Process": bidi(svc.frontend_process)}),))
     port = server.add_insecure_port(address)

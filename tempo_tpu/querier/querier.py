@@ -122,8 +122,18 @@ class Querier:
     # -- tags --------------------------------------------------------------
 
     def tag_names(self, tenant: str, scopes: Sequence[str] = ("span", "resource"),
-                  limit_bytes: int = 0) -> dict[str, list[str]]:
+                  limit_bytes: int = 0,
+                  on_partial=None) -> dict[str, list[str]]:
+        """`on_partial` (optional) receives the current merged snapshot
+        after the ingester pass and after each backend block that
+        contributed new names — the incremental feed the streaming
+        SearchTags endpoint diffs (`tempo.proto` StreamingQuerier)."""
         out: dict[str, set] = {}
+
+        def snap() -> dict[str, list[str]]:
+            return {k: sorted(v) for k, v in out.items()
+                    if k in scopes or not scopes}
+
         if self.ring is not None:
             for inst in self.ring.healthy_instances():
                 client = self.clients.get(inst.id)
@@ -131,6 +141,8 @@ class Querier:
                     continue
                 for scope, names in client.tag_names(tenant).items():
                     out.setdefault(scope, set()).update(names)
+            if on_partial is not None and out:
+                on_partial(snap())
         # backend blocks: key-list columns only, under a global byte budget
         from tempo_tpu.block.fetch import block_tag_names
         limit_bytes = limit_bytes or \
@@ -142,11 +154,15 @@ class Querier:
             per_block = block_tag_names(
                 self.db.backend_block(m),
                 byte_budget=(limit_bytes - used) if limit_bytes else 0)
+            grew = False
             for scope, names in per_block.items():
                 fresh = names - out.setdefault(scope, set())
                 used += sum(len(n) for n in fresh)
+                grew = grew or bool(fresh)
                 out[scope] |= fresh
-        return {k: sorted(v) for k, v in out.items() if k in scopes or not scopes}
+            if on_partial is not None and grew:
+                on_partial(snap())
+        return snap()
 
     def tag_values(self, tenant: str, name: str, limit: int = 1000) -> list[dict]:
         """Autocomplete values: ingester recent data + backend block scans,

@@ -1,0 +1,76 @@
+"""Mock memcached: a threaded TCP server speaking the text-protocol
+subset the client uses (get/set), verifying request shape strictly — the
+same signature-checking pattern as mock_s3/mock_kafka: a malformed client
+fails the test, not just the lookup."""
+
+from __future__ import annotations
+
+import socketserver
+import threading
+
+
+class MockMemcached:
+    def __init__(self) -> None:
+        self.store: dict[bytes, bytes] = {}
+        self.lock = threading.Lock()
+        self.gets = 0
+        self.sets = 0
+        self.bad_requests = 0
+
+    def start(self):
+        mock = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                while True:
+                    line = self.rfile.readline()
+                    if not line:
+                        return
+                    line = line.rstrip(b"\r\n")
+                    parts = line.split(b" ")
+                    if parts[0] == b"get" and len(parts) == 2:
+                        mock.gets += 1
+                        key = parts[1]
+                        if len(key) > 250 or any(
+                                c <= 32 or c > 126 for c in key):
+                            mock.bad_requests += 1
+                            self.wfile.write(b"CLIENT_ERROR bad key\r\n")
+                            continue
+                        with mock.lock:
+                            v = mock.store.get(key)
+                        if v is None:
+                            self.wfile.write(b"END\r\n")
+                        else:
+                            self.wfile.write(
+                                b"VALUE " + key + b" 0 " +
+                                str(len(v)).encode() + b"\r\n" + v +
+                                b"\r\nEND\r\n")
+                    elif parts[0] == b"set" and len(parts) == 5:
+                        mock.sets += 1
+                        key, _flags, _exp, n = (parts[1], parts[2],
+                                                parts[3], int(parts[4]))
+                        data = self.rfile.read(n)
+                        self.rfile.read(2)          # \r\n
+                        if len(key) > 250 or any(
+                                c <= 32 or c > 126 for c in key):
+                            mock.bad_requests += 1
+                            self.wfile.write(b"CLIENT_ERROR bad key\r\n")
+                            continue
+                        with mock.lock:
+                            mock.store[key] = data
+                        self.wfile.write(b"STORED\r\n")
+                    else:
+                        mock.bad_requests += 1
+                        self.wfile.write(b"ERROR\r\n")
+
+        srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), Handler)
+        srv.daemon_threads = True
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        return srv, srv.server_address[1]
+
+
+def start_mock_memcached():
+    m = MockMemcached()
+    srv, port = m.start()
+    return srv, port, m

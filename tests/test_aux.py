@@ -398,3 +398,94 @@ def test_runbook_covers_every_alert():
     for a in alerts:
         assert a in sections, f"runbook section missing for alert {a}"
         assert a.lower() in urls, f"runbook_url missing for alert {a}"
+
+
+# -- shared memcached cache tier (round 5, pkg/cache/memcached analog) -------
+
+def test_memcached_client_roundtrip_and_sanitization():
+    from tempo_tpu.backend.memcached import MemcachedCache, sanitize_key
+    from tests.mock_memcached import start_mock_memcached
+
+    srv, port, mock = start_mock_memcached()
+    try:
+        c = MemcachedCache(f"127.0.0.1:{port}")
+        assert c.get("missing") is None and c.misses == 1
+        c.put("k1", b"v1")
+        c.flush()
+        assert c.get("k1") == b"v1" and c.hits == 1
+        # long + unsafe keys sanitize to sha1 (mock REJECTS illegal keys,
+        # so a sloppy client would fail here, not silently miss)
+        long_key = "tenant/" + "x" * 300 + " with spaces"
+        c.put(long_key, b"v2")
+        c.flush()
+        assert c.get(long_key) == b"v2"
+        assert mock.bad_requests == 0
+        assert sanitize_key(long_key) != long_key.encode()
+        c.close()
+    finally:
+        srv.shutdown()
+
+
+def test_memcached_write_behind_drops_when_full():
+    from tempo_tpu.backend.memcached import MemcachedCache
+
+    # no server at this address: the writer can't drain, the queue fills,
+    # further puts DROP (counted) instead of blocking the read path
+    c = MemcachedCache("127.0.0.1:1", write_back_buffer=4)
+    for i in range(64):
+        c.put(f"k{i}", b"v")
+    assert c.dropped_writes > 0
+    assert c.get("k0") is None          # dead server degrades to miss
+    c.close()
+
+
+def test_memcached_cross_instance_shared_cache():
+    """Two TempoDB instances with SEPARATE processes' worth of cache state
+    share one memcached: blocks written+read through instance A leave
+    bloom/footer entries that instance B's reads hit (scale-out read perf
+    depends on this — in-process LRUs cannot give cross-replica hits)."""
+    import numpy as np
+    from tempo_tpu.backend.cache import CacheProvider, CachingReader
+    from tempo_tpu.backend.memcached import MemcachedCache
+    from tempo_tpu.backend.mem import MemBackend
+    from tempo_tpu.db.tempodb import TempoDB, TempoDBConfig
+    from tests.mock_memcached import start_mock_memcached
+
+    srv, port, mock = start_mock_memcached()
+    try:
+        be = MemBackend()
+        roles = ("bloom", "parquet-footer")
+
+        def mk_db():
+            shared = MemcachedCache(f"127.0.0.1:{port}")
+            prov = CacheProvider(caches={r: shared for r in roles})
+            return TempoDB(CachingReader(be, prov), be,
+                           TempoDBConfig(device_plane=False)), shared
+
+        db_a, ca = mk_db()
+        db_b, cb = mk_db()
+        rng = np.random.default_rng(3)
+        tid0 = None
+        traces = []
+        for i in range(50):
+            tid = rng.bytes(16)
+            tid0 = tid0 or tid
+            start = 1_700_000_000_000_000_000 + i * 10**9
+            traces.append((tid, [{
+                "trace_id": tid, "span_id": rng.bytes(8), "name": "op",
+                "service": "svc", "kind": 2, "status_code": 0,
+                "start_unix_nano": start,
+                "end_unix_nano": start + 10**6}]))
+        traces.sort(key=lambda t: t[0])   # blocks are trace-id ordered
+        db_a.write_block("t", traces, replication_factor=1)
+        db_a.poll_now()
+        db_b.poll_now()
+        assert db_a.find_trace_by_id("t", tid0)   # A populates the tier
+        ca.flush()
+        before = cb.hits
+        assert db_b.find_trace_by_id("t", tid0)   # B hits A's entries
+        assert cb.hits > before, (cb.hits, cb.misses)
+        assert mock.sets > 0 and mock.gets > 0
+        db_a.shutdown(); db_b.shutdown()
+    finally:
+        srv.shutdown()

@@ -310,3 +310,29 @@ def test_cli_round4_commands(block_dir, capsys, tmp_path):
     db2 = TempoDB(be, be)
     db2.poll_now()
     assert len(db2.blocklist.metas("t1")) == n_before   # read-only
+
+
+def test_cli_cachesummary_and_trace_summary(block_dir, capsys):
+    """Round-5 additions: `list cachesummary` (bloom bytes by age x level,
+    cmd-list-cachesummary.go) and `query trace-summary`
+    (cmd-query-trace-summary.go)."""
+    path, meta = block_dir
+    assert cli_main(["--path", path, "list", "cachesummary", "t1"]) == 0
+    out = capsys.readouterr().out
+    assert "compaction level" in out and "total bloom bytes:" in out
+    # bloom bytes are real object sizes, not zero
+    total = int(out.rsplit("total bloom bytes:", 1)[1].strip())
+    assert total > 0
+
+    tid = (bytes([3]) * 16).hex()
+    assert cli_main(["--path", path, "query", "trace-summary",
+                     "t1", tid]) == 0
+    out = capsys.readouterr().out
+    assert "number of blocks: 1" in out
+    assert "span count: 1" in out
+    assert "root service name: svc" in out
+    assert "op-1" in out                  # root span named
+    # unknown trace: rc 1, friendly message
+    assert cli_main(["--path", path, "query", "trace-summary",
+                     "t1", "ff" * 16]) == 1
+    assert "trace not found" in capsys.readouterr().out

@@ -407,3 +407,71 @@ def test_opencensus_grpc_export(grpc_cluster):
     assert by_name["oc-op"]["status_code"] == 2   # nonzero code → ERROR
     assert by_name["oc-op"]["attrs"]["oc.key"] == "v1"
     assert by_name["oc-op2"]["service"] == "oc-svc"   # node persisted
+
+
+def test_grpc_streaming_metrics_query_range(grpc_cluster):
+    """StreamingQuerier/MetricsQueryRange delivers series-DIFF messages
+    incrementally on a multi-block query (round-4 weak #5: the unary seam
+    buffered the whole series set in one response)."""
+    import numpy as np
+    from tempo_tpu.grpcplane.client import streaming_metrics_query_range
+
+    apps, ports = grpc_cluster
+    qdb = apps["query"].db
+    rng = np.random.default_rng(9)
+    now_s = time.time()
+    base = now_s - 7200          # squarely in the BACKEND window
+    for b in range(3):           # three blocks → three fold steps
+        traces = []
+        for i in range(60):
+            tid = rng.bytes(16)
+            start = int((base + b * 300 + i) * 1e9)
+            traces.append((tid, [{
+                "trace_id": tid, "span_id": rng.bytes(8),
+                "name": f"op-{b}", "service": "svc",
+                "kind": 2, "status_code": 0,
+                "start_unix_nano": start,
+                "end_unix_nano": start + 10**7}]))
+        traces.sort(key=lambda t: t[0])
+        qdb.write_block("single-tenant", traces, replication_factor=1)
+    qdb.poll_now()
+
+    msgs = list(streaming_metrics_query_range(
+        f"127.0.0.1:{ports['query']}", "single-tenant",
+        "{ } | rate() by (name)", start_s=base - 60, end_s=now_s - 3600,
+        step_s=300))
+    # incremental: more than one message, and the pre-final messages do
+    # not each carry the full final set (true diffs)
+    assert len(msgs) >= 2, len(msgs)
+    final = {tuple(s.labels): np.asarray(s.samples) for s in msgs[-1]}
+    assert len(final) == 3       # op-0/1/2 series
+    assert any(len(m) < len(final) for m in msgs[:-1]) or len(msgs) > 2
+    # diffs compose to the final answer: last-write-wins per series
+    acc: dict = {}
+    for m in msgs[:-1]:
+        for s in m:
+            acc[tuple(s.labels)] = np.asarray(s.samples)
+    assert set(acc) == set(final)
+    for k in final:
+        np.testing.assert_allclose(acc[k], final[k])
+
+
+def test_grpc_streaming_search_tags(grpc_cluster):
+    """StreamingQuerier/SearchTags streams scope diffs then the final
+    scopes map."""
+    from tempo_tpu.grpcplane.client import streaming_search_tags
+
+    apps, ports = grpc_cluster
+    t0 = int((time.time() - 5) * 1e9)
+    body = _otlp_json_to_proto(_otlp("aa" * 16, t0, name="tag-op"))
+    with grpc.insecure_channel(f"127.0.0.1:{ports['dist']}") as ch:
+        ch.unary_unary(
+            "/opentelemetry.proto.collector.trace.v1.TraceService/Export"
+        )(body, timeout=10)
+    msgs = list(streaming_search_tags(
+        f"127.0.0.1:{ports['query']}", "single-tenant"))
+    assert msgs[-1][1] is True
+    scopes = msgs[-1][0]
+    assert "http.status_code" in scopes.get("span", [])
+    # at least one pre-final diff arrived (the ingester pass)
+    assert len(msgs) >= 2 and msgs[0][1] is False

@@ -72,3 +72,140 @@ def test_sharded_query_range_histogram_plane():
     ref = np.zeros((n_series, n_steps, n_buckets), np.float32)
     np.add.at(ref, (slots, steps, b), 1.0)
     np.testing.assert_allclose(out, ref)
+
+
+# -- PRODUCT paths under the mesh (round-4 weak #3 closure) ------------------
+
+def _product_block(n=10_000):
+    """A non-trivial block (group-by labels, boundary durations, partial
+    attrs) through the real writer."""
+    from tempo_tpu.backend.mem import MemBackend
+    from tempo_tpu.db.tempodb import TempoDB, TempoDBConfig
+
+    rng = np.random.default_rng(17)
+    T0 = 1_700_000_000
+    be = MemBackend()
+    traces = []
+    for i in range(n):
+        tid = rng.bytes(16)
+        start = int((T0 + i * 0.05) * 1e9)
+        traces.append((tid, [{
+            "trace_id": tid, "span_id": rng.bytes(8),
+            "name": f"op-{i % 7}", "service": f"svc-{i % 4}",
+            "kind": int(i % 6), "status_code": int(i % 3),
+            "start_unix_nano": start,
+            "end_unix_nano": start + int(rng.lognormal(16, 1.2)),
+            "attrs": {"http.status_code": 200 + (i % 300)},
+        }]))
+    return be, traces, T0
+
+
+def test_sharded_plane_query_range_product_parity():
+    """TempoDB.query_range with plane_mesh: the SAME fused product kernels
+    run SPMD over 8 devices (span columns sharded over 'data', XLA
+    inserts the grid reduce). Series must match BOTH the host engine and
+    the single-device plane on a >=10k-span block with group-by, quantile
+    histograms, and predicate pushdown."""
+    from tempo_tpu.db.tempodb import TempoDB, TempoDBConfig
+    from tempo_tpu.parallel import make_mesh
+    from tempo_tpu.traceql.engine_metrics import QueryRangeRequest
+
+    be, traces, T0 = _product_block()
+    mesh = make_mesh(8, series_shards=1)
+    dev1 = TempoDB(be, be, TempoDBConfig(device_plane=True))
+    devm = TempoDB(be, be, TempoDBConfig(device_plane=True,
+                                         plane_mesh=mesh))
+    host = TempoDB(be, be, TempoDBConfig(device_plane=False))
+    dev1.write_block("t", traces, replication_factor=1)
+    for db in (dev1, devm, host):
+        db.poll_now()
+
+    def smap(series):
+        return {tuple(sorted((str(k), str(v)) for k, v in s.labels)):
+                np.nan_to_num(np.asarray(s.samples, np.float64))
+                for s in series}
+
+    for q in ('{ } | rate() by (resource.service.name)',
+              '{ } | count_over_time() by (name)',
+              '{ duration > 50ms } | rate() by (name)',
+              '{ } | quantile_over_time(duration, .99)'
+              ' by (resource.service.name)',
+              '{ span.http.status_code >= 400 } | rate() by (name)',
+              '{ } | avg_over_time(duration) by (resource.service.name)',
+              '{ } | rate() by (resource.service.name, name)'):
+        req = QueryRangeRequest(query=q, start_ns=int(T0 * 1e9),
+                                end_ns=int((T0 + 600) * 1e9),
+                                step_ns=int(60e9))
+        am = smap(devm.query_range("t", req))
+        a1 = smap(dev1.query_range("t", req))
+        b = smap(host.query_range("t", req))
+        assert set(am) == set(b) == set(a1), q
+        for k in b:
+            np.testing.assert_allclose(am[k], b[k], rtol=1e-5, atol=1e-4,
+                                       err_msg=f"mesh-vs-host {q} {k}")
+            np.testing.assert_allclose(am[k], a1[k], rtol=1e-6, atol=1e-6,
+                                       err_msg=f"mesh-vs-1dev {q} {k}")
+    # the sharded plane really served fused (not a silent host fallback)
+    assert devm.plane_stats["fused_metric_blocks"] >= 7
+    assert not any(k.startswith("fallback_") for k in devm.plane_stats)
+    # search rides the sharded mask kernel too
+    s_m = sorted(m.trace_id for m in devm.search(
+        "t", '{ duration > 50ms && span.http.status_code >= 400 }',
+        limit=5000))
+    s_h = sorted(m.trace_id for m in host.search(
+        "t", '{ duration > 50ms && span.http.status_code >= 400 }',
+        limit=5000))
+    assert s_m == s_h and s_m
+
+
+def test_sharded_registry_product_push_collect_parity():
+    """A REAL ManagedRegistry + SpanMetricsProcessor pushed under the mesh
+    (state sharded over 'series', batch over 'data') must collect the
+    same samples as the single-device processor — same series table, same
+    interner, same exemplar plumbing."""
+    from tempo_tpu.generator.processors.spanmetrics import (
+        SpanMetricsConfig, SpanMetricsProcessor)
+    from tempo_tpu.model.span_batch import SpanBatchBuilder
+    from tempo_tpu.parallel import make_mesh
+    from tempo_tpu.parallel.product import (shard_processor_state,
+                                            sharded_push_batch)
+    from tempo_tpu.registry import ManagedRegistry, RegistryOverrides
+
+    mesh = make_mesh(8, series_shards=2)
+    rng = np.random.default_rng(5)
+
+    def mk():
+        reg = ManagedRegistry("t", RegistryOverrides(max_active_series=512),
+                              now=lambda: 1000.0)
+        proc = SpanMetricsProcessor(reg, SpanMetricsConfig())
+        return reg, proc
+
+    reg_m, proc_m = mk()
+    reg_1, proc_1 = mk()
+    shard_processor_state(proc_m, mesh)
+
+    def batch(reg, seed):
+        b = SpanBatchBuilder(reg.interner)
+        r = np.random.default_rng(seed)
+        for i in range(3000):
+            b.append(trace_id=r.bytes(16), span_id=r.bytes(8),
+                     name=f"op-{i % 9}", service=f"svc-{i % 3}",
+                     kind=int(i % 6), status_code=int(i % 3),
+                     start_unix_nano=10**18,
+                     end_unix_nano=10**18 + int(r.lognormal(16, 1.0)))
+        return b.build()
+
+    for seed in (1, 2):
+        sharded_push_batch(proc_m, mesh, batch(reg_m, seed))
+        proc_1.push_batch(batch(reg_1, seed))
+    sm = sorted((s.name, s.labels, round(s.value, 4))
+                for s in reg_m.collect(5000))
+    s1 = sorted((s.name, s.labels, round(s.value, 4))
+                for s in reg_1.collect(5000))
+    assert sm == s1 and len(sm) > 100
+    # quantile sketch plane agrees too
+    qm = proc_m.quantile(0.99)
+    q1 = proc_1.quantile(0.99)
+    assert qm.keys() == q1.keys() and qm
+    for k in qm:
+        np.testing.assert_allclose(qm[k], q1[k], rtol=1e-5)

@@ -1,0 +1,171 @@
+"""Randomized device-vs-host parity gate for the DEFAULT read path.
+
+Round-4 weak #6: the fused device plane is the default product path, but
+its parity evidence was a fixed query list. This property test draws
+random TraceQL queries from the AST grammar (filters over every column
+family the plane adopts — int/float/string/missing attrs, intrinsics,
+boundary literals, nil/boolean forms, OR-fallback shapes — times every
+metrics kind and group-by arity) against randomized blocks, asserting the
+device plane and the host engine agree on BOTH search results and metric
+grids. The seed is printed on failure and can be pinned via
+TEMPO_FUZZ_SEED; case count via TEMPO_FUZZ_CASES (default sized to keep
+the whole module under a minute in CI).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+from tempo_tpu.backend.mem import MemBackend
+from tempo_tpu.db.tempodb import TempoDB, TempoDBConfig
+from tempo_tpu.traceql.engine_metrics import QueryRangeRequest
+
+T0 = 1_700_000_000
+SEED = int(os.environ.get("TEMPO_FUZZ_SEED",
+                          random.SystemRandom().randrange(1 << 30)))
+N_QUERIES = int(os.environ.get("TEMPO_FUZZ_CASES", 40))
+
+# -- random query grammar ----------------------------------------------------
+
+_DUR_LITS = ["1ns", "50ms", "123ms", "16777216ns", "16777217ns", "1s", "2s"]
+_NUM_OPS = ["=", "!=", ">", ">=", "<", "<="]
+_STR_OPS = ["=", "!=", "=~", "!~"]
+
+
+def _pred(rng: random.Random) -> str:
+    kind = rng.choice(["dur", "name", "svc", "int_attr", "float_attr",
+                       "str_attr", "missing", "kindp", "status", "nil",
+                       "bool_lit"])
+    if kind == "dur":
+        return f"duration {rng.choice(_NUM_OPS)} {rng.choice(_DUR_LITS)}"
+    if kind == "name":
+        return (f'name {rng.choice(_STR_OPS)} '
+                f'"op-{rng.randrange(6)}{rng.choice(["", ".*"])}"')
+    if kind == "svc":
+        return (f'resource.service.name {rng.choice(["=", "!="])} '
+                f'"svc-{rng.randrange(4)}"')
+    if kind == "int_attr":
+        lit = rng.choice([200, 204, 350, 499, 500, 0, -1])
+        return f"span.http.status_code {rng.choice(_NUM_OPS)} {lit}"
+    if kind == "float_attr":
+        lit = rng.choice([0.5, 1.5, -2.25, 0.0, 3.0, 2, 0.1])
+        return f"span.ratio {rng.choice(_NUM_OPS)} {lit}"
+    if kind == "str_attr":
+        return f'span.region {rng.choice(_STR_OPS)} "r{rng.randrange(3)}"'
+    if kind == "missing":
+        return f"span.nothere {rng.choice(_NUM_OPS)} 5"
+    if kind == "kindp":
+        return f'kind = {rng.choice(["server", "client", "internal"])}'
+    if kind == "status":
+        return f'status {rng.choice(["=", "!="])} error'
+    if kind == "nil":
+        attr = rng.choice(["span.ratio", "span.region", "span.nothere"])
+        return f'{attr} {rng.choice(["=", "!="])} nil'
+    return rng.choice(["true", "false"])
+
+
+def _filter(rng: random.Random) -> str:
+    n = rng.choice([0, 1, 1, 2, 2, 3])
+    if n == 0:
+        return "{ }"
+    op = " && " if rng.random() < 0.8 else " || "
+    return "{ " + op.join(_pred(rng) for _ in range(n)) + " }"
+
+
+def _metrics(rng: random.Random) -> str:
+    by_keys = rng.sample(["resource.service.name", "name", "span.region",
+                          "kind"], k=rng.choice([0, 1, 1, 2]))
+    by = f" by ({', '.join(by_keys)})" if by_keys else ""
+    agg = rng.choice(["rate()", "count_over_time()",
+                      "min_over_time(duration)", "max_over_time(duration)",
+                      "sum_over_time(duration)", "avg_over_time(duration)",
+                      "sum_over_time(span.http.status_code)",
+                      "avg_over_time(span.ratio)",
+                      "quantile_over_time(duration, .5, .99)",
+                      "histogram_over_time(duration)"])
+    return f"{_filter(rng)} | {agg}{by}"
+
+
+# -- random block ------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fuzz_dbs():
+    rng = np.random.default_rng(SEED)
+    be = MemBackend()
+    dev = TempoDB(be, be, TempoDBConfig(device_plane=True))
+    host = TempoDB(be, be, TempoDBConfig(device_plane=False))
+    n_blocks = 2
+    for b in range(n_blocks):
+        traces = []
+        for i in range(1500):
+            tid = rng.bytes(16)
+            start = int((T0 + b * 400 + float(rng.random()) * 390) * 1e9)
+            attrs = {}
+            if rng.random() < 0.8:
+                attrs["http.status_code"] = int(rng.integers(200, 501))
+            if rng.random() < 0.6:
+                attrs["ratio"] = float(rng.choice(
+                    [0.5, 1.5, -2.25, 0.0, 3.0, 0.1, 2.0]))
+            if rng.random() < 0.7:
+                attrs["region"] = f"r{int(rng.integers(0, 3))}"
+            traces.append((tid, [{
+                "trace_id": tid, "span_id": rng.bytes(8),
+                "name": f"op-{int(rng.integers(0, 6))}",
+                "service": f"svc-{int(rng.integers(0, 4))}",
+                "kind": int(rng.integers(0, 6)),
+                "status_code": int(rng.integers(0, 3)),
+                "start_unix_nano": start,
+                "end_unix_nano": start + int(rng.choice(
+                    [1, 50_000_000, 123_000_000, 16_777_216, 16_777_217,
+                     int(rng.lognormal(16, 1.5))])),
+                "attrs": attrs}]))
+        traces.sort(key=lambda t: t[0])
+        dev.write_block("t", traces, replication_factor=1)
+    dev.poll_now()
+    host.poll_now()
+    return dev, host
+
+
+def _smap(series) -> dict:
+    return {tuple(sorted((str(k), str(v)) for k, v in s.labels)):
+            np.nan_to_num(np.asarray(s.samples, np.float64))
+            for s in series}
+
+
+def test_fuzz_query_range_parity(fuzz_dbs):
+    dev, host = fuzz_dbs
+    rng = random.Random(SEED)
+    for case in range(N_QUERIES):
+        q = _metrics(rng)
+        req = QueryRangeRequest(query=q, start_ns=int(T0 * 1e9),
+                                end_ns=int((T0 + 900) * 1e9),
+                                step_ns=int(rng.choice([30, 60, 300]) * 1e9))
+        ctx = f"seed={SEED} case={case} query={q!r}"
+        try:
+            a = _smap(dev.query_range("t", req))
+            b = _smap(host.query_range("t", req))
+        except Exception as e:
+            raise AssertionError(f"{ctx}: {e}") from e
+        assert set(a) == set(b), f"{ctx}: series sets differ " \
+            f"(only-dev={set(a) - set(b)}, only-host={set(b) - set(a)})"
+        for k in b:
+            np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-4,
+                                       err_msg=f"{ctx} series={k}")
+
+
+def test_fuzz_search_parity(fuzz_dbs):
+    dev, host = fuzz_dbs
+    rng = random.Random(SEED + 1)
+    for case in range(N_QUERIES):
+        q = _filter(rng)
+        ctx = f"seed={SEED} case={case} query={q!r}"
+        try:
+            a = sorted(m.trace_id for m in dev.search("t", q, limit=5000))
+            b = sorted(m.trace_id for m in host.search("t", q, limit=5000))
+        except Exception as e:
+            raise AssertionError(f"{ctx}: {e}") from e
+        assert a == b, f"{ctx}: {len(a)} dev vs {len(b)} host trace ids"
