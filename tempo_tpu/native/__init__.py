@@ -749,8 +749,12 @@ def spanmetrics_resolve(table: "NativeRowTable", spans: np.ndarray,
     kind_lut = np.ascontiguousarray(kind_lut, np.int32)
     status_lut = np.ascontiguousarray(status_lut, np.int32)
     slots = np.full(cap, -1, np.int32)
-    dur = np.zeros(cap, np.float32)
-    sizes = np.zeros(cap, np.float32)
+    # dur/sizes are rows 1/2 of ONE packed [3, cap] f32 buffer: the fast
+    # paths upload slots+dur+sizes as a single H2D transfer (row 0 takes
+    # the f32 slot copy after miss resolution)
+    packed = np.zeros((3, cap), np.float32)
+    dur = packed[1]
+    sizes = packed[2]
     rows = np.empty((max(n, 1), int(dims.shape[0])), np.int32)
     valid = np.zeros(cap, np.uint8)
     miss = np.empty(max(n, 1), np.int64)
@@ -770,7 +774,7 @@ def spanmetrics_resolve(table: "NativeRowTable", spans: np.ndarray,
         valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         miss.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(miss),
         counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
-    return (slots, dur, sizes, rows, valid, miss[:nm],
+    return (slots, packed, rows, valid, miss[:nm],
             int(counts[0]), int(counts[1]))
 
 
@@ -796,8 +800,12 @@ def spanmetrics_from_recs(table: "NativeRowTable", interner_h, data: bytes,
     kind_lut = np.ascontiguousarray(kind_lut, np.int32)
     status_lut = np.ascontiguousarray(status_lut, np.int32)
     slots = np.full(cap, -1, np.int32)
-    dur = np.zeros(cap, np.float32)
-    sizes = np.zeros(cap, np.float32)
+    # dur/sizes are rows 1/2 of ONE packed [3, cap] f32 buffer: the fast
+    # paths upload slots+dur+sizes as a single H2D transfer (row 0 takes
+    # the f32 slot copy after miss resolution)
+    packed = np.zeros((3, cap), np.float32)
+    dur = packed[1]
+    sizes = packed[2]
     rows = np.empty((max(n, 1), int(dims.shape[0])), np.int32)
     valid = np.zeros(cap, np.uint8)
     miss = np.empty(max(n, 1), np.int64)
@@ -821,7 +829,7 @@ def spanmetrics_from_recs(table: "NativeRowTable", interner_h, data: bytes,
         counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
     if nm < 0:
         return None      # -1 malformed / -2 fixup: full path re-validates
-    return (slots, dur, sizes, rows, valid, miss[:nm],
+    return (slots, packed, rows, valid, miss[:nm],
             int(counts[0]), int(counts[1]))
 
 
