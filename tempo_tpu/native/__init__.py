@@ -734,10 +734,12 @@ def spanmetrics_resolve(table: "NativeRowTable", spans: np.ndarray,
                         now: float, last_seen: "np.ndarray | None",
                         cap: int):
     """Fused staged-records → device-ready arrays (see native.cpp
-    `spanmetrics_resolve`). Returns (slots, dur_s, sizes, rows, valid,
-    miss_idx, n_valid, n_filtered) with the first five sized/padded to
-    `cap` (slots tail -1 → masked out of the scatter); rows is [n, L] for
-    the miss-resolution pass. None when the library is unavailable."""
+    `spanmetrics_resolve`). Returns (slots, packed, rows, valid, miss_idx,
+    n_valid, n_filtered): `packed` is the [3, cap] f32 single-H2D buffer
+    whose rows 1/2 hold dur_s/sizes (row 0 is reserved for the caller's
+    f32 slot copy); slots/valid are cap-padded (slot tail -1 → masked out
+    of the scatter); rows is [n, L] for the miss-resolution pass. None
+    when the library is unavailable."""
     lib = _load()
     if lib is None:
         return None

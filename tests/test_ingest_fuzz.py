@@ -1,0 +1,156 @@
+"""Randomized OTLP payload parity for the fused ingest fast paths.
+
+The round-5 C++ kernels (`spanmetrics_resolve`, `spanmetrics_from_recs`)
+bypass SpanBatch staging entirely; their contract is BIT-IDENTICAL series
+state vs the full staging path for any valid payload, and a clean bail
+(None → full path) for the shapes they don't own (non-string
+service.name). This fuzzer generates adversarial payloads — empty/unicode
+span names, short trace ids, absent resources, absent service.name,
+numeric service.name (the fixup case), duplicate attr keys, zero/reversed
+timestamps, many resources — and asserts the parity triangle:
+
+    full staging == staged fast path == tee from-recs path
+
+plus malformed-bytes rejection. Seed pinnable via TEMPO_FUZZ_SEED.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+SEED = int(os.environ.get("TEMPO_FUZZ_SEED",
+                          random.SystemRandom().randrange(1 << 30)))
+N_CASES = int(os.environ.get("TEMPO_FUZZ_CASES", 25))
+
+
+def _payload(rng: random.Random) -> bytes:
+    """One random ExportTraceServiceRequest."""
+    from tempo_tpu.model.proto_wire import (enc_field_bytes, enc_field_msg,
+                                            enc_field_str, enc_field_varint)
+
+    def attr(k: str, v) -> bytes:
+        if isinstance(v, bool):
+            av = enc_field_varint(2, int(v))
+        elif isinstance(v, int):
+            av = enc_field_varint(3, v)
+        elif isinstance(v, float):
+            from tempo_tpu.model.proto_wire import enc_field_double
+            av = enc_field_double(4, v)
+        else:
+            av = enc_field_str(1, str(v))
+        return enc_field_str(1, k) + enc_field_msg(2, av)
+
+    out = []
+    for _r in range(rng.randint(1, 5)):
+        res_attrs = b""
+        svc_kind = rng.choice(["str", "none", "absent_res", "dup",
+                               "numeric"])
+        if svc_kind == "str":
+            res_attrs += enc_field_msg(1, attr(
+                "service.name", f"svc-{rng.randrange(3)}"))
+        elif svc_kind == "numeric":
+            # non-string service.name: the fast path must BAIL to the
+            # Python stringify fixup (the fallback branch below)
+            res_attrs += enc_field_msg(1, attr(
+                "service.name", rng.choice([7, 2.5, True])))
+        elif svc_kind == "dup":
+            # duplicate service.name: LAST occurrence wins
+            res_attrs += enc_field_msg(1, attr("service.name", "loser"))
+            res_attrs += enc_field_msg(1, attr(
+                "service.name", f"svc-{rng.randrange(3)}"))
+        if rng.random() < 0.5:
+            res_attrs += enc_field_msg(1, attr(
+                "deployment.env", rng.choice(["prod", "dev", 7, 2.5, True])))
+        spans = []
+        for _s in range(rng.randint(0, 40)):
+            t0 = rng.randrange(10**18, 10**18 + 10**12)
+            t1 = t0 + rng.choice([0, 1, 10**6, 10**9, -5])   # incl. reversed
+            name = rng.choice(["", "op", "op-1", "längere-ops-µ", "x" * 300])
+            b = (enc_field_bytes(1, rng.randbytes(rng.choice([16, 16, 8, 1])))
+                 + enc_field_bytes(2, rng.randbytes(8))
+                 + enc_field_str(5, name)
+                 + enc_field_varint(6, rng.randrange(0, 8))   # incl. OOB kind
+                 + enc_field_varint(7, t0)
+                 + enc_field_varint(8, t1)
+                 + enc_field_msg(15, enc_field_varint(3, rng.randrange(0, 4))))
+            for _a in range(rng.randint(0, 3)):
+                b += enc_field_msg(9, attr(
+                    rng.choice(["k1", "k2", "http.url"]),
+                    rng.choice([1, "v", 2.5, True, -7])))
+            spans.append(enc_field_msg(2, b))
+        rs = b""
+        if svc_kind != "absent_res":
+            rs += enc_field_msg(1, res_attrs)
+        rs += enc_field_msg(2, b"".join(spans))
+        out.append(enc_field_msg(1, rs))
+    return b"".join(out)
+
+
+def _mk_gen():
+    from tempo_tpu.generator.generator import Generator
+    from tempo_tpu.generator.instance import GeneratorConfig
+    from tempo_tpu.overrides import Overrides
+
+    cfg = GeneratorConfig(processors=("span-metrics",))
+    cfg.registry.disable_collection = True
+    cfg.ingestion_time_range_slack_s = 0     # keep every timestamp shape
+    return Generator(cfg, overrides=Overrides())
+
+
+def _samples(gen):
+    # EXACT values: the fast paths' contract is bit-identical state
+    return sorted((s.name, s.labels, s.value)
+                  for s in gen.instance("t").registry.collect(10_000))
+
+
+def test_fuzz_fast_paths_match_full_staging():
+    from tempo_tpu import native
+
+    rng = random.Random(SEED)
+    fast, slow, tee = _mk_gen(), _mk_gen(), _mk_gen()
+    slow.instance("t").push_otlp_staged = lambda *a, **k: None
+    n_fast = n_fallback = 0
+    for case in range(N_CASES):
+        payload = _payload(rng)
+        ctx = f"seed={SEED} case={case}"
+        inst = fast.instance("t")
+        took_fast = inst.push_otlp_staged(payload) is not None
+        if not took_fast:
+            fast.push_otlp("t", payload)     # numeric-service fixup path
+            n_fallback += 1
+        else:
+            n_fast += 1
+        slow.push_otlp("t", payload)
+        # tee route: scan records + original payload
+        recs = native.otlp_scan(payload)
+        if recs is None:
+            pytest.skip("native layer unavailable")
+        if tee.push_otlp_recs("t", payload, recs) is None:
+            tee.push_otlp("t", payload)
+        assert _samples(fast) == _samples(slow), f"{ctx}: fast != full"
+        assert _samples(tee) == _samples(slow), f"{ctx}: tee != full"
+    # the generator really exercised BOTH routes across the fuzz corpus
+    assert n_fast > 0, f"seed={SEED}: fast path never engaged"
+    assert n_fallback > 0, \
+        f"seed={SEED}: the non-string service.name fixup never exercised"
+
+
+def test_fuzz_malformed_payloads_rejected():
+    rng = random.Random(SEED + 7)
+    gen = _mk_gen()
+    base = _payload(rng)
+    for case in range(20):
+        bad = bytearray(base[:rng.randrange(1, len(base))])
+        if bad and rng.random() < 0.7:
+            bad[rng.randrange(len(bad))] ^= 0xFF
+        try:
+            gen.push_otlp("t", bytes(bad))
+        except ValueError:
+            pass                      # MalformedPayload — the right answer
+        except Exception as e:        # anything else is a crash bug
+            raise AssertionError(
+                f"seed={SEED} case={case}: {type(e).__name__}: {e}") from e
