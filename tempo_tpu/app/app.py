@@ -130,6 +130,7 @@ class App:
         self.grpc_server = None
         self.grpc_port: int = 0
         self.frontend_worker = None
+        self.jaeger_agent = None
         self.usage_reporter = None
         self.bus = None
         self.blockbuilder = None
@@ -424,6 +425,16 @@ class App:
                 worker_id=f"querier-{id(self) & 0xffff:x}",
                 parallelism=self.cfg.querier_worker.parallelism)
             self.frontend_worker.start()
+        if self.distributor is not None and \
+                self.cfg.distributor.jaeger_agent_port:
+            from tempo_tpu.distributor.receiver_agent import (
+                JaegerAgentConfig,
+                JaegerAgentReceiver,
+            )
+            self.jaeger_agent = JaegerAgentReceiver(
+                self.distributor, JaegerAgentConfig(
+                    port=self.cfg.distributor.jaeger_agent_port))
+            self.jaeger_agent.start()
         if self.ingester:
             self.ingester.start()
         if self.generator:
@@ -505,6 +516,8 @@ class App:
             # App in this process may have installed its own since
             if tracing.tracer() is mine:
                 tracing.install(tracing.NoopTracer())
+        if getattr(self, "jaeger_agent", None) is not None:
+            self.jaeger_agent.stop()
         if self.frontend_worker:
             self.frontend_worker.shutdown()
         if self.grpc_server:

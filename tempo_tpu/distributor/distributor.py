@@ -49,6 +49,10 @@ class DistributorConfig:
     # per-tenant forwarder configs: {tenant: [{name, endpoint, filter}, ...]}
     # (`modules/distributor/forwarder` per-tenant tee)
     forwarders: dict = dataclasses.field(default_factory=dict)
+    # jaeger agent UDP receiver (thrift-compact emitBatch, port 6831 —
+    # shim.go:165-171 jaeger protocols; deprecated upstream but still
+    # deployed). 0 = disabled.
+    jaeger_agent_port: int = 0
 
 
 class RateLimited(RuntimeError):

@@ -197,6 +197,41 @@ def _intrinsics_from_tags(attrs: dict) -> tuple[int, int]:
     return kind, status_code
 
 
+
+def _span_dict(tid_hi: int, tid_lo: int, sid: int, psid: int, name: str,
+               start_us: int, dur_us: int, attrs: dict) -> dict:
+    """Shared span-dict epilogue for the thrift decoders (binary +
+    compact agent — the api_v2 proto path carries ids as bytes and times
+    in ns, so it shares only `_intrinsics_from_tags`): one place owns the
+    id packing and the µs→ns mapping, so the wire forms cannot diverge."""
+    kind, status_code = _intrinsics_from_tags(attrs)
+    u64 = lambda v: v & ((1 << 64) - 1)
+    start_ns = start_us * 1000
+    return {
+        "trace_id": struct.pack(">QQ", u64(tid_hi), u64(tid_lo)),
+        "span_id": struct.pack(">Q", u64(sid)),
+        "parent_span_id": struct.pack(">Q", u64(psid)) if psid else b"",
+        "name": name,
+        "service": "",
+        "kind": kind,
+        "status_code": status_code,
+        "start_unix_nano": start_ns,
+        "end_unix_nano": start_ns + dur_us * 1000,
+        "attrs": attrs,
+        "res_attrs": None,
+    }
+
+
+def _patch_batch(out: list, service: str, res_attrs: dict) -> list:
+    """Apply the Batch's Process (service + resource tags) to its spans."""
+    res_attrs = dict(res_attrs)
+    res_attrs.setdefault("service.name", service)
+    for s in out:
+        s["service"] = service
+        s["res_attrs"] = res_attrs
+    return out
+
+
 def _read_span(r: _R) -> dict:
     """One jaeger.thrift Span → span dict (service/res_attrs patched in by
     the caller once the Process struct is known)."""
@@ -224,22 +259,8 @@ def _read_span(r: _R) -> dict:
         else:
             r.skip(ft)
 
-    kind, status_code = _intrinsics_from_tags(attrs)
-    u64 = lambda v: v & ((1 << 64) - 1)
-    start_ns = start_us * 1000
-    return {
-        "trace_id": struct.pack(">QQ", u64(tid_hi), u64(tid_lo)),
-        "span_id": struct.pack(">Q", u64(sid)),
-        "parent_span_id": struct.pack(">Q", u64(psid)) if psid else b"",
-        "name": name,
-        "service": "",
-        "kind": kind,
-        "status_code": status_code,
-        "start_unix_nano": start_ns,
-        "end_unix_nano": start_ns + dur_us * 1000,
-        "attrs": attrs,
-        "res_attrs": None,
-    }
+    return _span_dict(tid_hi, tid_lo, sid, psid, name, start_us, dur_us,
+                      attrs)
 
 
 def spans_from_jaeger_thrift(data: bytes) -> list[dict]:
@@ -272,12 +293,7 @@ def spans_from_jaeger_thrift(data: bytes) -> list[dict]:
                     out.append(_read_span(r))
             else:
                 r.skip(ft)
-        res_attrs = dict(res_attrs)
-        res_attrs.setdefault("service.name", service)
-        for s in out:
-            s["service"] = service
-            s["res_attrs"] = res_attrs
-        return out
+        return _patch_batch(out, service, res_attrs)
     except (struct.error, IndexError) as e:
         raise ValueError(f"malformed jaeger thrift payload: {e}") from None
 
@@ -418,4 +434,244 @@ def spans_from_jaeger_proto(data: bytes, wrapped: bool = True) -> list[dict]:
         raise ValueError(f"malformed jaeger proto payload: {e}") from None
 
 
-__all__ = ["spans_from_jaeger_thrift", "spans_from_jaeger_proto"]
+__all__ = ["spans_from_jaeger_thrift", "spans_from_jaeger_proto",
+           "spans_from_jaeger_agent"]
+
+
+# -- jaeger agent UDP (TCompactProtocol Agent.emitBatch) ---------------------
+#
+# The deprecated-but-still-deployed jaeger agent path: clients fire
+# one-way `Agent.emitBatch(Batch)` calls as UDP datagrams on port 6831,
+# encoded with the thrift COMPACT protocol (ref
+# `modules/distributor/receiver/shim.go:165-171` jaeger protocols map).
+# Same span-dict mapping as the binary/protobuf decoders above — the
+# three jaeger wire forms cannot diverge because they share
+# `_intrinsics_from_tags` and the field semantics below.
+
+_C_BOOL_TRUE, _C_BOOL_FALSE = 1, 2
+_C_BYTE, _C_I16, _C_I32, _C_I64, _C_DOUBLE = 3, 4, 5, 6, 7
+_C_BINARY, _C_LIST, _C_SET, _C_MAP, _C_STRUCT = 8, 9, 10, 11, 12
+
+
+class _CR:
+    """Cursor over TCompactProtocol bytes."""
+
+    __slots__ = ("b", "i")
+
+    def __init__(self, b: bytes):
+        self.b = b
+        self.i = 0
+
+    def u8(self) -> int:
+        v = self.b[self.i]
+        self.i += 1
+        return v
+
+    def uvarint(self) -> int:
+        out = shift = 0
+        while True:
+            byte = self.b[self.i]
+            self.i += 1
+            out |= (byte & 0x7F) << shift
+            if not byte & 0x80:
+                return out
+            shift += 7
+            if shift > 70:
+                raise ValueError("varint too long")
+
+    def zigzag(self) -> int:
+        v = self.uvarint()
+        return (v >> 1) ^ -(v & 1)
+
+    def f64(self) -> float:
+        # compact doubles are little-endian (the thrift library quirk —
+        # opposite of the binary protocol)
+        v = struct.unpack_from("<d", self.b, self.i)[0]
+        self.i += 8
+        return v
+
+    def raw(self) -> bytes:
+        n = self.uvarint()
+        if self.i + n > len(self.b):
+            raise ValueError("binary field overruns datagram")
+        v = self.b[self.i:self.i + n]
+        self.i += n
+        return v
+
+    def fields(self):
+        """Yield (field id, compact type) until STOP; short-form ids are
+        delta-encoded against the previous field of THIS struct."""
+        last = 0
+        while True:
+            h = self.u8()
+            if h == 0:
+                return
+            delta, ctype = h >> 4, h & 0x0F
+            fid = last + delta if delta else self.zigzag()
+            last = fid
+            yield fid, ctype
+
+    def list_header(self) -> tuple[int, int]:
+        h = self.u8()
+        n, et = h >> 4, h & 0x0F
+        if n == 15:
+            n = self.uvarint()
+        return n, et
+
+    def skip(self, ctype: int, depth: int = 0) -> None:
+        if depth > 32:
+            raise ValueError("nesting too deep")
+        if ctype in (_C_BOOL_TRUE, _C_BOOL_FALSE):
+            return                       # value lives in the field header
+        if ctype == _C_BYTE:
+            self.i += 1
+        elif ctype in (_C_I16, _C_I32, _C_I64):
+            self.zigzag()
+        elif ctype == _C_DOUBLE:
+            self.i += 8
+        elif ctype == _C_BINARY:
+            self.raw()
+        elif ctype in (_C_LIST, _C_SET):
+            n, et = self.list_header()
+            for _ in range(n):
+                self.skip_elem(et, depth + 1)
+        elif ctype == _C_MAP:
+            n = self.uvarint()
+            if n:
+                kv = self.u8()
+                for _ in range(n):
+                    self.skip_elem(kv >> 4, depth + 1)
+                    self.skip_elem(kv & 0x0F, depth + 1)
+        elif ctype == _C_STRUCT:
+            for _fid, ft in self.fields():
+                self.skip(ft, depth + 1)
+        else:
+            raise ValueError(f"bad compact type {ctype}")
+
+    def skip_elem(self, et: int, depth: int = 0) -> None:
+        # list/set/map elements: bools take one byte (unlike field bools)
+        if et in (_C_BOOL_TRUE, _C_BOOL_FALSE):
+            self.i += 1
+        else:
+            self.skip(et, depth)
+
+
+def _c_read_tag(r: _CR) -> tuple[str, Any]:
+    key, vtype = "", 0
+    vstr: bytes = b""
+    vdouble, vbool, vlong = 0.0, False, 0
+    vbin: bytes = b""
+    for fid, ft in r.fields():
+        if fid == 1 and ft == _C_BINARY:
+            key = r.raw().decode("utf-8", "replace")
+        elif fid == 2 and ft == _C_I32:
+            vtype = r.zigzag()
+        elif fid == 3 and ft == _C_BINARY:
+            vstr = r.raw()
+        elif fid == 4 and ft == _C_DOUBLE:
+            vdouble = r.f64()
+        elif fid == 5 and ft in (_C_BOOL_TRUE, _C_BOOL_FALSE):
+            vbool = ft == _C_BOOL_TRUE
+        elif fid == 6 and ft == _C_I64:
+            vlong = r.zigzag()
+        elif fid == 7 and ft == _C_BINARY:
+            vbin = r.raw()
+        else:
+            r.skip(ft)
+    val: Any
+    if vtype == 0:
+        val = vstr.decode("utf-8", "replace")
+    elif vtype == 1:
+        val = vdouble
+    elif vtype == 2:
+        val = vbool
+    elif vtype == 3:
+        val = vlong
+    else:
+        val = vbin
+    return key, val
+
+
+def _c_read_tag_list(r: _CR) -> dict[str, Any]:
+    n, et = r.list_header()
+    out: dict[str, Any] = {}
+    for _ in range(n):
+        if et == _C_STRUCT:
+            k, v = _c_read_tag(r)
+            out[k] = v
+        else:
+            r.skip_elem(et)
+    return out
+
+
+def _c_read_span(r: _CR) -> dict:
+    tid_lo = tid_hi = sid = psid = 0
+    name = ""
+    start_us = dur_us = 0
+    attrs: dict[str, Any] = {}
+    for fid, ft in r.fields():
+        if fid == 1 and ft == _C_I64:
+            tid_lo = r.zigzag()
+        elif fid == 2 and ft == _C_I64:
+            tid_hi = r.zigzag()
+        elif fid == 3 and ft == _C_I64:
+            sid = r.zigzag()
+        elif fid == 4 and ft == _C_I64:
+            psid = r.zigzag()
+        elif fid == 5 and ft == _C_BINARY:
+            name = r.raw().decode("utf-8", "replace")
+        elif fid == 8 and ft == _C_I64:
+            start_us = r.zigzag()
+        elif fid == 9 and ft == _C_I64:
+            dur_us = r.zigzag()
+        elif fid == 10 and ft == _C_LIST:
+            attrs = _c_read_tag_list(r)
+        else:
+            r.skip(ft)
+    return _span_dict(tid_hi, tid_lo, sid, psid, name, start_us, dur_us,
+                      attrs)
+
+
+def spans_from_jaeger_agent(datagram: bytes) -> list[dict]:
+    """Decode one UDP `Agent.emitBatch` datagram (compact protocol) into
+    span dicts. Raises ValueError on malformed bytes (the receiver counts
+    and drops — UDP has nobody to answer)."""
+    try:
+        r = _CR(datagram)
+        if r.u8() != 0x82:
+            raise ValueError("not a compact-protocol message")
+        vt = r.u8()
+        if (vt & 0x1F) != 1:
+            raise ValueError("unsupported compact version")
+        if (vt >> 5) not in (1, 4):          # CALL / ONEWAY
+            raise ValueError("not a call message")
+        r.uvarint()                          # seqid
+        if r.raw() != b"emitBatch":
+            raise ValueError("not an emitBatch call")
+        service = ""
+        res_attrs: dict[str, Any] = {}
+        out: list[dict] = []
+        for fid, ft in r.fields():           # Agent.emitBatch args
+            if fid == 1 and ft == _C_STRUCT:     # Batch
+                for bfid, bft in r.fields():
+                    if bfid == 1 and bft == _C_STRUCT:   # Process
+                        for pfid, pft in r.fields():
+                            if pfid == 1 and pft == _C_BINARY:
+                                service = r.raw().decode("utf-8", "replace")
+                            elif pfid == 2 and pft == _C_LIST:
+                                res_attrs = _c_read_tag_list(r)
+                            else:
+                                r.skip(pft)
+                    elif bfid == 2 and bft == _C_LIST:   # spans
+                        n, et = r.list_header()
+                        if n and et != _C_STRUCT:
+                            raise ValueError("Batch.spans must hold structs")
+                        for _ in range(n):
+                            out.append(_c_read_span(r))
+                    else:
+                        r.skip(bft)
+            else:
+                r.skip(ft)
+        return _patch_batch(out, service, res_attrs)
+    except (struct.error, IndexError) as e:
+        raise ValueError(f"malformed jaeger agent datagram: {e}") from None

@@ -449,3 +449,172 @@ def test_status_usage_stats_endpoint(server):
         assert code == 404
     finally:
         app.usage_reporter = saved
+
+
+# -- jaeger agent UDP (thrift-compact emitBatch, round 5) --------------------
+#
+# Test-side TCompactProtocol writer: an independent encoder so the
+# decoder is checked against the SPEC (zigzag varints, delta field ids,
+# header-embedded bools, little-endian doubles), not against itself.
+
+def _c_varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        x = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(x | 0x80)
+        else:
+            out.append(x)
+            return bytes(out)
+
+
+def _c_zig(v: int) -> bytes:
+    return _c_varint((v << 1) ^ (v >> 63) if v >= 0 else ((v << 1) ^ -1))
+
+
+def _c_field(last_fid: int, fid: int, ctype: int) -> bytes:
+    delta = fid - last_fid
+    if 0 < delta <= 15:
+        return bytes([(delta << 4) | ctype])
+    return bytes([ctype]) + _c_zig(fid)
+
+
+def _c_str(s) -> bytes:
+    b = s.encode() if isinstance(s, str) else s
+    return _c_varint(len(b)) + b
+
+
+def _c_tag(key: str, v) -> bytes:
+    out = _c_field(0, 1, 8) + _c_str(key)          # key
+    if isinstance(v, bool):
+        out += _c_field(1, 2, 5) + _c_zig(2)       # vType BOOL
+        out += _c_field(2, 5, 1 if v else 2)       # bool in the HEADER
+    elif isinstance(v, int):
+        out += _c_field(1, 2, 5) + _c_zig(3)       # vType LONG
+        out += _c_field(2, 6, 6) + _c_zig(v)
+    elif isinstance(v, float):
+        import struct as _s
+        out += _c_field(1, 2, 5) + _c_zig(1)       # vType DOUBLE
+        out += _c_field(2, 4, 7) + _s.pack("<d", v)
+    else:
+        out += _c_field(1, 2, 5) + _c_zig(0)       # vType STRING
+        out += _c_field(2, 3, 8) + _c_str(v)
+    return out + b"\x00"
+
+
+def _c_list(structs: list[bytes]) -> bytes:
+    n = len(structs)
+    if n < 15:
+        hdr = bytes([(n << 4) | 12])
+    else:
+        hdr = bytes([0xF0 | 12]) + _c_varint(n)
+    return hdr + b"".join(structs)
+
+
+def _agent_datagram(service: str, spans: list[dict]) -> bytes:
+    span_structs = []
+    for s in spans:
+        b = (_c_field(0, 1, 6) + _c_zig(s["tid_lo"]) +
+             _c_field(1, 2, 6) + _c_zig(s["tid_hi"]) +
+             _c_field(2, 3, 6) + _c_zig(s["sid"]) +
+             _c_field(3, 4, 6) + _c_zig(s.get("psid", 0)) +
+             _c_field(4, 5, 8) + _c_str(s["name"]) +
+             _c_field(5, 8, 6) + _c_zig(s["start_us"]) +   # delta 3
+             _c_field(8, 9, 6) + _c_zig(s["dur_us"]))
+        tags = [_c_tag(k, v) for k, v in s.get("tags", {}).items()]
+        if tags:
+            b += _c_field(9, 10, 9) + _c_list(tags)
+        span_structs.append(b + b"\x00")
+    process = (_c_field(0, 1, 8) + _c_str(service) +
+               _c_field(1, 2, 9) + _c_list([_c_tag("hostname", "h7")]) +
+               b"\x00")
+    batch = (_c_field(0, 1, 12) + process +
+             _c_field(1, 2, 9) + _c_list(span_structs) + b"\x00")
+    args = _c_field(0, 1, 12) + batch + b"\x00"
+    return (b"\x82" + bytes([(4 << 5) | 1]) +       # ONEWAY, version 1
+            _c_varint(7) + _c_str("emitBatch") + args)
+
+
+def test_jaeger_agent_udp_receiver():
+    import socket as _socket
+    import time as _time
+
+    from tempo_tpu.distributor.receiver_agent import (JaegerAgentConfig,
+                                                      JaegerAgentReceiver)
+
+    pushed = []
+
+    class _Rec:
+        def push_spans(self, tenant, spans, size_bytes=None, **kw):
+            pushed.append((tenant, spans))
+            return {}
+
+    rx = JaegerAgentReceiver(_Rec(), JaegerAgentConfig(host="127.0.0.1",
+                                                       port=0))
+    rx.start()
+    try:
+        gram = _agent_datagram("udp-svc", [{
+            "tid_lo": 0x1234, "tid_hi": 0, "sid": 0x77, "psid": 0x55,
+            "name": "udp-op", "start_us": 1_700_000_000_000_000,
+            "dur_us": 25_000,
+            "tags": {"span.kind": "server", "error": True,
+                     "retries": 3, "ratio": 0.5, "note": "hé"}}])
+        s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+        s.sendto(gram, ("127.0.0.1", rx.port))
+        s.sendto(b"\xff junk not thrift", ("127.0.0.1", rx.port))
+        deadline = _time.time() + 5
+        while _time.time() < deadline and (not pushed or rx.errors < 1):
+            _time.sleep(0.02)
+        assert rx.batches_received == 1 and rx.errors == 1
+        tenant, spans = pushed[0]
+        assert tenant == "single-tenant" and len(spans) == 1
+        sp = spans[0]
+        assert sp["name"] == "udp-op" and sp["service"] == "udp-svc"
+        assert sp["trace_id"].hex() == "0" * 16 + "0000000000001234"
+        assert sp["span_id"].hex() == "0000000000000077"
+        assert sp["parent_span_id"].hex() == "0000000000000055"
+        assert sp["kind"] == 2                       # span.kind=server
+        assert sp["status_code"] == 2                # error=true
+        assert sp["start_unix_nano"] == 1_700_000_000_000_000_000
+        assert sp["end_unix_nano"] - sp["start_unix_nano"] == 25_000_000
+        assert sp["attrs"]["retries"] == 3
+        assert sp["attrs"]["ratio"] == 0.5
+        assert sp["attrs"]["note"] == "hé"
+        assert sp["res_attrs"] == {"hostname": "h7",
+                                   "service.name": "udp-svc"}
+    finally:
+        rx.stop()
+
+
+def test_jaeger_agent_wired_into_app(tmp_path):
+    """distributor.jaeger_agent_port boots the UDP receiver inside the
+    app; a datagram lands as a searchable trace end-to-end."""
+    import socket as _socket
+    import time as _time
+
+    cfg = Config()
+    cfg.storage.backend = "mem"
+    cfg.storage.wal_path = str(tmp_path / "wal")
+    cfg.generator.localblocks.data_dir = str(tmp_path / "lb")
+    cfg.distributor.jaeger_agent_port = free_port()
+    app = App(cfg)
+    app.start_loops()
+    try:
+        now_us = int(_time.time() * 1e6)
+        gram = _agent_datagram("agent-svc", [{
+            "tid_lo": 0xABCD, "tid_hi": 0, "sid": 1,
+            "name": "agent-op", "start_us": now_us, "dur_us": 1000,
+            "tags": {}}])
+        s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+        s.sendto(gram, ("127.0.0.1", app.jaeger_agent.port))
+        deadline = _time.time() + 5
+        while _time.time() < deadline and \
+                app.jaeger_agent.spans_received < 1:
+            _time.sleep(0.02)
+        assert app.jaeger_agent.spans_received == 1
+        tid = bytes(8) + (0xABCD).to_bytes(8, "big")
+        spans = app.ingester.find_trace_by_id("single-tenant", tid)
+        assert spans and spans[0]["name"] == "agent-op"
+    finally:
+        app.shutdown()
