@@ -230,10 +230,17 @@ class App:
             from tempo_tpu.backend.cache import CacheProvider, CachingReader
             sc = self.cfg.storage
             caches = {}
-            if sc.memcached_addrs:
-                from tempo_tpu.backend.memcached import MemcachedCache
-                shared = MemcachedCache(
-                    sc.memcached_addrs, timeout_s=sc.memcached_timeout_s,
+            if sc.memcached_addrs and sc.redis_addrs:
+                raise ValueError(
+                    "configure ONE shared cache tier: both "
+                    "storage.memcached_addrs and storage.redis_addrs set")
+            if sc.memcached_addrs or sc.redis_addrs:
+                from tempo_tpu.backend.memcached import (MemcachedCache,
+                                                         RedisCache)
+                cls = RedisCache if sc.redis_addrs else MemcachedCache
+                shared = cls(
+                    sc.redis_addrs or sc.memcached_addrs,
+                    timeout_s=sc.memcached_timeout_s,
                     expiration_s=sc.memcached_expiration_s)
                 caches = {role: shared for role in sc.memcached_roles}
             self.cache_provider = CacheProvider(

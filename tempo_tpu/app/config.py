@@ -59,6 +59,9 @@ class StorageConfig:
     # SDK-free memcached client (write-behind) so every querier/frontend
     # replica shares one working set; empty = in-process LRUs only
     memcached_addrs: str = ""
+    # redis alternative (pkg/cache/redis_client.go analog, RESP2 GET/SET);
+    # takes the same roles — configure ONE of the two tiers
+    redis_addrs: str = ""
     memcached_roles: tuple = ("bloom", "parquet-footer", "frontend-search")
     memcached_timeout_s: float = 0.5
     memcached_expiration_s: int = 0

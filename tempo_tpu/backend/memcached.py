@@ -153,13 +153,15 @@ class MemcachedCache:
     write-behind queue. Network failures degrade to misses — the cache
     tier must never take the read path down."""
 
+    _conn_cls = _ServerConn          # RedisCache swaps the protocol
+
     def __init__(self, servers: "list[str] | str",
                  timeout_s: float = 0.5, expiration_s: int = 0,
                  write_back_buffer: int = 1024,
                  write_back_workers: int = 1) -> None:
         if isinstance(servers, str):
             servers = [s for s in servers.split(",") if s]
-        self._conns = [_ServerConn(a, timeout_s) for a in servers]
+        self._conns = [self._conn_cls(a, timeout_s) for a in servers]
         self.expiration_s = expiration_s
         self.hits = 0
         self.misses = 0
@@ -225,3 +227,71 @@ class MemcachedCache:
                 break
         for c in self._conns:
             c.close()
+
+
+# -- redis (RESP2) variant ----------------------------------------------------
+#
+# The reference ships both shared-cache clients (`pkg/cache/redis_client.go`
+# via go-redis); this is the RESP2 subset the cache roles need — GET/SET
+# (with EX expiry) — over the same per-thread connections and write-behind
+# queue as the memcached client. Cluster-mode redis is out of scope (the
+# reference's client also defaults to single-endpoint/ring).
+
+
+class _RedisConn(_ServerConn):
+    """RESP2 framing over the per-thread connection machinery."""
+
+    def _cmd(self, s: socket.socket, *parts: bytes) -> None:
+        out = b"*" + str(len(parts)).encode() + b"\r\n"
+        for p in parts:
+            out += b"$" + str(len(p)).encode() + b"\r\n" + p + b"\r\n"
+        s.sendall(out)
+
+    def _reply(self, s: socket.socket):
+        line = self._read_line(s)
+        t, body = line[:1], line[1:]
+        if t == b"+":
+            return body
+        if t == b"-":
+            raise ConnectionError(f"redis error: {body[:120]!r}")
+        if t == b":":
+            return int(body)
+        if t == b"$":
+            n = int(body)
+            if n < 0:
+                return None
+            v = self._read_n(s, n)
+            self._read_n(s, 2)
+            return v
+        raise ConnectionError(f"unexpected RESP type {t!r}")
+
+    def get(self, key: bytes) -> bytes | None:
+        try:
+            s = self._connect()
+            self._cmd(s, b"GET", key)
+            v = self._reply(s)
+            return v if isinstance(v, bytes) else None
+        except (OSError, ValueError, ConnectionError):
+            self._reset()
+            return None
+
+    def set(self, key: bytes, value: bytes, exp_s: int) -> bool:
+        try:
+            s = self._connect()
+            if exp_s > 0:
+                self._cmd(s, b"SET", key, value, b"EX", str(exp_s).encode())
+            else:
+                self._cmd(s, b"SET", key, value)
+            return self._reply(s) == b"OK"
+        except (OSError, ValueError, ConnectionError):
+            self._reset()
+            return False
+
+
+class RedisCache(MemcachedCache):
+    """LRUCache-shaped client over a redis server list; shares the
+    write-behind queue, key hashing, and degradation semantics with
+    `MemcachedCache` (keys need no sanitization — redis keys are binary
+    safe — but the shared sha1 form keeps the two tiers swappable)."""
+
+    _conn_cls = _RedisConn

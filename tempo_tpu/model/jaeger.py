@@ -511,12 +511,26 @@ class _CR:
             last = fid
             yield fid, ctype
 
+    def check_count(self, n: int, elem_type: int, pairs: bool = False
+                    ) -> int:
+        """Bound an attacker-supplied collection count by the remaining
+        datagram bytes BEFORE any loop runs — fixed-size skips (`i += 1`)
+        never touch the buffer, so a crafted 13-byte datagram claiming
+        2^40 elements would otherwise spin the receiver thread forever
+        (remote unauthenticated DoS)."""
+        per = 8 if elem_type == _C_DOUBLE else 1
+        if pairs:
+            per += 1                     # a map entry is >= 2 wire bytes
+        if n < 0 or n * per > len(self.b) - self.i:
+            raise ValueError("compact collection count overruns datagram")
+        return n
+
     def list_header(self) -> tuple[int, int]:
         h = self.u8()
         n, et = h >> 4, h & 0x0F
         if n == 15:
             n = self.uvarint()
-        return n, et
+        return self.check_count(n, et), et
 
     def skip(self, ctype: int, depth: int = 0) -> None:
         if depth > 32:
@@ -539,6 +553,7 @@ class _CR:
             n = self.uvarint()
             if n:
                 kv = self.u8()
+                self.check_count(n, kv & 0x0F, pairs=True)
                 for _ in range(n):
                     self.skip_elem(kv >> 4, depth + 1)
                     self.skip_elem(kv & 0x0F, depth + 1)

@@ -74,3 +74,68 @@ def start_mock_memcached():
     m = MockMemcached()
     srv, port = m.start()
     return srv, port, m
+
+
+class MockRedis:
+    """RESP2 GET/SET subset with strict framing verification."""
+
+    def __init__(self) -> None:
+        self.store: dict[bytes, bytes] = {}
+        self.lock = threading.Lock()
+        self.gets = 0
+        self.sets = 0
+
+    def start(self):
+        mock = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def _arg(self):
+                hdr = self.rfile.readline().rstrip(b"\r\n")
+                assert hdr[:1] == b"$", hdr
+                n = int(hdr[1:])
+                v = self.rfile.read(n)
+                self.rfile.read(2)
+                return v
+
+            def handle(self):
+                while True:
+                    line = self.rfile.readline()
+                    if not line:
+                        return
+                    line = line.rstrip(b"\r\n")
+                    assert line[:1] == b"*", line
+                    argc = int(line[1:])
+                    args = [self._arg() for _ in range(argc)]
+                    cmd = args[0].upper()
+                    if cmd == b"GET" and argc == 2:
+                        mock.gets += 1
+                        with mock.lock:
+                            v = mock.store.get(args[1])
+                        if v is None:
+                            self.wfile.write(b"$-1\r\n")
+                        else:
+                            self.wfile.write(
+                                b"$" + str(len(v)).encode() + b"\r\n" +
+                                v + b"\r\n")
+                    elif cmd == b"SET" and argc in (3, 5):
+                        if argc == 5:
+                            assert args[3].upper() == b"EX", args
+                            int(args[4])
+                        mock.sets += 1
+                        with mock.lock:
+                            mock.store[args[1]] = args[2]
+                        self.wfile.write(b"+OK\r\n")
+                    else:
+                        self.wfile.write(b"-ERR unknown command\r\n")
+
+        srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), Handler)
+        srv.daemon_threads = True
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        return srv, srv.server_address[1]
+
+
+def start_mock_redis():
+    m = MockRedis()
+    srv, port = m.start()
+    return srv, port, m

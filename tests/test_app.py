@@ -618,3 +618,45 @@ def test_jaeger_agent_wired_into_app(tmp_path):
         assert spans and spans[0]["name"] == "agent-op"
     finally:
         app.shutdown()
+
+
+def test_jaeger_agent_dos_datagram_rejected_fast():
+    """A crafted datagram claiming a huge fixed-size collection count must
+    raise (and quickly) — fixed-size skips never touch the buffer, so an
+    unbounded count would spin the receiver thread forever (remote
+    unauthenticated DoS, round-5 review finding)."""
+    import time as _time
+
+    from tempo_tpu.model.jaeger import spans_from_jaeger_agent
+
+    # message header + args struct holding field 1 as a LIST of BYTE with
+    # a ~2^41 claimed count
+    evil = (b"\x82" + bytes([(4 << 5) | 1]) + _c_varint(1) +
+            _c_str("emitBatch") +
+            bytes([(1 << 4) | 9]) +           # field 1, LIST
+            bytes([0xF3]) +                   # long form, elem BYTE
+            _c_varint(1 << 41) + b"\x00")
+    t0 = _time.time()
+    with pytest.raises(ValueError):
+        spans_from_jaeger_agent(evil)
+    assert _time.time() - t0 < 1.0
+    # same for maps and doubles
+    for elem in (7, 1):
+        evil2 = (b"\x82" + bytes([(4 << 5) | 1]) + _c_varint(1) +
+                 _c_str("emitBatch") +
+                 bytes([(1 << 4) | 9]) + bytes([0xF0 | elem]) +
+                 _c_varint(1 << 41) + b"\x00")
+        with pytest.raises(ValueError):
+            spans_from_jaeger_agent(evil2)
+
+
+def test_app_rejects_both_cache_tiers():
+    from tempo_tpu.app import App
+    from tempo_tpu.app.config import Config
+
+    cfg = Config(target="querier")
+    cfg.storage.backend = "mem"
+    cfg.storage.memcached_addrs = "127.0.0.1:11211"
+    cfg.storage.redis_addrs = "127.0.0.1:6379"
+    with pytest.raises(ValueError, match="ONE shared cache tier"):
+        App(cfg)

@@ -489,3 +489,61 @@ def test_memcached_cross_instance_shared_cache():
         db_a.shutdown(); db_b.shutdown()
     finally:
         srv.shutdown()
+
+
+def test_redis_cache_client_roundtrip_and_expiry():
+    """The RESP2 redis variant shares the write-behind + degradation
+    semantics with the memcached tier (pkg/cache/redis_client.go analog);
+    the strict mock rejects malformed framing."""
+    from tempo_tpu.backend.memcached import RedisCache
+    from tests.mock_memcached import start_mock_redis
+
+    srv, port, mock = start_mock_redis()
+    try:
+        c = RedisCache(f"127.0.0.1:{port}", expiration_s=60)
+        assert c.get("missing") is None and c.misses == 1
+        c.put("k1", b"v1")
+        c.flush()
+        assert c.get("k1") == b"v1" and c.hits == 1
+        assert mock.sets == 1 and mock.gets == 2
+        # concurrent readers: per-thread connections, no cross-talk
+        import threading as _t
+        errs = []
+
+        def reader(i):
+            for _ in range(50):
+                if c.get("k1") != b"v1":
+                    errs.append(i)
+
+        ts = [_t.Thread(target=reader, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        c.close()
+    finally:
+        srv.shutdown()
+
+
+def test_app_wires_shared_cache_tier(tmp_path):
+    from tempo_tpu.app import App
+    from tempo_tpu.app.config import Config
+    from tempo_tpu.backend.memcached import MemcachedCache, RedisCache
+    from tests.mock_memcached import start_mock_redis
+
+    srv, port, mock = start_mock_redis()
+    try:
+        cfg = Config(target="querier")
+        cfg.storage.backend = "mem"
+        cfg.storage.wal_path = str(tmp_path / "wal")
+        cfg.storage.redis_addrs = f"127.0.0.1:{port}"
+        app = App(cfg)
+        c = app.cache_provider.cache_for("bloom")
+        assert isinstance(c, RedisCache)
+        c.put("k", b"v")
+        c.flush()
+        assert c.get("k") == b"v" and mock.sets == 1
+        app.shutdown()
+    finally:
+        srv.shutdown()

@@ -1,0 +1,147 @@
+"""Property tests for the device plane's exactness arithmetic.
+
+The fused plane's correctness rests on a few small encodings: the 33/31
+and biased 32/32 int64 limb splits (lexicographic order == int64 order),
+the order-preserving float64→int64 map, the 16-bit limb boundary compare
+behind exact step bucketing, and `_int_literal`'s compare normalization.
+These are exhaustive-ish randomized checks of those invariants — cheap,
+seed-logged, and independent of jax (pure numpy)."""
+
+from __future__ import annotations
+
+import os
+import random
+
+import numpy as np
+
+from tempo_tpu.block.device_scan import (
+    _int_literal,
+    _sortable_f64,
+    _split_i64,
+    _split_i64_biased,
+    _split_lit,
+    _split_lit_biased,
+)
+from tempo_tpu.traceql import ast as A
+
+SEED = int(os.environ.get("TEMPO_FUZZ_SEED",
+                          random.SystemRandom().randrange(1 << 30)))
+
+
+def _rand_i64(rng: random.Random, n: int, lim: int) -> np.ndarray:
+    vals = [rng.randrange(-lim, lim) for _ in range(n)]
+    vals += [0, 1, -1, lim - 1, -lim, 2**31, -2**31, 2**31 - 1, 2**24,
+             2**24 + 1]
+    return np.asarray(vals, np.int64)
+
+
+def test_split_i64_order_and_roundtrip():
+    rng = random.Random(SEED)
+    # the 33/31 split is used for values |v| < 2^62 (timestamps, int attrs)
+    v = _rand_i64(rng, 500, 1 << 61)
+    hi, lo = _split_i64(v)
+    assert (lo >= 0).all()                      # low half non-negative
+    back = hi.astype(np.int64) * (1 << 31) + lo
+    np.testing.assert_array_equal(back, v, err_msg=f"seed={SEED}")
+    # lexicographic (hi, lo) == int64 order
+    order = np.lexsort((lo, hi))
+    np.testing.assert_array_equal(v[order], np.sort(v),
+                                  err_msg=f"seed={SEED}")
+    # per-pair literal split agrees with the array split
+    for x in v[:50].tolist():
+        lh, ll = _split_lit(int(x))
+        i = int(np.flatnonzero(v == x)[0])
+        assert (lh, ll) == (int(hi[i]), int(lo[i])), f"seed={SEED} x={x}"
+
+
+def test_split_i64_biased_full_range_order():
+    rng = random.Random(SEED + 1)
+    # the biased 32/32 split must order the FULL int64 range (sortable
+    # float encodings reach |v| ~ 2^63)
+    v = _rand_i64(rng, 500, (1 << 63) - 1)
+    hi, lo = _split_i64_biased(v)
+    order = np.lexsort((lo, hi))
+    np.testing.assert_array_equal(v[order], np.sort(v),
+                                  err_msg=f"seed={SEED}")
+    for x in v[:50].tolist():
+        lh, ll = _split_lit_biased(int(x))
+        i = int(np.flatnonzero(v == x)[0])
+        assert (lh, ll) == (int(hi[i]), int(lo[i])), f"seed={SEED} x={x}"
+        assert -(1 << 31) <= lh < (1 << 31)     # both halves fit int32
+        assert -(1 << 31) <= ll < (1 << 31)
+
+
+def test_sortable_f64_is_order_preserving():
+    rng = np.random.default_rng(SEED + 2)
+    vals = np.concatenate([
+        rng.uniform(-1e300, 1e300, 300),
+        rng.uniform(-1.0, 1.0, 300),
+        np.array([0.0, -0.0, np.inf, -np.inf, 1e-308, -1e-308,
+                  16777217.5, -16777217.5, 2.0**52, -(2.0**52)]),
+    ])
+    m = _sortable_f64(vals)
+    # total order matches float order; equal floats (0.0 == -0.0) equal
+    for _ in range(2000):
+        i, j = rng.integers(0, len(vals), 2)
+        a, b = float(vals[i]), float(vals[j])
+        ma, mb = int(m[i]), int(m[j])
+        if a < b:
+            assert ma < mb, f"seed={SEED} {a} {b}"
+        elif a > b:
+            assert ma > mb, f"seed={SEED} {a} {b}"
+        else:
+            assert ma == mb, f"seed={SEED} {a} {b}"
+
+
+def test_int_literal_normalization_matches_float_compare():
+    """`_int_literal` rewrites (op, float literal) into an exact integer
+    compare; for every op × literal × int value the rewritten compare
+    must agree with the host engine's float64 compare."""
+    rng = random.Random(SEED + 3)
+    ops = {A.Op.EQ: lambda a, b: a == b, A.Op.NEQ: lambda a, b: a != b,
+           A.Op.GT: lambda a, b: a > b, A.Op.GTE: lambda a, b: a >= b,
+           A.Op.LT: lambda a, b: a < b, A.Op.LTE: lambda a, b: a <= b}
+    lits = [1.5, -2.5, 0.0, 3.0, -1.0, 0.5, 7, -7, 2**24 + 0.5, 1e-9]
+    vals = [rng.randrange(-1000, 1000) for _ in range(50)] + [0, 1, -1]
+    for op, py in ops.items():
+        for lit in lits:
+            norm = _int_literal(op, lit)
+            for v in vals:
+                want = py(float(v), float(lit))
+                if norm[0] == "const":
+                    got = norm[1]
+                else:
+                    _, op2, ilit = norm
+                    got = ops[op2](v, ilit)
+                assert got == want, \
+                    f"seed={SEED} {op} {lit} {v}: {got} != {want}"
+
+
+def test_limb_boundary_compare_matches_int_math():
+    """The exact-bucketing kernel compares t_ns >= start_ns + q*step_ns
+    via 16-bit limbs; mirror the limb algorithm in numpy over random
+    operands within the kernel's guard bounds and check against exact
+    python ints."""
+    rng = random.Random(SEED + 4)
+    for _ in range(500):
+        start = rng.randrange(0, 1 << 62)
+        step = rng.randrange(1, 1 << 40)
+        q = rng.randrange(0, (1 << 14) + 1)
+        t = start + q * step + rng.randrange(-3, 4)
+        if t < 0:
+            continue
+        # limb compute (the kernel's ge_boundary, host-side mirror)
+        sl = [(step >> s) & 0xFFFF for s in (0, 16, 32, 48)]
+        ul = [(start >> s) & 0xFFFF for s in (0, 16, 32, 48)]
+        carry = 0
+        r = []
+        for i in range(4):
+            v = ul[i] + q * sl[i] + carry
+            r.append(v & 0xFFFF)
+            carry = v >> 16
+        w = [(t >> s) & 0xFFFF for s in (0, 16, 32, 48)]
+        ge = w[0] >= r[0]
+        for wi, ri in zip(w[1:], r[1:]):
+            ge = ge if wi == ri else wi > ri
+        assert ge == (t >= start + q * step), \
+            f"seed={SEED} start={start} step={step} q={q} t={t}"
