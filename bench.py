@@ -20,6 +20,17 @@ Any stage that fails or times out on the accelerator is retried on CPU and
 the final line is still emitted, tagged with "platform" and per-stage
 errors. rc is 0 whenever the orchestrator itself survives.
 
+Round-5 rework (the round-4 failure: probes timed out twice in the first
+8 minutes and the bench never looked at the accelerator again): a
+BACKGROUND probe keeps watching for the tunnel while stages run on CPU
+(CPU children drop the relay env entirely, so there is no lease
+contention), any stage whose number was captured on CPU is re-run on the
+accelerator when it appears (headline e2e first), probe children are
+always reaped so a wedged one cannot hold the tunnel lease past exit,
+and a soft deadline bounds the optional work. The fault-injection hooks
+(TEMPO_BENCH_STAGE_STUB / PROBE_HANG_UNTIL / PROBE_FAKE) drive
+tests/test_bench_orchestration.py through the recovery paths.
+
 Scaling profile (measured r3): e2e throughput is flat in batch size
 (16k/64k/128k-span payloads all ~1.2-1.5M spans/s) and in thread count —
 the bound is per-span host staging orchestration (Python/numpy between
