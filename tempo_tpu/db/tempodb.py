@@ -214,10 +214,13 @@ class TempoDB:
             metas = self.blocks(tenant, req.start_ns / 1e9, req.end_ns / 1e9)
         ev = MetricsEvaluator(req, clip_start_ns, clip_end_ns)
         # the fused path is exact only when the pushdown IS the filter:
-        # single pure-AND filter pipeline (all_conditions, the optimize()
-        # precondition of engine_metrics.go:885) and no compare() stage
+        # a single filter pipeline that is pure-AND (all_conditions, the
+        # optimize() precondition of engine_metrics.go:885) or a pure OR
+        # of pushed compares (the OR mask of exact terms is exact —
+        # round 5), and no compare() stage
         fusable = (self.planes is not None
-                   and ev.fetch_req.all_conditions
+                   and (ev.fetch_req.all_conditions
+                        or ev.fetch_req.pure_disjunction)
                    and all(isinstance(s, A.SpansetFilter) for s in ev.q.stages)
                    and ev.m.kind != A.MetricsKind.COMPARE)
         preds = [c for c in ev.fetch_req.conditions if c.op is not None]
@@ -240,7 +243,8 @@ class TempoDB:
             if fusable:
                 cb = self.planes.get(self.backend_block(m))
                 handle = cb.plane.metrics_grid(
-                    ev.m, preds, True, req.start_ns, req.end_ns, req.step_ns,
+                    ev.m, preds, ev.fetch_req.all_conditions,
+                    req.start_ns, req.end_ns, req.step_ns,
                     clip_start_ns, clip_end_ns, row_groups)
             if handle is not None:
                 self.plane_stats["fused_metric_blocks"] += 1

@@ -42,6 +42,11 @@ class FetchSpansRequest:
     # OR, rhs of a structural op, ...): the storage prefilter must pass every
     # row through, since any span may participate in the second pass
     has_unconditioned_arm: bool = False
+    # True when the single filter stage is a pure OR-tree whose every leaf
+    # pushed down: the OR of the per-condition masks is then EXACT (not a
+    # hint superset), so the fused metrics plane may serve the query even
+    # though all_conditions is False (round 5)
+    pure_disjunction: bool = False
 
     def add(self, c: Condition) -> None:
         if c not in self.conditions:
@@ -49,6 +54,41 @@ class FetchSpansRequest:
 
 
 _ALWAYS_SECOND_PASS = {A.Op.NOT}  # negations can't prune at storage
+
+
+def _pushable_compare(e) -> "tuple | None":
+    """(attr, op, static) when `e` is a storage-pushable compare
+    (attribute <op> literal, either side order) — the single source of
+    pushability shared by the extractor and the pure-disjunction check,
+    so the two can never disagree on what 'pushed' means."""
+    if not isinstance(e, A.BinaryOp):
+        return None
+    lhs, rhs, op = e.lhs, e.rhs, e.op
+    if isinstance(rhs, A.Attribute) and isinstance(lhs, A.Static):
+        lhs, rhs = rhs, lhs
+        op = _flip(op)
+    if isinstance(lhs, A.Attribute) and isinstance(rhs, A.Static) and \
+            op in (A.Op.EQ, A.Op.NEQ, A.Op.REGEX, A.Op.NOT_REGEX,
+                   A.Op.GT, A.Op.GTE, A.Op.LT, A.Op.LTE):
+        return lhs, op, rhs
+    return None
+
+
+def _is_pure_disjunction(e) -> bool:
+    """True when `e` is an OR-tree whose EVERY leaf is itself a single
+    pushable compare — the structural guarantee that the OR of the pushed
+    masks equals the filter exactly. A count heuristic is NOT enough: an
+    AND leaf can push net-one condition via dedup, or a boolean literal
+    can push nothing, silently turning the mask into a superset."""
+    if not (isinstance(e, A.BinaryOp) and e.op == A.Op.OR):
+        return False
+
+    def ok(x) -> bool:
+        if isinstance(x, A.BinaryOp) and x.op == A.Op.OR:
+            return ok(x.lhs) and ok(x.rhs)
+        return _pushable_compare(x) is not None
+
+    return ok(e)
 
 
 def extract_conditions(q: A.Pipeline, start_ns: int = 0,
@@ -64,7 +104,14 @@ def extract_conditions(q: A.Pipeline, start_ns: int = 0,
     if len(filters) != 1 or structural:
         req.all_conditions = False
     for stage in q.stages:
+        before = len(req.conditions)
         _extract_stage(stage, req)
+        if isinstance(stage, A.SpansetFilter) and len(filters) == 1 \
+                and not structural and _is_pure_disjunction(stage.expr):
+            # structurally verified: every OR leaf is ONE pushable
+            # compare, so the OR of the pushed masks IS the filter
+            assert any(c.op is not None for c in req.conditions[before:])
+            req.pure_disjunction = True
     if q.metrics is not None:
         if q.metrics.attr is not None:
             _collect_columns(q.metrics.attr, req)
@@ -130,14 +177,10 @@ def _extract_expr(e, req: FetchSpansRequest, top_level: bool = False) -> None:
             _extract_expr(e.rhs, req)
             return
         # comparison attr <op> static (either side)
-        lhs, rhs, op = e.lhs, e.rhs, e.op
-        if isinstance(rhs, A.Attribute) and isinstance(lhs, A.Static):
-            lhs, rhs = rhs, lhs
-            op = _flip(op)
-        if isinstance(lhs, A.Attribute) and isinstance(rhs, A.Static) and \
-                op in (A.Op.EQ, A.Op.NEQ, A.Op.REGEX, A.Op.NOT_REGEX,
-                       A.Op.GT, A.Op.GTE, A.Op.LT, A.Op.LTE):
-            req.add(Condition(lhs, op, (rhs,)))
+        got = _pushable_compare(e)
+        if got is not None:
+            attr, op, static = got
+            req.add(Condition(attr, op, (static,)))
             return
         # non-pushable comparison: fetch referenced columns, clear the flag
         req.all_conditions = False

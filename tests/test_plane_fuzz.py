@@ -72,7 +72,15 @@ def _filter(rng: random.Random) -> str:
     n = rng.choice([0, 1, 1, 2, 2, 3])
     if n == 0:
         return "{ }"
-    op = " && " if rng.random() < 0.8 else " || "
+    if n >= 3 and rng.random() < 0.3:
+        # mixed AND/OR trees: NOT pure disjunctions — the fused plane must
+        # refuse these (a superset mask would silently corrupt metrics;
+        # the round-5 review found exactly this via crafted dedup shapes)
+        a, b, c = (_pred(rng) for _ in range(3))
+        return rng.choice([f"{{ {a} && ({b} || {c}) }}",
+                           f"{{ ({a} && {b}) || {c} }}",
+                           f"{{ {a} || ({a} && {b}) }}"])
+    op = " && " if rng.random() < 0.7 else " || "
     return "{ " + op.join(_pred(rng) for _ in range(n)) + " }"
 
 

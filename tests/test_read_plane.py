@@ -469,10 +469,12 @@ def test_fallback_cause_counters():
     surface as tempo_read_plane_fallback_total{cause=...}."""
     dev, _ = _race_dbs()
     req = QueryRangeRequest(
-        query='{ name = "op-1" || name = "op-2" } | rate() by (name)',
+        query='{ kind = server && (name = "op-1" || name = "op-2") }'
+              ' | rate() by (name)',
         start_ns=int(T0 * 1e9), end_ns=int((T0 + 100) * 1e9),
         step_ns=int(50e9))
-    dev.query_range("t", req)       # OR filter → not fusable (query shape)
+    dev.query_range("t", req)   # mixed AND/OR → not fusable (query shape;
+    #                             pure disjunctions fuse since round 5)
     assert dev.plane_stats.get("fallback_query_shape", 0) >= 1
     # NaN column values have no consistent order → predicate cause
     rng = np.random.default_rng(23)
@@ -496,3 +498,62 @@ def test_fallback_cause_counters():
     dev2.query_range("t", req2)
     assert dev2.plane_stats.get("fallback_predicate", 0) >= 1, \
         dev2.plane_stats
+
+
+def test_pure_or_filters_fuse_exactly(dbs):
+    """`{ a || b } | rate()` (pure disjunction of pushable compares) rides
+    the fused plane — the OR of exact device terms is exact (round 5);
+    mixed AND/OR trees still fall back to the host's exact second pass."""
+    dev, host = dbs
+    before = dev.plane_stats["fused_metric_blocks"]
+    for q in ('{ name = "op-1" || duration > 400ms } | rate() by (name)',
+              '{ name = "op-0" || name = "op-2" || kind = server }'
+              ' | count_over_time() by (resource.service.name)',
+              '{ span.retries > 4 || status = error }'
+              ' | quantile_over_time(duration, .9) by (name)'):
+        req = QueryRangeRequest(query=q, start_ns=int(T0 * 1e9),
+                                end_ns=int((T0 + 400) * 1e9),
+                                step_ns=int(60e9))
+        a = _series_map(dev.query_range("t", req))
+        b = _series_map(host.query_range("t", req))
+        assert set(a) == set(b), q
+        for k in b:
+            np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-4,
+                                       err_msg=f"{q} {k}")
+    assert dev.plane_stats["fused_metric_blocks"] >= before + 3
+    # mixed tree: NOT a pure disjunction → host fallback stays
+    before_host = dev.plane_stats["host_metric_blocks"]
+    req = QueryRangeRequest(
+        query='{ kind = server && (name = "op-1" || name = "op-2") }'
+              ' | rate() by (name)',
+        start_ns=int(T0 * 1e9), end_ns=int((T0 + 400) * 1e9),
+        step_ns=int(60e9))
+    a = _series_map(dev.query_range("t", req))
+    b = _series_map(host.query_range("t", req))
+    assert set(a) == set(b)
+    for k in b:
+        np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-4)
+    assert dev.plane_stats["host_metric_blocks"] > before_host
+
+
+def test_pure_disjunction_rejects_spoofed_shapes(dbs):
+    """OR trees whose leaves are NOT single pushable compares must stay on
+    the host's exact second pass — the round-5 review crafted shapes where
+    a count heuristic certified a SUPERSET mask as exact (dedup'd AND arm,
+    zero-push boolean literal). Parity + routing pinned here."""
+    dev, host = dbs
+    before_host = dev.plane_stats["host_metric_blocks"]
+    for q in ('{ name = "op-1" || (name = "op-1" && kind = server) }'
+              ' | rate() by (name)',
+              '{ (name = "op-1" && false) || kind = server }'
+              ' | rate() by (name)'):
+        req = QueryRangeRequest(query=q, start_ns=int(T0 * 1e9),
+                                end_ns=int((T0 + 400) * 1e9),
+                                step_ns=int(60e9))
+        a = _series_map(dev.query_range("t", req))
+        b = _series_map(host.query_range("t", req))
+        assert set(a) == set(b), q
+        for k in b:
+            np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-4,
+                                       err_msg=f"{q} {k}")
+    assert dev.plane_stats["host_metric_blocks"] >= before_host + 2
