@@ -501,19 +501,31 @@ class Frontend:
         self.slos.record("metadata", tenant, self.now() - t0, 0)
         return merged
 
-    def tag_values(self, tenant: str, name: str, limit: int = 1000) -> list[dict]:
+    def tag_values(self, tenant: str, name: str, limit: int = 1000,
+                   on_partial: Callable[[list], None] | None = None
+                   ) -> list[dict]:
         t0 = self.now()
         out: list[dict] = []
         seen: set = set()
-        for t in split_tenants(tenant):
-            # each tenant is asked for the FULL limit: cross-tenant
-            # duplicates collapse in `seen`, so a smaller ask could
-            # starve distinct values hiding behind shared ones
-            for v in self.querier.tag_values(t, name, limit):
+
+        def fold(values: list[dict]) -> None:
+            for v in values:
                 key = (v.get("type"), v.get("value"))
                 if key not in seen:
                     seen.add(key)
                     out.append(v)
+
+        def hook(partial: list[dict]) -> None:
+            fold(partial)
+            on_partial(out[:limit])
+
+        for t in split_tenants(tenant):
+            # each tenant is asked for the FULL limit: cross-tenant
+            # duplicates collapse in `seen`, so a smaller ask could
+            # starve distinct values hiding behind shared ones
+            fold(self.querier.tag_values(
+                t, name, limit,
+                on_partial=hook if on_partial is not None else None))
         self.slos.record("metadata", tenant, self.now() - t0, 0)
         return out[:limit]
 

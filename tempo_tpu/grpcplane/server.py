@@ -343,6 +343,32 @@ class _Services:
             lambda batch: _jdump({"scopes": batch, "final": False}),
             lambda res: _jdump({"scopes": res or {}, "final": True}))
 
+    def streaming_search_tag_values(self, request: bytes, context):
+        """Server-streaming tag-value autocomplete: value diffs as the
+        ingester pass merges in, then the final list
+        (`StreamingQuerier/SearchTagValues`)."""
+        tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
+        d = _jload(request)
+        sent: set = set()
+
+        def run_fn(emit):
+            def on_partial(values: list) -> None:
+                fresh = [v for v in values
+                         if (v.get("type"), v.get("value")) not in sent]
+                if fresh:
+                    sent.update((v.get("type"), v.get("value"))
+                                for v in fresh)
+                    emit(fresh)
+
+            return self.app.frontend.tag_values(
+                tenant, d["name"], int(d.get("limit", 1000)),
+                on_partial=on_partial)
+
+        yield from self._stream_partials(
+            context, run_fn,
+            lambda batch: _jdump({"tagValues": batch, "final": False}),
+            lambda res: _jdump({"tagValues": res or [], "final": True}))
+
     # -- Frontend worker-pull dispatch --------------------------------------
 
     def frontend_process(self, request_iterator, context):
@@ -489,7 +515,9 @@ def build_grpc_server(app, address: str = "127.0.0.1:0",
             "tempopb.StreamingQuerier",
             {"Search": sstream(svc.streaming_search),
              "MetricsQueryRange": sstream(svc.streaming_metrics_query_range),
-             "SearchTags": sstream(svc.streaming_search_tags)}),))
+             "SearchTags": sstream(svc.streaming_search_tags),
+             "SearchTagValues": sstream(
+                 svc.streaming_search_tag_values)}),))
         server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
             "tempopb.Frontend", {"Process": bidi(svc.frontend_process)}),))
     port = server.add_insecure_port(address)

@@ -164,9 +164,12 @@ class Querier:
                 on_partial(snap())
         return snap()
 
-    def tag_values(self, tenant: str, name: str, limit: int = 1000) -> list[dict]:
+    def tag_values(self, tenant: str, name: str, limit: int = 1000,
+                   on_partial=None) -> list[dict]:
         """Autocomplete values: ingester recent data + backend block scans,
-        deduped (`ExecuteTagValues` fan-out, querier side)."""
+        deduped (`ExecuteTagValues` fan-out, querier side). `on_partial`
+        receives the current snapshot after the ingester pass (the
+        streaming SearchTagValues feed)."""
         from tempo_tpu.traceql.engine import execute_tag_values, tag_values_request
 
         seen: dict[str, dict] = {}
@@ -177,6 +180,8 @@ class Querier:
                     continue
                 for v in client.tag_values(tenant, name, limit):
                     seen.setdefault(v["value"], v)
+            if on_partial is not None and seen:
+                on_partial(list(seen.values())[:limit])
         req = tag_values_request(name)
         # ride the plane cache's retained views when a block is ALREADY
         # resident (autocomplete repeats per keystroke); cold blocks take

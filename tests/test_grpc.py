@@ -475,3 +475,21 @@ def test_grpc_streaming_search_tags(grpc_cluster):
     assert "http.status_code" in scopes.get("span", [])
     # at least one pre-final diff arrived (the ingester pass)
     assert len(msgs) >= 2 and msgs[0][1] is False
+
+
+def test_grpc_streaming_search_tag_values(grpc_cluster):
+    apps, ports = grpc_cluster
+    t0 = int(time.time() - 5) * 10**9
+    body = _otlp_json_to_proto(_otlp("bb" * 16, t0, name="tv-op"))
+    with grpc.insecure_channel(f"127.0.0.1:{ports['dist']}") as ch:
+        ch.unary_unary(
+            "/opentelemetry.proto.collector.trace.v1.TraceService/Export"
+        )(body, timeout=10)
+    with grpc.insecure_channel(f"127.0.0.1:{ports['query']}") as ch:
+        fn = ch.unary_stream("/tempopb.StreamingQuerier/SearchTagValues")
+        msgs = [json.loads(m) for m in fn(
+            json.dumps({"name": ".http.status_code"}).encode(), timeout=30,
+            metadata=(("x-scope-orgid", "single-tenant"),))]
+    assert msgs[-1]["final"] is True
+    assert any(v["value"] == "200" for v in msgs[-1]["tagValues"])
+    assert len(msgs) >= 2 and msgs[0]["final"] is False
