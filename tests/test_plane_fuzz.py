@@ -149,9 +149,14 @@ def test_fuzz_query_range_parity(fuzz_dbs):
     rng = random.Random(SEED)
     for case in range(N_QUERIES):
         q = _metrics(rng)
-        req = QueryRangeRequest(query=q, start_ns=int(T0 * 1e9),
-                                end_ns=int((T0 + 900) * 1e9),
-                                step_ns=int(rng.choice([30, 60, 300]) * 1e9))
+        # random windows: offset starts exercise the q_steps/frac split of
+        # the exact bucketing, sub-windows exercise the clip terms
+        w0 = T0 + rng.choice([0, -120, 37, 333, 701])
+        w1 = w0 + rng.choice([900, 301, 1500, 83])
+        req = QueryRangeRequest(query=q, start_ns=int(w0 * 1e9),
+                                end_ns=int(w1 * 1e9),
+                                step_ns=int(rng.choice([30, 60, 300, 7])
+                                            * 1e9))
         ctx = f"seed={SEED} case={case} query={q!r}"
         try:
             a = _smap(dev.query_range("t", req))
