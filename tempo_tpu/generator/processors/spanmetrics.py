@@ -21,6 +21,7 @@ series for <1%-error quantiles (the sketch plane the reference lacks).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Sequence
 
 import jax
@@ -70,16 +71,18 @@ def _fused_update(calls, latency, sizes, dd, slots, dur_s, size_bytes, weights):
     return calls, latency, sizes, dd
 
 
-@jax.jit
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
 def _fused_update_packed(calls, latency, sizes, dd, packed, weights):
     """`_fused_update` with (slots, dur_s, size_bytes) packed into ONE
     [3, cap] f32 H2D transfer (the staged fast paths): behind a
     high-latency device link the per-push transfer COUNT is the cost, not
     the bytes. Slots ride f32 exactly while the SERIES TABLE capacity is
     below 2^24 (the caller gates on that); weights are the cached device
-    ones-vector, uploaded once. No buffer donation: the collection loop
-    reads the same state arrays from its own thread, and a donated input
-    would be deleted out from under it."""
+    ones-vector, uploaded once. States are DONATED — a non-donating
+    update copies the full state (the DDSketch plane alone is ~85MB at
+    default capacity) every push; the caller holds the registry's
+    state_lock across dispatch+rebind so the collection thread can never
+    observe a donated-dead buffer."""
     slots = packed[0].astype(jax.numpy.int32)
     return _fused_update(calls, latency, sizes, dd, slots, packed[1],
                          packed[2], weights)
@@ -214,12 +217,15 @@ class SpanMetricsProcessor:
             # single packed H2D for (slots, dur, sizes) — f32 holds every
             # possible SLOT ID exactly while the series-table capacity
             # stays below 2^24 (slot values, not batch length, are what
-            # round-trip through f32)
+            # round-trip through f32). The state_lock spans the DONATING
+            # dispatch + rebind: collect() on the collection thread takes
+            # the same lock, so it can never read a donated-dead buffer.
             packed[0] = slots
-            (self.calls.state, self.latency.state, self.sizes.state,
-             self.dd) = _fused_update_packed(
-                self.calls.state, self.latency.state, self.sizes.state,
-                self.dd, packed, ones)
+            with self.registry.state_lock:
+                (self.calls.state, self.latency.state, self.sizes.state,
+                 self.dd) = _fused_update_packed(
+                    self.calls.state, self.latency.state, self.sizes.state,
+                    self.dd, packed, ones)
         else:
             (self.calls.state, self.latency.state, self.sizes.state,
              self.dd) = _fused_update(
@@ -293,16 +299,23 @@ class SpanMetricsProcessor:
     # -- sketch quantiles ---------------------------------------------------
 
     def quantile(self, q: float) -> dict[tuple[tuple[str, str], ...], float]:
-        """Per-series latency quantile from the DDSketch plane (<1% error)."""
+        """Per-series latency quantile from the DDSketch plane (<1% error).
+        Takes the registry state lock: the packed ingest path DONATES the
+        previous dd buffers at dispatch."""
         if self.dd is None:
             return {}
         # The sketch plane may be smaller than the series table
         # (sketch_max_series < max_active_series); slots beyond it were
-        # masked out of dd_update and have no quantile.
-        nrows = self.dd.counts.shape[0]
+        # masked out of dd_update and have no quantile. The whole device
+        # read happens INSIDE the lock: donation deletes the old buffers
+        # at the next push's dispatch no matter who still references them,
+        # so an out-of-lock np.asarray on a snapshot is not safe.
+        with self.registry.state_lock:
+            dd = self.dd
+            nrows = dd.counts.shape[0]
+            vals = np.asarray(sketches.dd_quantile(dd, q))
         slots = self.calls.table.active_slots()
         slots = slots[slots < nrows]
-        vals = np.asarray(sketches.dd_quantile(self.dd, q))
         return {self.calls.labels_of(int(s)): float(vals[int(s)]) for s in slots}
 
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import threading
 import time
 from typing import Callable, Iterable, Sequence
 
@@ -131,8 +132,11 @@ class Counter(_MetricBase):
         row = self.registry.interner.intern_many(label_values)[None, :]
         self.inc_batch(row, np.array([value], np.float32))
 
-    def collect(self, ts_ms: int) -> list[Sample]:
-        vals = np.asarray(self.state.values)
+    def _snap(self) -> tuple:
+        return (np.asarray(self.state.values),)
+
+    def collect(self, ts_ms: int, snap: tuple | None = None) -> list[Sample]:
+        (vals,) = snap if snap is not None else self._snap()
         out = [Sample(self.name, self.labels_of(s), float(vals[s]), ts_ms,
                       exemplar=self.exemplars.get(s))
                for s in self.table.active_slots().tolist()]
@@ -162,8 +166,11 @@ class Gauge(_MetricBase):
         row = self.registry.interner.intern_many(label_values)[None, :]
         self.set_batch(row, np.array([value], np.float32))
 
-    def collect(self, ts_ms: int) -> list[Sample]:
-        vals = np.asarray(self.state.values)
+    def _snap(self) -> tuple:
+        return (np.asarray(self.state.values),)
+
+    def collect(self, ts_ms: int, snap: tuple | None = None) -> list[Sample]:
+        (vals,) = snap if snap is not None else self._snap()
         out = [Sample(self.name, self.labels_of(s), float(vals[s]), ts_ms)
                for s in self.table.active_slots().tolist()]
         return out + self._drain_stale_markers(ts_ms)
@@ -188,10 +195,12 @@ class Histogram(_MetricBase):
         row = self.registry.interner.intern_many(label_values)[None, :]
         self.observe_batch(row, np.array([value], np.float32))
 
-    def collect(self, ts_ms: int) -> list[Sample]:
-        bc = np.asarray(self.state.bucket_counts)
-        sums = np.asarray(self.state.sums)
-        counts = np.asarray(self.state.counts)
+    def _snap(self) -> tuple:
+        return (np.asarray(self.state.bucket_counts),
+                np.asarray(self.state.sums), np.asarray(self.state.counts))
+
+    def collect(self, ts_ms: int, snap: tuple | None = None) -> list[Sample]:
+        bc, sums, counts = snap if snap is not None else self._snap()
         out: list[Sample] = []
         edges = self.state.edges
         for s in self.table.active_slots().tolist():
@@ -223,11 +232,13 @@ class NativeHistogram(_MetricBase):
         self.state = m.native_histogram_update(self.state, slots, values, weights, None)
         return slots
 
-    def collect(self, ts_ms: int) -> list[Sample]:
+    def _snap(self) -> tuple:
+        return (np.asarray(self.state.sums), np.asarray(self.state.counts))
+
+    def collect(self, ts_ms: int, snap: tuple | None = None) -> list[Sample]:
         # Scalar samples for visibility; the remote-write encoder additionally
         # reads `native_payload()` for real native-histogram protos.
-        sums = np.asarray(self.state.sums)
-        counts = np.asarray(self.state.counts)
+        sums, counts = snap if snap is not None else self._snap()
         out = []
         for s in self.table.active_slots().tolist():
             base = self.labels_of(s)
@@ -262,6 +273,12 @@ class ManagedRegistry:
         self.now = now
         self.budget = SeriesBudget(self.overrides.max_active_series)
         self._metrics: dict[str, _MetricBase] = {}
+        # serializes device-state REBINDS that donate the old buffers
+        # (the packed ingest fast path) against state READERS (collect /
+        # native_histograms / purge's zero_slots): a donated input is
+        # DELETED at dispatch, so an unlocked concurrent np.asarray on the
+        # collection thread would hit a dead array
+        self.state_lock = threading.Lock()
 
     # -- family constructors ----------------------------------------------
 
@@ -314,9 +331,15 @@ class ManagedRegistry:
         if self.overrides.disable_collection:
             return []
         ts = int(self.now() * 1000) if ts_ms is None else ts_ms
+        # ONLY the device snapshots sit under the lock (they are what a
+        # donating push would invalidate); the per-sample formatting —
+        # the bulk of the tick at high cardinality — runs outside so
+        # ingest never stalls behind it
+        with self.state_lock:
+            snaps = [(mt, mt._snap()) for mt in self._metrics.values()]
         out: list[Sample] = []
-        for mt in self._metrics.values():
-            out.extend(mt.collect(ts))
+        for mt, snap in snaps:
+            out.extend(mt.collect(ts, snap))
         return out
 
     def purge_stale(self) -> int:
@@ -338,10 +361,13 @@ class ManagedRegistry:
             # pad to a small set of static shapes to bound recompiles
             padded = np.full(_pad_len(stale.size), table.capacity, np.int32)
             padded[: stale.size] = stale
-            for mt in fams:
-                mt.note_stale(stale)
-                mt.state = m.zero_slots(mt.state, padded)
-            table.purge_stale(cutoff)
+            # one lock over the WHOLE shared-table eviction: a concurrent
+            # collect must never see the slot-aligned trio half-zeroed
+            with self.state_lock:
+                for mt in fams:
+                    mt.note_stale(stale)
+                    mt.state = m.zero_slots(mt.state, padded)
+                table.purge_stale(cutoff)
             total += stale.size
         return total
 
@@ -350,11 +376,12 @@ class ManagedRegistry:
         native-histogram series, in the shape encode_write_request consumes."""
         ts = int(self.now() * 1000) if ts_ms is None else ts_ms
         out = []
-        for mt in self._metrics.values():
-            payload = getattr(mt, "native_payload", None)
-            if payload is None:
-                continue
-            slots, labels, hists, sums, counts, zeros = payload()
+        with self.state_lock:
+            payloads = [(mt, getattr(mt, "native_payload", None))
+                        for mt in self._metrics.values()]
+            payloads = [(mt, p()) for mt, p in payloads if p is not None]
+        for mt, payload in payloads:
+            slots, labels, hists, sums, counts, zeros = payload
             offset = mt.state.hist.offset
             for i in range(len(labels)):
                 out.append((labels[i], hists[i], float(sums[i]),

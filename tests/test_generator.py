@@ -360,3 +360,49 @@ def test_staged_fast_path_slack_filter_counts():
     gen.push_otlp("t", payload)
     assert inst.spans_filtered_slack == 512
     assert inst.spans_received == 512
+
+
+def test_donating_push_vs_concurrent_collection():
+    """The packed fast path DONATES state buffers; collect()/
+    native_histograms()/quantile() run on the collection thread and must
+    serialize on the registry state_lock — an unguarded reader dies with
+    'Array has been deleted' (caught live by this hammer before the
+    quantile read moved inside the lock)."""
+    import threading
+
+    import bench as _bench
+    from tempo_tpu.generator.generator import Generator
+    from tempo_tpu.generator.instance import GeneratorConfig
+    from tempo_tpu.overrides import Overrides
+
+    payload = _bench._make_otlp_payload(2048, seed=8)
+    gen = Generator(GeneratorConfig(processors=("span-metrics",)),
+                    overrides=Overrides())
+    gen.push_otlp("t", payload)
+    inst = gen.instance("t")
+    proc = inst.processors["span-metrics"]
+    # the hammer is vacuous unless the DONATING staged path is live
+    assert proc.supports_staged_fast_path()
+    assert inst.push_otlp_staged(payload) is not None
+    stop = threading.Event()
+    errs: list = []
+
+    def collector():
+        while not stop.is_set():
+            try:
+                inst.registry.collect(1000)
+                inst.registry.native_histograms(1000)
+                proc.quantile(0.99)
+            except Exception as e:      # pragma: no cover - the regression
+                errs.append(repr(e))
+                return
+
+    t = threading.Thread(target=collector)
+    t.start()
+    try:
+        for _ in range(40):
+            gen.push_otlp("t", payload)
+    finally:
+        stop.set()
+        t.join()
+    assert not errs, errs[:3]
