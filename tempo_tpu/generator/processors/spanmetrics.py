@@ -58,8 +58,8 @@ class SpanMetricsConfig:
     subprocessors: tuple[str, ...] = ("count", "latency", "size")
 
 
-@jax.jit
-def _fused_update(calls, latency, sizes, dd, slots, dur_s, size_bytes, weights):
+def _fused_update_impl(calls, latency, sizes, dd, slots, dur_s, size_bytes,
+                       weights):
     """One device step for all spanmetrics families (slots shared)."""
     calls = rm.counter_update(calls, slots, weights)
     latency = rm.histogram_update(latency, slots, dur_s, weights)
@@ -69,6 +69,16 @@ def _fused_update(calls, latency, sizes, dd, slots, dur_s, size_bytes, weights):
         dd = sketches.dd_update(dd, jax.numpy.where(keep, slots, 0), dur_s,
                                 mask=keep, weights=weights)
     return calls, latency, sizes, dd
+
+
+# non-donating variant (kept for API symmetry/debugging; every product
+# push path below uses the donating forms under the registry state_lock)
+_fused_update = jax.jit(_fused_update_impl)
+# donating variant for the product push paths: without donation every
+# push COPIES the full functional state (~90MB with the default DDSketch
+# plane). Callers MUST hold the registry state_lock across call+rebind.
+_fused_update_donated = jax.jit(_fused_update_impl,
+                                donate_argnums=(0, 1, 2, 3))
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
@@ -84,8 +94,8 @@ def _fused_update_packed(calls, latency, sizes, dd, packed, weights):
     state_lock across dispatch+rebind so the collection thread can never
     observe a donated-dead buffer."""
     slots = packed[0].astype(jax.numpy.int32)
-    return _fused_update(calls, latency, sizes, dd, slots, packed[1],
-                         packed[2], weights)
+    return _fused_update_impl(calls, latency, sizes, dd, slots, packed[1],
+                              packed[2], weights)
 
 
 class SpanMetricsProcessor:
@@ -227,10 +237,14 @@ class SpanMetricsProcessor:
                     self.calls.state, self.latency.state, self.sizes.state,
                     self.dd, packed, ones)
         else:
-            (self.calls.state, self.latency.state, self.sizes.state,
-             self.dd) = _fused_update(
-                self.calls.state, self.latency.state, self.sizes.state,
-                self.dd, slots, packed[1], packed[2], ones)
+            # same donation + lock discipline as the packed branch — an
+            # unlocked non-donating dispatch here could read buffers the
+            # dict route just donated
+            with self.registry.state_lock:
+                (self.calls.state, self.latency.state, self.sizes.state,
+                 self.dd) = _fused_update_donated(
+                    self.calls.state, self.latency.state, self.sizes.state,
+                    self.dd, slots, packed[1], packed[2], ones)
         self.calls.note_exemplars(slots[:n], trace_ids, packed[1],
                                   int(now * 1000))
         self.latency.exemplars = self.calls.exemplars
@@ -286,9 +300,12 @@ class SpanMetricsProcessor:
         if self.cfg.span_multiplier_key:
             mult = _attr_fval(sb, self.cfg.span_multiplier_key)
             weights = np.where(mult > 0, mult, 1.0).astype(np.float32)
-        self.calls.state, self.latency.state, self.sizes.state, self.dd = _fused_update(
-            self.calls.state, self.latency.state, self.sizes.state, self.dd,
-            slots, dur_s, span_sizes.astype(np.float32), weights)
+        with self.registry.state_lock:
+            (self.calls.state, self.latency.state, self.sizes.state,
+             self.dd) = _fused_update_donated(
+                self.calls.state, self.latency.state, self.sizes.state,
+                self.dd, slots, dur_s, span_sizes.astype(np.float32),
+                weights)
         ts_ms = int(self.registry.now() * 1000)
         self.calls.note_exemplars(slots, sb.trace_id, dur_s, ts_ms)
         self.latency.exemplars = self.calls.exemplars

@@ -400,8 +400,14 @@ def test_donating_push_vs_concurrent_collection():
     t = threading.Thread(target=collector)
     t.start()
     try:
-        for _ in range(40):
+        for i in range(40):
             gen.push_otlp("t", payload)
+            if i % 8 == 0:      # the dict route donates too (push_batch)
+                gen.push_spans("t", [{
+                    "trace_id": b"\x01" * 16, "span_id": bytes([i]) * 8,
+                    "name": "d", "service": "s", "kind": 2,
+                    "status_code": 0, "start_unix_nano": 1,
+                    "end_unix_nano": 2}])
     finally:
         stop.set()
         t.join()
