@@ -95,7 +95,10 @@ def _product_block(n=10_000):
             "kind": int(i % 6), "status_code": int(i % 3),
             "start_unix_nano": start,
             "end_unix_nano": start + int(rng.lognormal(16, 1.2)),
-            "attrs": {"http.status_code": 200 + (i % 300)},
+            "attrs": ({"http.status_code": 200 + (i % 300),
+                       "ratio": [0.5, 1.5, -2.25][i % 3]}
+                      if i % 4 else
+                      {"http.status_code": 200 + (i % 300)}),
         }]))
     return be, traces, T0
 
@@ -132,7 +135,12 @@ def test_sharded_plane_query_range_product_parity():
               ' by (resource.service.name)',
               '{ span.http.status_code >= 400 } | rate() by (name)',
               '{ } | avg_over_time(duration) by (resource.service.name)',
-              '{ } | rate() by (resource.service.name, name)'):
+              '{ } | rate() by (resource.service.name, name)',
+              # round-5 features under the mesh: float-attr compares on
+              # the sortable-int64 encoding + pure-OR fusion
+              '{ span.ratio > 0.5 } | rate() by (name)',
+              '{ span.ratio = -2.25 || name = "op-3" }'
+              ' | count_over_time() by (name)'):
         req = QueryRangeRequest(query=q, start_ns=int(T0 * 1e9),
                                 end_ns=int((T0 + 600) * 1e9),
                                 step_ns=int(60e9))
