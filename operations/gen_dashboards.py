@@ -118,6 +118,9 @@ def dashboards() -> dict[str, dict]:
                 p("Plane cache host bytes",
                   "tempo_read_plane_cache_host_bytes"),
                 p("Plane cache entries", "tempo_read_plane_cache_entries"),
+                p("Host fallbacks /s by cause",
+                  _rate("tempo_read_plane_fallback_total", "cause"),
+                  legend="{{cause}}"),
             ]),
         "tempo-tpu-writes.json": dash(
             "Tempo-TPU / Writes",
