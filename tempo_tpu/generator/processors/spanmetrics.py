@@ -71,19 +71,17 @@ def _fused_update_impl(calls, latency, sizes, dd, slots, dur_s, size_bytes,
     return calls, latency, sizes, dd
 
 
-# non-donating variant (kept for API symmetry/debugging; every product
-# push path below uses the donating forms under the registry state_lock)
-_fused_update = jax.jit(_fused_update_impl)
-# donating variant for the product push paths: without donation every
-# push COPIES the full functional state (~90MB with the default DDSketch
-# plane). Callers MUST hold the registry state_lock across call+rebind.
+# donating jit of the fused step: without donation every push COPIES the
+# full functional state (~90MB with the default DDSketch plane). Callers
+# MUST hold the registry state_lock across call+rebind — donation deletes
+# the input buffers at dispatch for any concurrent reader.
 _fused_update_donated = jax.jit(_fused_update_impl,
                                 donate_argnums=(0, 1, 2, 3))
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
 def _fused_update_packed(calls, latency, sizes, dd, packed, weights):
-    """`_fused_update` with (slots, dur_s, size_bytes) packed into ONE
+    """The fused step with (slots, dur_s, size_bytes) packed into ONE
     [3, cap] f32 H2D transfer (the staged fast paths): behind a
     high-latency device link the per-push transfer COUNT is the cost, not
     the bytes. Slots ride f32 exactly while the SERIES TABLE capacity is
