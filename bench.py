@@ -330,6 +330,108 @@ def bench_query() -> dict:
             **scan}
 
 
+def bench_obs() -> dict:
+    """Self-telemetry cost: instrumentation overhead on the distributor
+    push hot path (obs registry enabled vs `Registry(enabled=False)`
+    handing out no-op instruments — target <3%) and `/metrics` scrape
+    latency over a fully wired `target=all` process."""
+    import socket
+    import statistics
+    import tempfile
+    import urllib.request
+
+    from tempo_tpu.distributor import Distributor
+    from tempo_tpu.obs import Registry
+    from tempo_tpu.overrides import Overrides
+    from tempo_tpu.ring import ACTIVE, InstanceDesc, Ring
+    from tempo_tpu.ring.ring import _instance_tokens
+
+    n_spans = 16384
+    payload = _make_otlp_payload(n_spans)
+
+    class _NullIng:
+        def push(self, tenant, traces):
+            return [None] * len(traces)
+
+        def push_otlp(self, tenant, payload):
+            return {}
+
+    def make_dist(reg: Registry) -> Distributor:
+        now = time.time
+        iring = Ring(replication_factor=1, now=now)
+        iring.register(InstanceDesc(id="i0", state=ACTIVE,
+                                    tokens=_instance_tokens("i0", 64),
+                                    heartbeat_ts=now()))
+        ov = Overrides()
+        ov.set_tenant_patch("bench", {"ingestion": {
+            "rate_limit_bytes": 1 << 40, "burst_size_bytes": 1 << 40}})
+        return Distributor(iring, {"i0": _NullIng()}, overrides=ov,
+                           registry=reg, now=now)
+
+    # A/B alternating pairs + per-arm MEDIAN: the instrumentation delta
+    # (one histogram observe per 16k-span push) is micro-seconds against
+    # multi-ms pushes, so GC pauses and CPU-frequency drift would swamp a
+    # mean — the median per-push time is the honest comparison
+    inst, noop = make_dist(Registry()), make_dist(Registry(enabled=False))
+    inst.push_otlp("bench", payload)    # warm the native scan + limiter
+    noop.push_otlp("bench", payload)
+    iters = 30
+    t_inst: list[float] = []
+    t_noop: list[float] = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        inst.push_otlp("bench", payload)
+        t_inst.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        noop.push_otlp("bench", payload)
+        t_noop.append(time.perf_counter() - t0)
+    med_inst = statistics.median(t_inst)
+    med_noop = statistics.median(t_noop)
+    out = {
+        "obs_push_instrumented_spans_per_sec": n_spans / med_inst,
+        "obs_push_noop_spans_per_sec": n_spans / med_noop,
+        "obs_push_overhead_pct": (med_inst - med_noop) / med_noop * 100.0,
+    }
+
+    # -- /metrics scrape cost: full process, real HTTP GET ---------------
+    from tempo_tpu.app import App
+    from tempo_tpu.app.api import serve
+    from tempo_tpu.app.config import Config
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    with tempfile.TemporaryDirectory() as tmp:
+        cfg = Config(target="all")
+        cfg.storage.backend = "mem"
+        cfg.storage.wal_path = os.path.join(tmp, "wal")
+        cfg.generator.localblocks.data_dir = os.path.join(tmp, "lb")
+        cfg.server.http_listen_port = port
+        app = App(cfg)
+        app.overrides.set_tenant_patch("single-tenant", {"ingestion": {
+            "rate_limit_bytes": 1 << 40, "burst_size_bytes": 1 << 40}})
+        srv = serve(app, block=False)
+        try:
+            # populate the families a loaded process would carry
+            app.distributor.push_otlp("single-tenant",
+                                      _make_otlp_payload(2048, seed=1))
+            url = f"http://127.0.0.1:{port}/metrics"
+            urllib.request.urlopen(url, timeout=10).read()   # warmup
+            times = []
+            nbytes = 0
+            for _ in range(50):
+                t0 = time.perf_counter()
+                nbytes = len(urllib.request.urlopen(url, timeout=10).read())
+                times.append(time.perf_counter() - t0)
+            out["obs_scrape_ms"] = statistics.median(times) * 1000
+            out["obs_scrape_bytes"] = nbytes
+        finally:
+            srv.shutdown()
+            app.shutdown()
+    return out
+
+
 def _bench_scan_plane(db) -> dict:
     """Fetch-path predicate plane on ≥1M spans scanned from the written
     block: the device-resident BlockScanPlane (dictionary-coded columns
@@ -418,7 +520,7 @@ def _bench_scan_plane(db) -> dict:
 # --- orchestrator ----------------------------------------------------------
 
 STAGES = {"e2e": bench_e2e_ingest, "kernel": bench_kernel,
-          "query": bench_query}
+          "query": bench_query, "obs": bench_obs}
 
 
 def _cpu_env(env: dict) -> dict:
@@ -679,6 +781,18 @@ def main() -> int:
         # device-vs-host parity evidence for the scan + metrics planes
         "scan_masks_equal": results.get("scan_masks_equal"),
         "qr_grids_equal": results.get("qr_grids_equal"),
+        # self-telemetry cost (ISSUE 1 satellite: push-path overhead <3%)
+        "obs_push_overhead_pct": round(results["obs_push_overhead_pct"], 3)
+        if "obs_push_overhead_pct" in results else None,
+        "obs_push_instrumented_spans_per_sec": round(
+            results["obs_push_instrumented_spans_per_sec"], 1)
+        if "obs_push_instrumented_spans_per_sec" in results else None,
+        "obs_push_noop_spans_per_sec": round(
+            results["obs_push_noop_spans_per_sec"], 1)
+        if "obs_push_noop_spans_per_sec" in results else None,
+        "obs_scrape_ms": round(results["obs_scrape_ms"], 3)
+        if "obs_scrape_ms" in results else None,
+        "obs_scrape_bytes": results.get("obs_scrape_bytes"),
     }
     if errors:
         extra["errors"] = errors

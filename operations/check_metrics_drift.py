@@ -1,0 +1,45 @@
+#!/usr/bin/env python3
+"""Alert/dashboard ↔ metrics-registry drift gate (CLI).
+
+Boots a `target=all` in-memory App, collects every metric family name
+registered in its obs registry (plus the process-wide JAX runtime
+registry), and fails if `alerts.yaml` or any dashboard references a
+`tempo_*` metric the process would never expose. Run standalone or via
+`python operations/gen_dashboards.py --check` (which chains into this).
+
+Usage: python operations/check_metrics_drift.py
+Exit codes: 0 clean, 1 drift found.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OPS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def main() -> int:
+    from tempo_tpu.obs import drift
+
+    registries, app = drift.default_registries()
+    try:
+        problems = drift.check_drift(OPS_DIR, registries)
+    finally:
+        app.shutdown()
+    if problems:
+        print("METRIC DRIFT:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        print("register the metric in tempo_tpu/obs (module families) or "
+              "fix the alert/dashboard expression", file=sys.stderr)
+        return 1
+    n = len(drift.referenced_metric_names(OPS_DIR))
+    print(f"ok: {n} referenced metric names all registered")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,13 @@ def _ratio(hit: str, miss: str, win: str = "5m") -> str:
             f" + rate({miss}[{win}]))")
 
 
+def _p99(metric: str, by: str | None = None, win: str = "5m") -> str:
+    """p99 from an obs-registry histogram's cumulative buckets."""
+    grp = f"le, {by}" if by else "le"
+    return (f"histogram_quantile(0.99, "
+            f"sum(rate({metric}_bucket[{win}])) by ({grp}))")
+
+
 def dashboards() -> dict[str, dict]:
     slo_ratio = (
         "sum(rate(tempo_query_frontend_queries_within_slo_total[5m])) by (op)"
@@ -94,6 +101,14 @@ def dashboards() -> dict[str, dict]:
                 p("Data-quality warnings /s",
                   _rate("tempo_warnings_total", "reason"),
                   legend="{{reason}}"),
+                p("HTTP p99 latency by route",
+                  _p99("tempo_request_duration_seconds", "route"),
+                  legend="{{route}}"),
+                p("gRPC p99 latency by method",
+                  _p99("tempo_grpc_request_duration_seconds", "method"),
+                  legend="{{method}}"),
+                p("Distributor push p99",
+                  _p99("tempo_distributor_push_duration_seconds")),
             ]),
         "tempo-tpu-reads.json": dash(
             "Tempo-TPU / Reads",
@@ -121,6 +136,16 @@ def dashboards() -> dict[str, dict]:
                 p("Host fallbacks /s by cause",
                   _rate("tempo_read_plane_fallback_total", "cause"),
                   legend="{{cause}}"),
+                p("Frontend op p99 latency",
+                  _p99("tempo_query_frontend_request_duration_seconds",
+                       "op"), legend="{{op}}"),
+                p("Queue wait p99",
+                  _p99("tempo_query_frontend_queue_wait_seconds")),
+                p("Block-scan p99 by op",
+                  _p99("tempo_querier_block_scan_duration_seconds", "op"),
+                  legend="{{op}}"),
+                p("Query shard fan-out p99",
+                  _p99("tempo_query_frontend_shard_fanout")),
             ]),
         "tempo-tpu-writes.json": dash(
             "Tempo-TPU / Writes",
@@ -147,6 +172,13 @@ def dashboards() -> dict[str, dict]:
                         "tenant")),
                 p("Data-quality warnings /s",
                   _rate("tempo_warnings_total", "reason")),
+                p("Push p99 latency",
+                  _p99("tempo_distributor_push_duration_seconds")),
+                p("Ingester cut p99",
+                  _p99("tempo_ingester_cut_duration_seconds")),
+                p("Ingester flush p99 by op",
+                  _p99("tempo_ingester_flush_duration_seconds", "op"),
+                  legend="{{op}}"),
             ]),
         "tempo-tpu-resources.json": dash(
             "Tempo-TPU / Resources",
@@ -167,6 +199,20 @@ def dashboards() -> dict[str, dict]:
                   _rate("tempo_distributor_bytes_received_total")),
                 p("Usage-stats reports written",
                   "tempo_usage_stats_reports_written_total", kind="stat"),
+                p("JIT compiles /h by function",
+                  _rate("tempo_jax_jit_compile_total", "fn", win="1h"),
+                  legend="{{fn}}"),
+                p("JIT compile seconds /h",
+                  _rate("tempo_jax_jit_compile_seconds_total", win="1h")),
+                p("Device uploads MB/s",
+                  "sum(rate(tempo_jax_device_put_bytes_total[5m])) / 1e6"),
+                p("Device kernel p99 by kernel",
+                  _p99("tempo_jax_kernel_duration_seconds", "kernel"),
+                  legend="{{kernel}}"),
+                p("Generator collect p99",
+                  _p99("tempo_metrics_generator_collect_duration_seconds")),
+                p("Compaction cycle p99",
+                  _p99("tempo_compactor_cycle_duration_seconds")),
             ]),
     }
 
@@ -189,6 +235,15 @@ def main() -> int:
         print(f"DRIFT: {drift} — run python operations/gen_dashboards.py",
               file=sys.stderr)
         return 1
+    if check:
+        # chain the alert/dashboard ↔ registry metric-name gate: a panel
+        # may only reference metrics the process actually registers
+        import subprocess
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "check_metrics_drift.py")])
+        return proc.returncode
     return 0
 
 

@@ -23,11 +23,50 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import urllib.parse
 from urllib.parse import parse_qs, urlparse
 
 FAKE_TENANT = "single-tenant"
+
+# exact paths that keep their own route label; anything else normalizes
+# to a template (path params stripped) or "other" so unauthenticated
+# garbage paths cannot mint unbounded label cardinality
+_KNOWN_ROUTES = frozenset({
+    "/v1/traces", "/api/v2/spans", "/api/traces", "/api/overrides",
+    "/ready", "/metrics", "/usage_metrics", "/api/echo",
+    "/api/status/buildinfo", "/api/search", "/api/search/tags",
+    "/api/v2/search/tags", "/api/metrics/query",
+    "/api/metrics/query_range", "/api/metrics/summary",
+    "/debug/threads", "/debug/profile",
+    "/internal/ingester/push", "/internal/ingester/push_otlp",
+    "/internal/ingester/trace", "/internal/ingester/search",
+    "/internal/ingester/tags", "/internal/ingester/tag_values",
+    "/internal/generator/push", "/internal/generator/push_otlp",
+    "/internal/generator/query_range",
+})
+
+
+def _route_of(path: str) -> str:
+    """Low-cardinality route template for the request-duration metric."""
+    if path in _KNOWN_ROUTES:
+        return path
+    if path.startswith("/api/v2/traces/"):
+        return "/api/v2/traces/{id}"
+    if path.startswith("/api/traces/"):
+        return "/api/traces/{id}"
+    if path.startswith("/api/v2/search/tag/") and path.endswith("/values"):
+        return "/api/v2/search/tag/{name}/values"
+    if path.startswith("/api/search/tag/") and path.endswith("/values"):
+        return "/api/search/tag/{name}/values"
+    if path.startswith("/kv/"):
+        return "/kv/{key}"
+    if path == "/status" or path.startswith("/status/"):
+        return "/status"
+    if path.startswith("/internal/"):
+        return "/internal/other"
+    return "other"
 
 
 def _json_bytes(obj) -> bytes:
@@ -60,6 +99,25 @@ class Handler(BaseHTTPRequestHandler):
 
     # -- helpers -----------------------------------------------------------
 
+    def send_response(self, code, message=None):
+        self._obs_status = code       # captured for the duration histogram
+        super().send_response(code, message)
+
+    def _observe_request(self, method: str, handler) -> None:
+        """Time one request into the App's HTTP duration histogram
+        (route template + method + status labels)."""
+        hist = getattr(self.app, "http_request_duration", None)
+        if hist is None:
+            return handler()
+        self._obs_status = 0
+        t0 = time.perf_counter()
+        try:
+            handler()
+        finally:
+            hist.observe(time.perf_counter() - t0,
+                         (_route_of(urlparse(self.path).path), method,
+                          str(self._obs_status or 500)))
+
     def _tenant(self) -> str:
         t = self.headers.get("X-Scope-OrgID", "")
         if not t:
@@ -91,7 +149,7 @@ class Handler(BaseHTTPRequestHandler):
         # join the caller's W3C trace context (receiver half of the
         # propagation install, main.go:252-258)
         with tracing.adopted(self.headers.get("traceparent")):
-            self._do_post()
+            self._observe_request("POST", self._do_post)
 
     def _do_post(self) -> None:
         path = urlparse(self.path).path
@@ -280,6 +338,9 @@ class Handler(BaseHTTPRequestHandler):
     # -- reads -------------------------------------------------------------
 
     def do_GET(self) -> None:  # noqa: N802
+        self._observe_request("GET", self._do_get)
+
+    def _do_get(self) -> None:
         path = urlparse(self.path).path
         q = self._q()
         try:
@@ -349,6 +410,9 @@ class Handler(BaseHTTPRequestHandler):
         self._err(404, f"unknown path {path}")
 
     def do_DELETE(self) -> None:  # noqa: N802
+        self._observe_request("DELETE", self._do_delete)
+
+    def _do_delete(self) -> None:
         path = urlparse(self.path).path
         if path.startswith("/kv/"):
             self._kv_store().delete(
@@ -547,81 +611,15 @@ class Handler(BaseHTTPRequestHandler):
         self._reply(200, "\n".join(lines).encode() + b"\n", "text/plain")
 
     def _self_metrics(self) -> None:
-        """Prometheus text exposition of service self-metrics."""
-        from tempo_tpu.utils.usage import escape_label as esc
-        lines = []
-        d = self.app.distributor
-        if d is not None:
-            for k, v in d.metrics.items():
-                lines.append(f"tempo_distributor_{k} {v}")
-            for r, v in d.discarded.items():
-                lines.append(
-                    f'tempo_discarded_spans_total{{reason="{esc(r)}"}} {v}')
-            for (tenant, reason), v in d.dataquality.snapshot().items():
-                if v:
-                    lines.append(
-                        f'tempo_warnings_total{{tenant="{esc(tenant)}",'
-                        f'reason="{esc(reason)}"}} {v}')
-        ur = getattr(self.app, "usage_reporter", None)
-        if ur is not None:
-            lines.append(
-                f"tempo_usage_stats_reports_written_total "
-                f"{ur.reports_written}")
-        fe = self.app.frontend
-        if fe is not None:
-            for (op, tenant), v in fe.slos.total.items():
-                lines.append(f'tempo_query_frontend_queries_total'
-                             f'{{op="{op}",tenant="{esc(tenant)}"}} {v}')
-            for (op, tenant), v in fe.slos.within.items():
-                lines.append(f'tempo_query_frontend_queries_within_slo_total'
-                             f'{{op="{op}",tenant="{esc(tenant)}"}} {v}')
-            cs = fe.cache_stats
-            lines.append(f"tempo_query_frontend_cache_hits_total "
-                         f"{cs['hits']}")
-            lines.append(f"tempo_query_frontend_cache_misses_total "
-                         f"{cs['misses']}")
-        db = getattr(self.app, "db", None)
-        if db is not None:
-            for k, v in db.plane_stats.items():
-                if k.startswith("fallback_"):
-                    # per-cause host-fallback counters (round-4 weak #4)
-                    lines.append(f'tempo_read_plane_fallback_total'
-                                 f'{{cause="{k[9:]}"}} {v}')
-                else:
-                    lines.append(f"tempo_read_plane_{k}_total {v}")
-            if db.planes is not None:
-                ps = db.planes.stats()
-                for k in ("entries", "device_bytes", "host_bytes",
-                          "device_budget_bytes", "host_budget_bytes"):
-                    lines.append(f"tempo_read_plane_cache_{k} {ps[k]}")
-                lines.append(f"tempo_read_plane_cache_hits_total "
-                             f"{ps['hits']}")
-                lines.append(f"tempo_read_plane_cache_misses_total "
-                             f"{ps['misses']}")
-        ing = self.app.ingester
-        if ing is not None:
-            with ing.lock:
-                insts = dict(ing.instances)
-            for tenant, inst in insts.items():
-                lines.append(f'tempo_ingester_live_traces{{tenant="{esc(tenant)}"}} '
-                             f'{len(inst.live)}')
-                for reason, v in inst.discarded.items():
-                    lines.append(
-                        f'tempo_ingester_discarded_traces_total'
-                        f'{{tenant="{esc(tenant)}",reason="{esc(reason)}"}} {v}')
-        gen = self.app.generator
-        if gen is not None:
-            with gen._lock:
-                ginsts = dict(gen.instances)
-            for tenant, gi in ginsts.items():
-                lines.append(
-                    f'tempo_metrics_generator_spans_received_total'
-                    f'{{tenant="{esc(tenant)}"}} {gi.spans_received}')
-                lines.append(
-                    f'tempo_metrics_generator_registry_active_series'
-                    f'{{tenant="{esc(tenant)}"}} {gi.registry.budget.used}')
-        self._reply(200, "\n".join(lines).encode() + b"\n",
-                    "text/plain; version=0.0.4")
+        """Prometheus text exposition, rendered entirely from the obs
+        registry (each module registered its own families at wiring time)
+        plus the process-wide JAX runtime registry. The API layer no
+        longer reaches into module internals."""
+        from tempo_tpu.obs.jaxruntime import RUNTIME
+
+        reg = getattr(self.app, "obs", None)
+        text = reg.render(extra=(RUNTIME,)) if reg is not None else ""
+        self._reply(200, text.encode(), "text/plain; version=0.0.4")
 
 
 def serve(app, block: bool = True) -> ThreadingHTTPServer:

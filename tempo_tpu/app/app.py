@@ -22,6 +22,7 @@ from tempo_tpu.distributor import Distributor
 from tempo_tpu.frontend import Frontend
 from tempo_tpu.generator import Generator
 from tempo_tpu.ingester import Ingester
+from tempo_tpu.obs import Registry
 from tempo_tpu.overrides import Overrides, UserConfigurableOverrides
 from tempo_tpu.querier import Querier
 from tempo_tpu.ring import ACTIVE, InstanceDesc, KVStore, Lifecycler, Ring
@@ -116,6 +117,11 @@ class App:
         # reads merge, convergence via heartbeat republish)
         from tempo_tpu.ring.kv import make_kv
         self.kv, self.kv_host = make_kv(self.cfg.ring_kv_url)
+        # ONE obs registry per App: every module registers its families
+        # here and /metrics renders it (plus the process-wide JAX runtime
+        # registry) — the single source of truth for self-telemetry
+        self.obs = Registry()
+        self._init_app_obs()
         self.ready = False
         self._stop = threading.Event()
         # modules (populated by _init_*)
@@ -142,6 +148,29 @@ class App:
         self._build()
 
     # -- wiring ------------------------------------------------------------
+
+    def _init_app_obs(self) -> None:
+        """App-level families that belong to no single module."""
+        def reports():
+            ur = getattr(self, "usage_reporter", None)
+            return [((), ur.reports_written)] if ur is not None else []
+
+        self.obs.counter_func(
+            "tempo_usage_stats_reports_written_total", reports,
+            help="Usage-stats reports written by the leader reporter")
+        # the serving-surface histograms are registered eagerly so the
+        # drift gate sees them before any request arrives; the HTTP
+        # handler and gRPC server observe through these App handles (one
+        # declaration — name, help, labels — instead of three copies)
+        self.http_request_duration = self.obs.histogram(
+            "tempo_request_duration_seconds",
+            "HTTP API request latency by route, method, and status",
+            labels=("route", "method", "status"))
+        self.grpc_request_duration = self.obs.histogram(
+            "tempo_grpc_request_duration_seconds",
+            "gRPC plane request latency by method and outcome (streams "
+            "time first message to stream end)",
+            labels=("method", "status"))
 
     def _build(self) -> None:
         mods = TARGETS[self.cfg.target]
@@ -248,7 +277,8 @@ class App:
             reader = CachingReader(reader, self.cache_provider)
         self.db = TempoDB(reader, self.backend, TempoDBConfig(
             compactor=self.cfg.compactor,
-            pool_workers=self.cfg.storage.pool_workers))
+            pool_workers=self.cfg.storage.pool_workers),
+            registry=self.obs)
 
     def _iid(self, kind: str) -> str:
         """This process's ring identity for a module kind. Single-binary
@@ -280,7 +310,8 @@ class App:
         iid = self._iid("ingester")
         self.ingester = Ingester(
             data_dir, flush_writer=self.backend, cfg=self.cfg.ingester,
-            overrides=self.overrides, now=self.now, instance_id=iid)
+            overrides=self.overrides, now=self.now, instance_id=iid,
+            registry=self.obs)
         self._join_ring("ingester", iid)
 
     def _init_generator(self) -> None:
@@ -288,7 +319,8 @@ class App:
         cfg.localblocks_flush_writer = self.backend
         iid = self._iid("generator")
         self.generator = Generator(cfg, overrides=self.overrides,
-                                   instance_id=iid, now=self.now)
+                                   instance_id=iid, registry=self.obs,
+                                   now=self.now)
         self._join_ring("generator", iid)
 
     def _peer_clients(self, kind: str):
@@ -340,7 +372,8 @@ class App:
         self.distributor = Distributor(
             iring, ing_clients, overrides=self.overrides,
             generator_ring=gring, generator_clients=gen_clients,
-            cfg=self.cfg.distributor, bus=self.bus, now=self.now)
+            cfg=self.cfg.distributor, bus=self.bus, registry=self.obs,
+            now=self.now)
         if self.cfg.target == ALL and not self.cfg.peers.ingesters \
                 and not self.cfg.ring_kv_url:
             self.distributor.cfg.rf = 1   # one in-process ingester
@@ -350,21 +383,24 @@ class App:
             clients, iring = self._peer_clients("ingesters")
             self.querier = Querier(self.db, iring, clients,
                                    overrides=self.overrides,
-                                   cfg=self.cfg.querier, now=self.now)
+                                   cfg=self.cfg.querier, registry=self.obs,
+                                   now=self.now)
             return
         if self.cfg.ring_kv_url:
             iring = self._shared_ring("ingester", self.cfg.querier.rf)
             self.querier = Querier(self.db, iring,
                                    RingClientPool(iring, "ingesters"),
                                    overrides=self.overrides,
-                                   cfg=self.cfg.querier, now=self.now)
+                                   cfg=self.cfg.querier, registry=self.obs,
+                                   now=self.now)
             return
         iring = Ring(kv=self.kv, key="ingester", replication_factor=1,
                      now=self.now)
         self.querier = Querier(
             self.db, iring,
             {self._iid("ingester"): self.ingester} if self.ingester else {},
-            overrides=self.overrides, cfg=self.cfg.querier, now=self.now)
+            overrides=self.overrides, cfg=self.cfg.querier,
+            registry=self.obs, now=self.now)
         if self.cfg.target == ALL:
             self.querier.cfg.rf = 1
 
@@ -409,7 +445,7 @@ class App:
             overrides=self.overrides,
             generator_query_range=gen_qr,
             cache_provider=getattr(self, "cache_provider", None),
-            now=self.now)
+            registry=self.obs, now=self.now)
 
     def _join_ring(self, key: str, instance_id: str) -> None:
         self._lifecyclers.append(

@@ -244,7 +244,8 @@ def _compiled_mask(sig: tuple, all_conditions: bool):
                                            else mask | m)
         return mask
 
-    return jax.jit(fn)
+    from tempo_tpu.obs.jaxruntime import instrumented_jit
+    return instrumented_jit(fn, name="plane_predicate_mask")
 
 
 def _icmp(jnp, op: A.Op, hi, lo, lh, ll):
@@ -342,7 +343,8 @@ def _block_mask_kernel(n: int, pred_sig: tuple, extra_sig: tuple,
         weights = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], jnp.uint8)
         return (mp * weights).sum(axis=1).astype(jnp.uint8)
 
-    return jax.jit(fn)
+    from tempo_tpu.obs.jaxruntime import instrumented_jit
+    return instrumented_jit(fn, name="plane_packed_mask")
 
 
 # ---------------------------------------------------------------------------
@@ -561,6 +563,8 @@ class BlockScanPlane:
         else:
             d = jnp.asarray(arr)
         self.device_bytes += int(arr.nbytes)
+        from tempo_tpu.obs.jaxruntime import record_device_put
+        record_device_put(int(arr.nbytes), "plane_column")
         return d
 
     def _host_col(self, attr: A.Attribute) -> Optional[Col]:
@@ -1253,7 +1257,8 @@ class BlockScanPlane:
                     return pack(grid, vcnt)
                 return pack(grid, cnt)
 
-            fn = jax.jit(build)
+            from tempo_tpu.obs.jaxruntime import instrumented_jit
+            fn = instrumented_jit(build, name="plane_query_range_grid")
             with self._lock:
                 if len(self._qr_cache) >= 64:
                     self._qr_cache.pop(next(iter(self._qr_cache)))

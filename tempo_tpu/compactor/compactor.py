@@ -6,6 +6,7 @@ import time
 from typing import Callable
 
 from tempo_tpu.db.tempodb import TempoDB
+from tempo_tpu.obs import Registry
 from tempo_tpu.ring import KVStore, Lifecycler, Ring
 
 COMPACTOR_RING = "compactor"
@@ -14,10 +15,18 @@ COMPACTOR_RING = "compactor"
 class Compactor:
     def __init__(self, db: TempoDB, kv: KVStore | None = None,
                  instance_id: str = "compactor-0",
+                 registry: Registry | None = None,
                  now: Callable[[], float] = time.time) -> None:
         self.db = db
         self.id = instance_id
         self.now = now
+        # share the db's registry by default so a compactor target's
+        # /metrics carries both the service sweep and the per-tenant
+        # cycle histogram the db records
+        self.obs = registry if registry is not None else db.obs
+        self.sweeps = self.obs.counter(
+            "tempo_compactor_sweeps_total",
+            "Full compactor sweeps over all tenants")
         self.kv = kv
         self.ring: Ring | None = None
         self.lifecycler: Lifecycler | None = None
@@ -40,6 +49,7 @@ class Compactor:
         delete/mark writes — and the sweep keeps our heartbeat fresh so a
         caller-driven loop can't age itself out of the ring."""
         self.heartbeat()
+        self.sweeps.inc()
         done = 0
         for tenant in self.db.blocklist.tenants():
             try:

@@ -25,6 +25,7 @@ from tempo_tpu.db.blocklist import List
 from tempo_tpu.db.pool import Pool
 from tempo_tpu.db.poller import Poller, PollerConfig
 from tempo_tpu.model.combine import combine_spans
+from tempo_tpu.obs import Registry
 
 log = logging.getLogger("tempo_tpu.db")
 
@@ -50,6 +51,7 @@ class TempoDBConfig:
 class TempoDB:
     def __init__(self, r: RawReader, w: RawWriter,
                  cfg: TempoDBConfig | None = None,
+                 registry: Registry | None = None,
                  now: Callable[[], float] = time.time):
         self.r = r
         self.w = w
@@ -73,6 +75,47 @@ class TempoDB:
         # read-plane routing counters: how many block scans took the fused
         # device path vs the host engine (tests + /metrics)
         self.plane_stats = {"fused_metric_blocks": 0, "host_metric_blocks": 0}
+        self.obs = registry if registry is not None else Registry()
+        self._register_obs(self.obs)
+
+    def _register_obs(self, reg: Registry) -> None:
+        reg.counter_func(
+            "tempo_read_plane_fused_metric_blocks_total",
+            lambda: [((), self.plane_stats["fused_metric_blocks"])],
+            help="Metrics blocks answered by the fused device plane")
+        reg.counter_func(
+            "tempo_read_plane_host_metric_blocks_total",
+            lambda: [((), self.plane_stats["host_metric_blocks"])],
+            help="Metrics blocks answered by the host engine")
+        reg.counter_func(
+            "tempo_read_plane_fallback_total",
+            lambda: [((k[len("fallback_"):],), v)
+                     for k, v in self.plane_stats.items()
+                     if k.startswith("fallback_")],
+            help="Host-engine fallbacks by cause (query_shape, predicate, "
+                 "group, value, grid_size, window, times, disabled)",
+            labels=("cause",))
+
+        def plane_stat(key):
+            def fn():
+                if self.planes is None:
+                    return []
+                return [((), self.planes.stats()[key])]
+            return fn
+
+        for key in ("entries", "device_bytes", "host_bytes",
+                    "device_budget_bytes", "host_budget_bytes"):
+            reg.gauge_func(f"tempo_read_plane_cache_{key}", plane_stat(key),
+                           help=f"Device read-plane cache {key.replace('_', ' ')}")
+        reg.counter_func("tempo_read_plane_cache_hits_total",
+                         plane_stat("hits"),
+                         help="Device read-plane cache hits")
+        reg.counter_func("tempo_read_plane_cache_misses_total",
+                         plane_stat("misses"),
+                         help="Device read-plane cache misses")
+        self.compaction_duration = reg.histogram(
+            "tempo_compactor_cycle_duration_seconds",
+            "One per-tenant compaction sweep (selection + block rewrites)")
 
     # -- writer ------------------------------------------------------------
 
@@ -232,9 +275,12 @@ class TempoDB:
         fused_parts: list = []
         MAX_INFLIGHT = 8   # bound live device grids (hist grids are big)
 
+        from tempo_tpu.obs.jaxruntime import kernel_timer
+
         def drain(to: int) -> None:
             while len(handles) > to:
-                labels, main, cnt, vcnt = handles.pop(0).fetch()
+                with kernel_timer("plane_metrics_grid"):
+                    labels, main, cnt, vcnt = handles.pop(0).fetch()
                 fused_parts.append(grid_series(ev.m, labels, main, cnt,
                                                vcnt))
 
@@ -347,6 +393,7 @@ class TempoDB:
                             owns: Callable[[str], bool] = lambda key: True) -> int:
         """One compaction sweep for a tenant; `owns` is the ring-ownership
         predicate keyed like `modules/compactor/compactor.go:190`."""
+        t0 = time.perf_counter()
         metas = self.blocklist.metas(tenant)
         jobs = self.selector.blocks_to_compact(metas)
         done = 0
@@ -359,6 +406,7 @@ class TempoDB:
                 tenant, add=out, remove=group,
                 compacted_add=[bm.CompactedBlockMeta(m, self.now()) for m in group])
             done += 1
+        self.compaction_duration.observe(time.perf_counter() - t0)
         return done
 
     def retention_once(self, tenant: str) -> tuple[list, list]:

@@ -17,6 +17,7 @@ import numpy as np
 from tempo_tpu.distributor.limiter import RateLimiter, effective_rate
 from tempo_tpu.native import group_keys  # native hash group; numpy fallback
 from tempo_tpu.native import token_for   # native fnv batch; numpy fallback
+from tempo_tpu.obs import Registry
 from tempo_tpu.overrides import Overrides
 from tempo_tpu.ring import InstanceDesc, Ring, do_batch
 from tempo_tpu.utils.livetraces import _approx_size
@@ -80,6 +81,7 @@ class Distributor:
                  cfg: DistributorConfig | None = None,
                  n_distributors: Callable[[], int] = lambda: 1,
                  bus: "object | None" = None,
+                 registry: Registry | None = None,
                  now: Callable[[], float] = time.time) -> None:
         self.bus = bus
         self.cfg = cfg or DistributorConfig()
@@ -108,12 +110,47 @@ class Distributor:
                 cfg_obj = fc if isinstance(fc, ForwarderConfig) \
                     else ForwarderConfig(**fc)
                 self.forwarders.register(tenant, Forwarder(cfg_obj))
-        # self-metrics (tempo_distributor_* naming)
+        # self-metrics (tempo_distributor_* naming): the plain dicts stay
+        # the hot-path store; the obs registry renders them through
+        # callback families registered below
         self.metrics: dict[str, float] = {
             "spans_received_total": 0, "bytes_received_total": 0,
             "traces_pushed_total": 0, "push_failures_total": 0,
         }
         self.discarded: dict[str, int] = {}
+        self.obs = registry if registry is not None else Registry()
+        self._register_obs(self.obs)
+
+    def _register_obs(self, reg: Registry) -> None:
+        """This module's metric families — owned here, not scraped by the
+        API layer."""
+        helps = {
+            "spans_received_total": "Spans accepted by the distributor",
+            "bytes_received_total": "Wire bytes accepted by the distributor",
+            "traces_pushed_total":
+                "Distinct traces replicated to the ingester ring",
+            "push_failures_total":
+                "Quorum replication failures (ingester or generator ring)",
+        }
+        for key, help_text in helps.items():
+            reg.counter_func(
+                f"tempo_distributor_{key}",
+                lambda key=key: [((), self.metrics[key])], help=help_text)
+        reg.counter_func(
+            "tempo_discarded_spans_total",
+            lambda: [((r,), v) for r, v in self.discarded.items()],
+            help="Spans discarded by the distributor, by reason",
+            labels=("reason",))
+        reg.counter_func(
+            "tempo_warnings_total",
+            lambda: [((t, r), v) for (t, r), v in
+                     self.dataquality.snapshot().items() if v],
+            help="Data-quality warnings (clock skew, suspect timestamps)",
+            labels=("tenant", "reason"))
+        self.push_duration = reg.histogram(
+            "tempo_distributor_push_duration_seconds",
+            "End-to-end distributor push latency: validation, regrouping, "
+            "ring replication, and the generator tee")
 
     # -- entry -------------------------------------------------------------
 
@@ -132,10 +169,14 @@ class Distributor:
         `raw_recs` is the receiver's native SpanRec scan of the same bytes
         (passed along so the tee does not scan twice)."""
         from tempo_tpu.utils import tracing
-        with tracing.span_for_tenant("distributor.PushSpans", tenant,
-                                     n_spans=len(spans)):
-            return self._push_spans(tenant, spans, size_bytes, raw_otlp,
-                                    raw_recs)
+        t0 = time.perf_counter()
+        try:
+            with tracing.span_for_tenant("distributor.PushSpans", tenant,
+                                         n_spans=len(spans)):
+                return self._push_spans(tenant, spans, size_bytes, raw_otlp,
+                                        raw_recs)
+        finally:
+            self.push_duration.observe(time.perf_counter() - t0)
 
     def push_otlp(self, tenant: str, raw: bytes,
                   recs: "np.ndarray | None" = None) -> dict[str, int]:
@@ -165,9 +206,14 @@ class Distributor:
                 except ValueError as e:
                     raise MalformedPayload(str(e)) from None
             if recs is not None:
-                with tracing.span_for_tenant("distributor.PushSpans",
-                                             tenant, n_spans=len(recs)):
-                    return self._push_otlp_columnar(tenant, raw, recs, lim)
+                t0 = time.perf_counter()
+                try:
+                    with tracing.span_for_tenant("distributor.PushSpans",
+                                                 tenant, n_spans=len(recs)):
+                        return self._push_otlp_columnar(tenant, raw, recs,
+                                                        lim)
+                finally:
+                    self.push_duration.observe(time.perf_counter() - t0)
         try:
             got = native.spans_from_otlp_proto_native(raw, return_recs=True)
             if got[0] is None:

@@ -26,6 +26,7 @@ from tempo_tpu.frontend.sharders import (
 )
 from tempo_tpu.frontend.slos import SLOConfig, SLORecorder
 from tempo_tpu.model.combine import combine_spans, sort_spans
+from tempo_tpu.obs import Registry, exponential_buckets
 from tempo_tpu.overrides import Overrides
 from tempo_tpu.querier.querier import Querier
 from tempo_tpu.traceql.engine import MetadataCombiner
@@ -55,7 +56,7 @@ class FrontendConfig:
 
 class _Job:
     __slots__ = ("job", "fn", "spec", "result", "error", "event", "_lock",
-                 "_claimed")
+                 "_claimed", "enqueued_at", "queue_wait")
 
     def __init__(self, job: SearchJob, fn: Callable[[SearchJob], Any],
                  spec: dict | None = None):
@@ -67,6 +68,12 @@ class _Job:
         self.event = threading.Event()
         self._lock = threading.Lock()
         self._claimed = False
+        # queue-wait clock, attached at enqueue: observed at CLAIM time,
+        # because remote worker streams claim a job and ship its spec
+        # without ever invoking fn — only the claim is common to local
+        # workers, remote streams, and the issuer's inline fallback
+        self.enqueued_at: float | None = None
+        self.queue_wait = None
 
     def try_claim(self) -> bool:
         """Exactly-once execution claim: local workers, remote worker
@@ -76,7 +83,9 @@ class _Job:
             if self._claimed:
                 return False
             self._claimed = True
-            return True
+        if self.queue_wait is not None and self.enqueued_at is not None:
+            self.queue_wait.observe(time.perf_counter() - self.enqueued_at)
+        return True
 
     def run(self) -> None:
         if not self.try_claim():
@@ -114,6 +123,7 @@ class Frontend:
                  overrides: Overrides | None = None,
                  generator_query_range: Callable[..., list[TimeSeries]] | None = None,
                  cache_provider=None,
+                 registry: Registry | None = None,
                  now: Callable[[], float] = time.time) -> None:
         self.db = db
         self.querier = querier
@@ -136,6 +146,54 @@ class Frontend:
             from tempo_tpu.backend.cache import ROLE_FRONTEND_SEARCH
 
             self._job_cache = cache_provider.cache_for(ROLE_FRONTEND_SEARCH)
+        self.obs = registry if registry is not None else Registry()
+        self._register_obs(self.obs)
+
+    def _register_obs(self, reg: Registry) -> None:
+        reg.counter_func(
+            "tempo_query_frontend_queries_total",
+            lambda: [(k, v) for k, v in self.slos.total.items()],
+            help="Frontend queries, by endpoint op and tenant",
+            labels=("op", "tenant"))
+        reg.counter_func(
+            "tempo_query_frontend_queries_within_slo_total",
+            lambda: [(k, v) for k, v in self.slos.within.items()],
+            help="Frontend queries that met the latency or throughput SLO",
+            labels=("op", "tenant"))
+        reg.counter_func(
+            "tempo_query_frontend_cache_hits_total",
+            lambda: [((), self.cache_stats["hits"])],
+            help="Search-response cache hits")
+        reg.counter_func(
+            "tempo_query_frontend_cache_misses_total",
+            lambda: [((), self.cache_stats["misses"])],
+            help="Search-response cache misses")
+        self.op_duration = reg.histogram(
+            "tempo_query_frontend_request_duration_seconds",
+            "Frontend query latency by endpoint op; observations over the "
+            "op's SLO threshold carry the active trace id as an exemplar",
+            labels=("op",))
+        self.queue_wait = reg.histogram(
+            "tempo_query_frontend_queue_wait_seconds",
+            "Time a sharded sub-request spent in the tenant-fair queue "
+            "before a worker claimed it")
+        self.shard_fanout = reg.histogram(
+            "tempo_query_frontend_shard_fanout",
+            "Sub-requests one query sharded into",
+            buckets=exponential_buckets(1.0, 2.0, 12))
+
+    def _record_op(self, op: str, tenant: str, latency_s: float,
+                   nbytes: int) -> None:
+        """SLO accounting + the op latency histogram. A request outside
+        its SLO stamps the active self-tracing span's trace id as the
+        observation's exemplar, so a p99 spike links to a concrete trace
+        in the dogfood tenant."""
+        good = self.slos.record(op, tenant, latency_s, nbytes)
+        trace_id = None
+        if not good:
+            from tempo_tpu.utils import tracing
+            trace_id = tracing.current_trace_id_hex()
+        self.op_duration.observe(latency_s, (op,), trace_id=trace_id)
 
     @property
     def cache_stats(self) -> dict:
@@ -199,6 +257,7 @@ class Frontend:
         happen at fold time, so cached sub-requests are skipped no matter
         who would have executed them — inline, local worker, or remote
         worker stream. key_fn returning None marks a job uncacheable."""
+        self.shard_fanout.observe(float(len(jobs)))
         key_fn = encode = decode = None
         if cache is not None and self._job_cache is not None:
             key_fn, encode, decode = cache
@@ -247,7 +306,7 @@ class Frontend:
         window = max(1, min(self.cfg.concurrent_jobs,
                             self.cfg.max_outstanding_per_tenant - 1))
         for _, wj in pending[:window]:
-            self.queue.enqueue(tenant, wj)
+            self._enqueue_timed(tenant, wj)
         qi = window                 # next pending job to enqueue
         for idx, j in enumerate(jobs):
             if idx in hits:
@@ -264,13 +323,21 @@ class Frontend:
                     # run it inline rather than hanging the query forever
                     wj.run_claimed()
             if qi < len(pending):
-                self.queue.enqueue(tenant, pending[qi][1])
+                self._enqueue_timed(tenant, pending[qi][1])
                 qi += 1
             if wj.error is not None:
                 raise wj.error
             if not fold(idx, j, wj.result):
                 break
         return nbytes
+
+    def _enqueue_timed(self, tenant: str, wj: "_Job") -> None:
+        """Enqueue with the queue-wait clock attached: the wait histogram
+        observes enqueue → claim, whoever claims (local worker, remote
+        stream, or the issuer's inline fallback)."""
+        wj.enqueued_at = time.perf_counter()
+        wj.queue_wait = self.queue_wait
+        self.queue.enqueue(tenant, wj)
 
     # -- endpoints ---------------------------------------------------------
 
@@ -354,7 +421,7 @@ class Frontend:
                     "row_groups": list(j.row_groups), "limit": limit,
                     "start_s": j.start_s, "end_s": j.end_s},
                 cache=(search_key, _encode_metadata, _decode_metadata))
-        self.slos.record("search", tenant, self.now() - t0, nbytes)
+        self._record_op("search", tenant, self.now() - t0, nbytes)
         return combiner.results()
 
     def find_trace(self, tenant: str, trace_id: bytes,
@@ -366,8 +433,8 @@ class Frontend:
             got = self.querier.find_trace_by_id(t, trace_id, start_s, end_s)
             if got:
                 spans.extend(got)
-        self.slos.record("traces", tenant, self.now() - t0,
-                         len(spans) * 200)
+        self._record_op("traces", tenant, self.now() - t0,
+                        len(spans) * 200)
         return sort_spans(combine_spans(spans)) if spans else None
 
     def query_range(self, tenant: str, query: str, *,
@@ -461,7 +528,7 @@ class Frontend:
                     "row_groups": list(j.row_groups),
                     "clip_end_ns": cutoff_ns},
                 cache=(qr_key, _encode_series, _decode_series))
-        self.slos.record("metrics", tenant, self.now() - t0, nbytes)
+        self._record_op("metrics", tenant, self.now() - t0, nbytes)
         return comb.final(req)
 
     def decode_job_result(self, spec: dict, result):
@@ -498,7 +565,7 @@ class Frontend:
                 t, on_partial=hook if on_partial is not None else None))
         for scope in merged:
             merged[scope] = sorted(merged[scope])
-        self.slos.record("metadata", tenant, self.now() - t0, 0)
+        self._record_op("metadata", tenant, self.now() - t0, 0)
         return merged
 
     def tag_values(self, tenant: str, name: str, limit: int = 1000,
@@ -526,7 +593,7 @@ class Frontend:
             fold(self.querier.tag_values(
                 t, name, limit,
                 on_partial=hook if on_partial is not None else None))
-        self.slos.record("metadata", tenant, self.now() - t0, 0)
+        self._record_op("metadata", tenant, self.now() - t0, 0)
         return out[:limit]
 
 

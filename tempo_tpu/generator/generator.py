@@ -17,6 +17,7 @@ from typing import Callable, Sequence
 
 from tempo_tpu.generator.instance import GeneratorConfig, GeneratorInstance
 from tempo_tpu.model.span_batch import SpanBatchBuilder
+from tempo_tpu.obs import Registry
 from tempo_tpu.overrides import Overrides
 
 
@@ -28,6 +29,7 @@ class Generator:
     def __init__(self, cfg: GeneratorConfig | None = None,
                  overrides: Overrides | None = None,
                  instance_id: str = "generator-0",
+                 registry: Registry | None = None,
                  now: Callable[[], float] = time.time) -> None:
         self.base_cfg = cfg or GeneratorConfig()
         self.overrides = overrides or Overrides()
@@ -38,6 +40,29 @@ class Generator:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        self.obs = registry if registry is not None else Registry()
+        self._register_obs(self.obs)
+
+    def _register_obs(self, reg: Registry) -> None:
+        def insts():
+            with self._lock:
+                return dict(self.instances)
+
+        reg.counter_func(
+            "tempo_metrics_generator_spans_received_total",
+            lambda: [((t,), gi.spans_received) for t, gi in insts().items()],
+            help="Spans received by the metrics-generator, per tenant",
+            labels=("tenant",))
+        reg.gauge_func(
+            "tempo_metrics_generator_registry_active_series",
+            lambda: [((t,), gi.registry.budget.used)
+                     for t, gi in insts().items()],
+            help="Active series in the tenant registry vs its budget",
+            labels=("tenant",))
+        self.collect_duration = reg.histogram(
+            "tempo_metrics_generator_collect_duration_seconds",
+            "One tenant collection tick: device-state gather through "
+            "remote-write send")
 
     def instance(self, tenant: str) -> GeneratorInstance:
         with self._lock:
@@ -195,7 +220,9 @@ class Generator:
         total = 0
         for inst in insts:
             if not inst.registry.overrides.disable_collection:
+                t0 = time.perf_counter()
                 total += inst.collect_and_push()
+                self.collect_duration.observe(time.perf_counter() - t0)
             inst.tick()
         return total
 

@@ -21,7 +21,6 @@ series for <1%-error quantiles (the sketch plane the reference lacks).
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Sequence
 
 import jax
@@ -74,13 +73,17 @@ def _fused_update_impl(calls, latency, sizes, dd, slots, dur_s, size_bytes,
 # donating jit of the fused step: without donation every push COPIES the
 # full functional state (~90MB with the default DDSketch plane). Callers
 # MUST hold the registry state_lock across call+rebind — donation deletes
-# the input buffers at dispatch for any concurrent reader.
-_fused_update_donated = jax.jit(_fused_update_impl,
-                                donate_argnums=(0, 1, 2, 3))
+# the input buffers at dispatch for any concurrent reader. The
+# instrumented jit records compile count + seconds into the process-wide
+# obs runtime registry (tempo_jax_jit_compile_* on /metrics).
+from tempo_tpu.obs.jaxruntime import instrumented_jit
+
+_fused_update_donated = instrumented_jit(
+    _fused_update_impl, name="spanmetrics_fused_update",
+    donate_argnums=(0, 1, 2, 3))
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
-def _fused_update_packed(calls, latency, sizes, dd, packed, weights):
+def _fused_update_packed_impl(calls, latency, sizes, dd, packed, weights):
     """The fused step with (slots, dur_s, size_bytes) packed into ONE
     [3, cap] f32 H2D transfer (the staged fast paths): behind a
     high-latency device link the per-push transfer COUNT is the cost, not
@@ -94,6 +97,11 @@ def _fused_update_packed(calls, latency, sizes, dd, packed, weights):
     slots = packed[0].astype(jax.numpy.int32)
     return _fused_update_impl(calls, latency, sizes, dd, slots, packed[1],
                               packed[2], weights)
+
+
+_fused_update_packed = instrumented_jit(
+    _fused_update_packed_impl, name="spanmetrics_fused_update_packed",
+    donate_argnums=(0, 1, 2, 3))
 
 
 class SpanMetricsProcessor:

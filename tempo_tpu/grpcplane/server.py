@@ -466,60 +466,113 @@ def build_grpc_server(app, address: str = "127.0.0.1:0",
     Returns (server, bound_port). Only services whose backing module exists
     on this target are registered — a `-target=ingester` process serves
     Pusher + Querier, a frontend serves StreamingQuerier + Frontend, etc.
+    Every handler is timed into the gRPC request-duration histogram
+    (method + status labels), the RPC-plane twin of the HTTP histogram.
     """
+    import time as _time
+
     svc = _Services(app)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
 
-    def unary(fn):
+    hist = getattr(app, "grpc_request_duration", None)
+
+    def unary(fn, method: str):
+        def handler(request, context):
+            t0 = _time.perf_counter()
+            status = "OK"
+            try:
+                return fn(request, context)
+            except BaseException:          # context.abort raises
+                status = "error"
+                raise
+            finally:
+                if hist is not None:
+                    hist.observe(_time.perf_counter() - t0,
+                                 (method, status))
         return grpc.unary_unary_rpc_method_handler(
-            fn, request_deserializer=_ident, response_serializer=_ident)
+            handler, request_deserializer=_ident,
+            response_serializer=_ident)
 
-    def sstream(fn):
+    def _timed_stream(fn, method: str):
+        def handler(request, context):
+            t0 = _time.perf_counter()
+            status = "OK"
+            try:
+                yield from fn(request, context)
+            except BaseException:
+                status = "error"
+                raise
+            finally:
+                if hist is not None:
+                    hist.observe(_time.perf_counter() - t0,
+                                 (method, status))
+        return handler
+
+    def sstream(fn, method: str):
         return grpc.unary_stream_rpc_method_handler(
-            fn, request_deserializer=_ident, response_serializer=_ident)
+            _timed_stream(fn, method), request_deserializer=_ident,
+            response_serializer=_ident)
 
-    def bidi(fn):
+    def bidi(fn, method: str):
         return grpc.stream_stream_rpc_method_handler(
-            fn, request_deserializer=_ident, response_serializer=_ident)
+            _timed_stream(fn, method), request_deserializer=_ident,
+            response_serializer=_ident)
 
     if app.distributor is not None:
         server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
             "opentelemetry.proto.collector.trace.v1.TraceService",
-            {"Export": unary(svc.otlp_export)}),))
+            {"Export": unary(svc.otlp_export, "TraceService/Export")}),))
         server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
             "jaeger.api_v2.CollectorService",
-            {"PostSpans": unary(svc.jaeger_post_spans)}),))
+            {"PostSpans": unary(svc.jaeger_post_spans,
+                                "CollectorService/PostSpans")}),))
         server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
             "opencensus.proto.agent.trace.v1.TraceService",
-            {"Export": bidi(svc.opencensus_export)}),))
+            {"Export": bidi(svc.opencensus_export,
+                            "OpenCensus.TraceService/Export")}),))
     if app.ingester is not None:
         server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
             "tempopb.Pusher",
-            {"PushBytesV2": unary(svc.push_bytes_v2),
-             "PushOTLP": unary(svc.push_otlp_traces)}),))
+            {"PushBytesV2": unary(svc.push_bytes_v2,
+                                  "Pusher/PushBytesV2"),
+             "PushOTLP": unary(svc.push_otlp_traces,
+                               "Pusher/PushOTLP")}),))
         server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
             "tempopb.Querier",
-            {"FindTraceByID": unary(svc.find_trace_by_id),
-             "SearchRecent": unary(svc.search_recent),
-             "SearchTags": unary(svc.search_tags),
-             "SearchTagValues": unary(svc.search_tag_values)}),))
+            {"FindTraceByID": unary(svc.find_trace_by_id,
+                                    "Querier/FindTraceByID"),
+             "SearchRecent": unary(svc.search_recent,
+                                   "Querier/SearchRecent"),
+             "SearchTags": unary(svc.search_tags, "Querier/SearchTags"),
+             "SearchTagValues": unary(svc.search_tag_values,
+                                      "Querier/SearchTagValues")}),))
     if app.generator is not None:
         server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
             "tempopb.MetricsGenerator",
-            {"PushSpans": unary(svc.generator_push_spans),
-             "PushOTLP": unary(svc.generator_push_otlp),
-             "QueryRange": unary(svc.generator_query_range),
-             "GetMetrics": unary(svc.generator_get_metrics)}),))
+            {"PushSpans": unary(svc.generator_push_spans,
+                                "MetricsGenerator/PushSpans"),
+             "PushOTLP": unary(svc.generator_push_otlp,
+                               "MetricsGenerator/PushOTLP"),
+             "QueryRange": unary(svc.generator_query_range,
+                                 "MetricsGenerator/QueryRange"),
+             "GetMetrics": unary(svc.generator_get_metrics,
+                                 "MetricsGenerator/GetMetrics")}),))
     if app.frontend is not None:
         server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
             "tempopb.StreamingQuerier",
-            {"Search": sstream(svc.streaming_search),
-             "MetricsQueryRange": sstream(svc.streaming_metrics_query_range),
-             "SearchTags": sstream(svc.streaming_search_tags),
+            {"Search": sstream(svc.streaming_search,
+                               "StreamingQuerier/Search"),
+             "MetricsQueryRange": sstream(
+                 svc.streaming_metrics_query_range,
+                 "StreamingQuerier/MetricsQueryRange"),
+             "SearchTags": sstream(svc.streaming_search_tags,
+                                   "StreamingQuerier/SearchTags"),
              "SearchTagValues": sstream(
-                 svc.streaming_search_tag_values)}),))
+                 svc.streaming_search_tag_values,
+                 "StreamingQuerier/SearchTagValues")}),))
         server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
-            "tempopb.Frontend", {"Process": bidi(svc.frontend_process)}),))
+            "tempopb.Frontend",
+            {"Process": bidi(svc.frontend_process, "Frontend/Process")}),))
     port = server.add_insecure_port(address)
     server.start()
     return server, port

@@ -19,6 +19,7 @@ import numpy as np
 
 from tempo_tpu.backend.raw import RawWriter, block_keypath
 from tempo_tpu.ingester.instance import InstanceConfig, TenantInstance
+from tempo_tpu.obs import Registry
 from tempo_tpu.overrides import Overrides
 from tempo_tpu.utils.flushqueues import FlushQueues, backoff_at
 
@@ -51,7 +52,8 @@ class Ingester:
                  cfg: IngesterConfig | None = None,
                  overrides: Overrides | None = None,
                  now: Callable[[], float] = time.time,
-                 instance_id: str = "ingester-0") -> None:
+                 instance_id: str = "ingester-0",
+                 registry: Registry | None = None) -> None:
         self.cfg = cfg or IngesterConfig()
         self.overrides = overrides or Overrides()
         self.now = now
@@ -64,7 +66,39 @@ class Ingester:
         self.queues = FlushQueues(self.cfg.concurrent_flushes, now=now)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        self.obs = registry if registry is not None else Registry()
+        self._register_obs(self.obs)
         self.replay()
+
+    def _register_obs(self, reg: Registry) -> None:
+        def live():
+            with self.lock:
+                insts = dict(self.instances)
+            return [((t,), len(inst.live)) for t, inst in insts.items()]
+
+        def discarded():
+            with self.lock:
+                insts = dict(self.instances)
+            return [((t, r), v) for t, inst in insts.items()
+                    for r, v in inst.discarded.items()]
+
+        reg.gauge_func("tempo_ingester_live_traces", live,
+                       help="Traces currently held in memory, per tenant",
+                       labels=("tenant",))
+        reg.counter_func(
+            "tempo_ingester_discarded_traces_total", discarded,
+            help="Traces rejected by the ingester after the distributor "
+                 "accepted them, by tenant and reason",
+            labels=("tenant", "reason"))
+        self.cut_duration = reg.histogram(
+            "tempo_ingester_cut_duration_seconds",
+            "One cut sweep for a tenant: idle-trace cut plus head-block "
+            "seal decision")
+        self.flush_duration = reg.histogram(
+            "tempo_ingester_flush_duration_seconds",
+            "One flush-queue operation, by kind (complete = WAL to local "
+            "block; flush = local block to object storage)",
+            labels=("op",))
 
     # -- instances ---------------------------------------------------------
 
@@ -119,9 +153,11 @@ class Ingester:
     def sweep_instance(self, tenant: str, immediate: bool = False) -> None:
         """One cut tick for a tenant (`sweepInstance` flush.go:142):
         cut idle traces, maybe seal head, enqueue completion."""
+        t0 = time.perf_counter()
         inst = self.instance(tenant)
         inst.cut_complete_traces(immediate=immediate)
         sealed = inst.cut_block_if_ready(immediate=immediate)
+        self.cut_duration.observe(time.perf_counter() - t0)
         if sealed is not None:
             self.queues.enqueue(
                 f"{tenant}/{sealed.block_id}",
@@ -134,6 +170,14 @@ class Ingester:
             self.sweep_instance(t, immediate=immediate)
 
     def _handle_op(self, key: str, op: _FlushOp) -> bool:
+        t0 = time.perf_counter()
+        try:
+            return self._handle_op_inner(key, op)
+        finally:
+            self.flush_duration.observe(time.perf_counter() - t0,
+                                        (op.kind,))
+
+    def _handle_op_inner(self, key: str, op: _FlushOp) -> bool:
         inst = self.instance(op.tenant)
         try:
             if op.kind == OP_COMPLETE:

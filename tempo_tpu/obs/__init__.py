@@ -1,0 +1,23 @@
+"""tempo_tpu.obs — the process self-telemetry substrate.
+
+- `registry`: Counter/Gauge/Histogram families, callback collectors,
+  HELP/TYPE text exposition, conformance parser.
+- `jaxruntime`: process-wide JAX/TPU runtime metrics (jit compiles,
+  device-put bytes, kernel wall time) in the shared `RUNTIME` registry.
+- `drift`: alert/dashboard ↔ registry drift gate.
+"""
+
+from tempo_tpu.obs.registry import (
+    DEFAULT_DURATION_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    escape_label,
+    exponential_buckets,
+    parse_exposition,
+)
+
+__all__ = ["Registry", "Counter", "Gauge", "Histogram", "escape_label",
+           "exponential_buckets", "parse_exposition",
+           "DEFAULT_DURATION_BUCKETS"]

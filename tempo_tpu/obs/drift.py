@@ -1,0 +1,98 @@
+"""Alert/dashboard ↔ registry drift gate.
+
+Extracts every `tempo_*` metric name referenced by
+`operations/alerts.yaml` and `operations/dashboards/*.json` and checks
+each against the set of names actually registered in the obs registries
+— the guarantee the tempo-mixin gets from generating everything out of
+one jsonnet tree. A dashboard panel or alert expression can no longer
+reference a metric this process never emits.
+
+Used three ways: `operations/check_metrics_drift.py` (CLI, wired into
+the `gen_dashboards.py --check` flow), the CI test
+(tests/test_obs.py::test_ops_metric_names_registered), and ad-hoc from a
+REPL against a live App.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+METRIC_NAME_RE = re.compile(r"\btempo_[a-z0-9_]+")
+
+# tokens the regex catches that are prose, not metric names (the python
+# package name shows up in dashboard descriptions)
+_NOT_METRICS = frozenset({"tempo_tpu"})
+
+
+def referenced_metric_names(ops_dir: str) -> dict[str, set[str]]:
+    """{metric_name -> {relative file paths referencing it}} over
+    alerts.yaml + dashboards/*.json."""
+    out: dict[str, set[str]] = {}
+
+    def scan(path: str) -> None:
+        rel = os.path.relpath(path, ops_dir)
+        with open(path) as f:
+            text = f.read()
+        for name in METRIC_NAME_RE.findall(text):
+            if name not in _NOT_METRICS:
+                out.setdefault(name, set()).add(rel)
+
+    alerts = os.path.join(ops_dir, "alerts.yaml")
+    if os.path.exists(alerts):
+        scan(alerts)
+    dash_dir = os.path.join(ops_dir, "dashboards")
+    if os.path.isdir(dash_dir):
+        for fname in sorted(os.listdir(dash_dir)):
+            if fname.endswith(".json"):
+                # parse: a dashboard that stops being JSON should fail
+                # here, not silently degrade to a text grep
+                with open(os.path.join(dash_dir, fname)) as f:
+                    json.load(f)
+                scan(os.path.join(dash_dir, fname))
+    return out
+
+
+def registered_metric_names(registries) -> set[str]:
+    out: set[str] = set()
+    for reg in registries:
+        out |= reg.metric_names()
+    return out
+
+
+def check_drift(ops_dir: str, registries) -> list[str]:
+    """Return human-readable drift findings (empty = clean): every
+    referenced metric name that no registry registers."""
+    known = registered_metric_names(registries)
+    problems: list[str] = []
+    for name, files in sorted(referenced_metric_names(ops_dir).items()):
+        if name in known:
+            continue
+        problems.append(
+            f"{name} (referenced by {', '.join(sorted(files))}) is not "
+            f"registered in the obs registry")
+    return problems
+
+
+def default_registries():
+    """Boot a `target=all` in-memory App and return its registries —
+    the canonical "what does a full process register" answer for the
+    CLI gate. Caller must App.shutdown() the returned app."""
+    import tempfile
+
+    from tempo_tpu.app import App
+    from tempo_tpu.app.config import Config
+    from tempo_tpu.obs.jaxruntime import RUNTIME
+
+    tmp = tempfile.mkdtemp(prefix="tempo-obs-drift-")
+    cfg = Config(target="all")
+    cfg.storage.backend = "mem"
+    cfg.storage.wal_path = os.path.join(tmp, "wal")
+    cfg.generator.localblocks.data_dir = os.path.join(tmp, "lb")
+    app = App(cfg)
+    return [app.obs, RUNTIME], app
+
+
+__all__ = ["referenced_metric_names", "registered_metric_names",
+           "check_drift", "default_registries", "METRIC_NAME_RE"]

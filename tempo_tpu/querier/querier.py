@@ -17,6 +17,7 @@ import numpy as np
 from tempo_tpu.backend.meta import BlockMeta
 from tempo_tpu.db.tempodb import TempoDB
 from tempo_tpu.model.combine import combine_spans, sort_spans
+from tempo_tpu.obs import Registry
 from tempo_tpu.ops.hashing import token_for
 from tempo_tpu.overrides import Overrides
 from tempo_tpu.ring import Ring
@@ -42,6 +43,7 @@ class Querier:
                  ingester_clients: dict[str, IngesterQueryClient] | None = None,
                  overrides: Overrides | None = None,
                  cfg: QuerierConfig | None = None,
+                 registry: Registry | None = None,
                  now: Callable[[], float] = time.time) -> None:
         self.db = db
         self.ring = ingester_ring
@@ -49,6 +51,11 @@ class Querier:
         self.overrides = overrides or Overrides()
         self.cfg = cfg or QuerierConfig()
         self.now = now
+        self.obs = registry if registry is not None else Registry()
+        self.block_scan_duration = self.obs.histogram(
+            "tempo_querier_block_scan_duration_seconds",
+            "One frontend-sharded backend block job, by op "
+            "(search or metrics)", labels=("op",))
 
     # -- trace by id -------------------------------------------------------
 
@@ -104,9 +111,14 @@ class Querier:
                      limit: int = 20,
                      start_s: float | None = None, end_s: float | None = None):
         """One frontend-sharded backend job (`SearchBlock` `querier.go:780`)."""
-        return self.db.search(tenant, query, limit=limit,
-                              start_s=start_s, end_s=end_s,
-                              metas=[meta], row_groups=row_groups)
+        t0 = time.perf_counter()
+        try:
+            return self.db.search(tenant, query, limit=limit,
+                                  start_s=start_s, end_s=end_s,
+                                  metas=[meta], row_groups=row_groups)
+        finally:
+            self.block_scan_duration.observe(time.perf_counter() - t0,
+                                             ("search",))
 
     def query_range_block(self, tenant: str, req, meta: BlockMeta,
                           row_groups: Sequence[int] | None = None,
@@ -114,10 +126,15 @@ class Querier:
                           clip_end_ns: int | None = None):
         """One metrics job: raw evaluator over a block slice; job-level
         series to be combined at the frontend (AggregateModeSum)."""
-        return self.db.query_range(tenant, req, metas=[meta],
-                                   row_groups=row_groups,
-                                   clip_start_ns=clip_start_ns,
-                                   clip_end_ns=clip_end_ns)
+        t0 = time.perf_counter()
+        try:
+            return self.db.query_range(tenant, req, metas=[meta],
+                                       row_groups=row_groups,
+                                       clip_start_ns=clip_start_ns,
+                                       clip_end_ns=clip_end_ns)
+        finally:
+            self.block_scan_duration.observe(time.perf_counter() - t0,
+                                             ("metrics",))
 
     # -- tags --------------------------------------------------------------
 

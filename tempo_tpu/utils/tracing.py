@@ -193,6 +193,14 @@ def span(name: str, **attrs):
     return _tracer.span(name, **attrs)
 
 
+def current_trace_id_hex() -> "str | None":
+    """Trace id of the active span (local or adopted remote context), or
+    None outside any span — the metrics-side exemplar bridge: slow
+    requests stamp this onto their histogram observation."""
+    s = _current_span.get()
+    return s.trace_id.hex() if s is not None else None
+
+
 def span_for_tenant(name: str, tenant: str, **attrs):
     """Like span(), but a NO-OP for the self-tracing tenant: in dogfood
     mode (exporting into this very process) tracing the ingestion of our
@@ -216,4 +224,4 @@ def adopted(traceparent: str | None):
 
 
 __all__ = ["SelfTracer", "NoopTracer", "install", "tracer", "span",
-           "span_for_tenant", "adopted"]
+           "span_for_tenant", "adopted", "current_trace_id_hex"]

@@ -15,12 +15,9 @@ from typing import Sequence
 OVERFLOW = "__overflow__"
 MISSING = "__missing__"
 
-
-def escape_label(v: str) -> str:
-    """Prometheus exposition label escaping: backslash, quote, newline.
-    Attacker-controlled values (tenant header, span attrs) must never be
-    able to forge or corrupt exposition lines."""
-    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+# canonical escaping lives in the obs registry; re-exported for callers
+# that predate it
+from tempo_tpu.obs import escape_label  # noqa: E402,F401
 
 
 @dataclasses.dataclass
@@ -84,23 +81,36 @@ class UsageTracker:
                 ent[0] += nbytes
                 ent[1] += n
 
-    def prometheus_text(self) -> str:
-        """`/usage_metrics` exposition."""
-        dims = self.cfg.dimensions
-        lines = []
+    def snapshot(self) -> list[tuple[tuple, int, int]]:
+        """[(label values (tenant, *dims), bytes, spans)] under the lock."""
+        out = []
         with self._lock:
             for tenant in sorted(self._series):
-                for vals, (nbytes, nspans) in sorted(self._series[tenant].items()):
-                    labels = ",".join(
-                        [f'tenant="{escape_label(tenant)}"'] +
-                        [f'{d}="{escape_label(v)}"' for d, v in zip(dims, vals)])
-                    lines.append(
-                        f"tempo_usage_tracker_bytes_received_total{{{labels}}} "
-                        f"{int(nbytes)}")
-                    lines.append(
-                        f"tempo_usage_tracker_spans_received_total{{{labels}}} "
-                        f"{nspans}")
-        return "\n".join(lines) + ("\n" if lines else "")
+                for vals, (nbytes, nspans) in sorted(
+                        self._series[tenant].items()):
+                    out.append(((tenant, *vals), int(nbytes), int(nspans)))
+        return out
+
+    def prometheus_text(self) -> str:
+        """`/usage_metrics` exposition — rendered by the same obs writer
+        as `/metrics` (one escaping/HELP/TYPE implementation, not two
+        hand-rolled ones)."""
+        from tempo_tpu.obs import Registry
+
+        reg = Registry()
+        labels = ("tenant",) + self.cfg.dimensions
+        snap = self.snapshot()      # one lock + sort, feeding both families
+        reg.counter_func(
+            "tempo_usage_tracker_bytes_received_total",
+            lambda: [(vals, nbytes) for vals, nbytes, _ in snap],
+            help="Cost-attributed bytes received, by tenant and dimension",
+            labels=labels)
+        reg.counter_func(
+            "tempo_usage_tracker_spans_received_total",
+            lambda: [(vals, nspans) for vals, _, nspans in snap],
+            help="Cost-attributed spans received, by tenant and dimension",
+            labels=labels)
+        return reg.render()
 
 
 def _span_size(s: dict) -> int:

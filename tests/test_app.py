@@ -357,12 +357,16 @@ def test_jaeger_receiver(server):
 
 def test_ops_files_reference_only_emitted_metrics(server):
     """Every tempo_* metric named in operations/ dashboards + alerts must
-    be one the server actually emits (VERDICT r3 item 9: no aspirational
-    metric names). Counter-gated metrics that need error traffic to appear
-    are verified against the exposition source instead."""
+    be REGISTERED in the obs registry (the drift gate: no aspirational
+    metric names), and the core write-path names must actually appear on
+    /metrics after traffic — byte-compatible with the pre-registry
+    exposition."""
     import os
     import re
     import time
+
+    from tempo_tpu.obs import drift
+    from tempo_tpu.obs.jaxruntime import RUNTIME
 
     app, base = server
     t0 = int((time.time() - 5) * 1e9)
@@ -374,31 +378,24 @@ def test_ops_files_reference_only_emitted_metrics(server):
     _get(f"{base}/api/metrics/query_range?q=" +
          urllib.parse.quote("{ } | rate()") +
          f"&start={now - 300}&end={now}&step=300")
-    with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
-        emitted = set(re.findall(r"^(tempo_[a-z_]+)", r.read().decode(),
-                                 re.M))
 
     import tempo_tpu.app.api as api_mod
-    src = open(api_mod.__file__).read()
     ops_dir = os.path.join(os.path.dirname(api_mod.__file__),
                            "..", "..", "operations")
-    referenced: set[str] = set()
-    for root, _dirs, files in os.walk(ops_dir):
-        for fname in files:
-            if fname.endswith((".json", ".yaml")):
-                if fname in ("docker-compose.yaml", "k8s.yaml"):
-                    continue
-                text = open(os.path.join(root, fname)).read()
-                referenced |= set(re.findall(r"tempo_[a-z_]+", text))
-    assert referenced, "no metrics referenced — ops files missing?"
-    for name in sorted(referenced):
-        if name in emitted:
-            continue
-        # counter-gated (appears only on errors/reports): its literal or
-        # construction prefix must exist in the exposition source
-        assert (name in src
-                or any(name.startswith(p) and p in src for p in
-                       ("tempo_read_plane_", "tempo_distributor_"))), name
+    assert drift.referenced_metric_names(ops_dir), \
+        "no metrics referenced — ops files missing?"
+    problems = drift.check_drift(ops_dir, [app.obs, RUNTIME])
+    assert not problems, "\n".join(problems)
+
+    with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+        text = r.read().decode()
+    emitted = set(re.findall(r"^(tempo_[a-z_]+)", text, re.M))
+    for name in ("tempo_distributor_spans_received_total",
+                 "tempo_distributor_bytes_received_total",
+                 "tempo_query_frontend_queries_total",
+                 "tempo_ingester_live_traces",
+                 "tempo_request_duration_seconds_bucket"):
+        assert name in emitted, name
 
 
 def test_v2_api_endpoints(server):

@@ -69,11 +69,15 @@ def test_usage_label_escaping():
     evil = 'a"} 999\ninjected_metric{x="y'
     t.observe("ten\"ant", [{"service": evil}])
     text = t.prometheus_text()
-    # no forged exposition line: every physical line is one of ours, raw
+    # no forged exposition line: every physical line is one of ours (a
+    # sample or HELP/TYPE metadata from the shared obs renderer), raw
     # newlines/quotes in values are escaped
     for line in text.strip().splitlines():
-        assert line.startswith("tempo_usage_tracker_")
+        assert line.startswith(("tempo_usage_tracker_", "# ")), line
     assert '\\n' in text and '\\"' in text
+    # and the output is well-formed exposition end to end
+    from tempo_tpu.obs import parse_exposition
+    parse_exposition(text)
 
 
 def test_hedged_reader_wraps_reads():
