@@ -429,7 +429,77 @@ def bench_obs() -> dict:
         finally:
             srv.shutdown()
             app.shutdown()
+    out.update(_bench_query_stats())
     return out
+
+
+def _bench_query_stats() -> dict:
+    """Request-scoped stats + query-log cost on the search hot path:
+    the SAME tempodb search with an active QueryStats scope (every
+    block-fetch/engine record fires) vs without (each record is one
+    contextvar None check) — budget <3%, matching the push-path
+    instrumentation budget. Plus the per-request fixed cost of one
+    `QueryLogger.log_query` decision (the suppressed path, which is what
+    every non-logged query pays)."""
+    import statistics
+
+    from tempo_tpu.backend.mem import MemBackend
+    from tempo_tpu.db.tempodb import TempoDB
+    from tempo_tpu.obs import querystats
+    from tempo_tpu.obs.qlog import QueryLogger
+
+    t_base = 1_700_000_000.0
+    be = MemBackend()
+    db = TempoDB(be, be)
+    traces = []
+    for i in range(20_000):
+        tid = i.to_bytes(16, "big")
+        t0 = int((t_base + i * 0.01) * 1e9)
+        traces.append((tid, [{
+            "trace_id": tid, "span_id": i.to_bytes(8, "big"),
+            "name": f"op-{i % 50}", "service": f"svc-{i % 8}",
+            "start_unix_nano": t0, "end_unix_nano": t0 + 50_000_000}]))
+    db.write_block("bench", traces, replication_factor=1)
+    db.poll_now()
+    query = '{ resource.service.name = "svc-3" }'
+
+    def search():
+        return db.search("bench", query, limit=20,
+                         start_s=t_base, end_s=t_base + 3600)
+
+    search()                               # warm plane cache + jit
+    with querystats.scope():
+        search()
+    t_on: list[float] = []
+    t_off: list[float] = []
+    for _ in range(30):
+        t0 = time.perf_counter()
+        with querystats.scope():
+            search()
+        t_on.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        search()
+        t_off.append(time.perf_counter() - t0)
+    med_on, med_off = statistics.median(t_on), statistics.median(t_off)
+    db.shutdown()
+
+    ql = QueryLogger(sample_every=10**9, min_observations=10**9)
+    ql.log_query(op="search", tenant="bench", query=query, status="ok",
+                 duration_s=med_on)
+    t0 = time.perf_counter()
+    iters = 10_000
+    for _ in range(iters):
+        ql.log_query(op="search", tenant="bench", query=query,
+                     status="ok", duration_s=med_on)
+    qlog_us = (time.perf_counter() - t0) / iters * 1e6
+    pct = (med_on - med_off) / med_off * 100.0
+    return {
+        "qstats_search_on_ms": med_on * 1000,
+        "qstats_search_off_ms": med_off * 1000,
+        "qstats_search_overhead_pct": pct,
+        "qstats_overhead_ok": pct < 3.0,    # the ISSUE budget
+        "qstats_qlog_decide_us": qlog_us,
+    }
 
 
 def _bench_scan_plane(db) -> dict:
@@ -793,6 +863,14 @@ def main() -> int:
         "obs_scrape_ms": round(results["obs_scrape_ms"], 3)
         if "obs_scrape_ms" in results else None,
         "obs_scrape_bytes": results.get("obs_scrape_bytes"),
+        # request-scoped query stats + qlog cost on the search hot path
+        # (ISSUE 2 satellite: accumulation + logging overhead <3%)
+        "qstats_search_overhead_pct": round(
+            results["qstats_search_overhead_pct"], 3)
+        if "qstats_search_overhead_pct" in results else None,
+        "qstats_overhead_ok": results.get("qstats_overhead_ok"),
+        "qstats_qlog_decide_us": round(results["qstats_qlog_decide_us"], 3)
+        if "qstats_qlog_decide_us" in results else None,
     }
     if errors:
         extra["errors"] = errors

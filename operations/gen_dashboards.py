@@ -146,6 +146,15 @@ def dashboards() -> dict[str, dict]:
                   legend="{{op}}"),
                 p("Query shard fan-out p99",
                   _p99("tempo_query_frontend_shard_fanout")),
+                p("Inspected bytes /s by tenant",
+                  _rate("tempo_tpu_query_inspected_bytes_total", "tenant"),
+                  legend="{{tenant}}"),
+                p("Blocks scanned /s by tenant",
+                  _rate("tempo_tpu_query_blocks_scanned_total", "tenant"),
+                  legend="{{tenant}}"),
+                p("Query-log records /s by reason",
+                  _rate("tempo_query_log_records_total", "reason"),
+                  legend="{{reason}}"),
             ]),
         "tempo-tpu-writes.json": dash(
             "Tempo-TPU / Writes",

@@ -428,11 +428,15 @@ class Handler(BaseHTTPRequestHandler):
             return self._reply(200, _json_bytes(
                 {"spans": spans_to_json(spans) if spans else None}))
         if path == "/internal/ingester/search":
-            res = self.app.ingester.search(
-                tenant, q.get("q", "{ }"), int(q.get("limit", 20)),
-                float(q.get("start", 0)), float(q.get("end", 0)))
+            from tempo_tpu.obs import querystats
+            with querystats.scope() as st:   # stats trailer for the caller
+                res = self.app.ingester.search(
+                    tenant, q.get("q", "{ }"), int(q.get("limit", 20)),
+                    float(q.get("start", 0)), float(q.get("end", 0)))
+            st.floor_inspected_traces(len(res))
             return self._reply(200, _json_bytes(
-                {"traces": [md.to_json() for md in res]}))
+                {"traces": [md.to_json() for md in res],
+                 "stats": st.to_json()}))
         if path == "/internal/ingester/tags":
             return self._reply(200, _json_bytes(
                 {"scopes": self.app.ingester.tag_names(tenant)}))
@@ -462,14 +466,21 @@ class Handler(BaseHTTPRequestHandler):
         self._reply(200, _json_bytes({"trace_id": hexid, "spans": out}))
 
     def _search(self, tenant: str, q: dict) -> None:
-        res = self.app.frontend.search(
-            tenant, q.get("q", "{ }"),
-            limit=int(q.get("limit", 20)),
-            start_s=float(q["start"]) if "start" in q else None,
-            end_s=float(q["end"]) if "end" in q else None)
+        from tempo_tpu.obs import querystats
+
+        # request-scoped stats: the frontend (and every shard job under
+        # it) records into this scope; the response carries the merged
+        # SearchMetrics, like the reference's frontend combiner
+        with querystats.scope() as st:
+            res = self.app.frontend.search(
+                tenant, q.get("q", "{ }"),
+                limit=int(q.get("limit", 20)),
+                start_s=float(q["start"]) if "start" in q else None,
+                end_s=float(q["end"]) if "end" in q else None)
+        st.floor_inspected_traces(len(res))
         self._reply(200, _json_bytes({
             "traces": [md.to_json() for md in res],
-            "metrics": {"inspectedTraces": len(res)}}))
+            "metrics": st.search_metrics()}))
 
     def _tags(self, tenant: str, q: dict, v2: bool = False) -> None:
         names = self.app.frontend.tag_names(tenant)
@@ -505,10 +516,13 @@ class Handler(BaseHTTPRequestHandler):
             "tagValues": [str(v.get("value", "")) for v in vals]}))
 
     def _query_range(self, tenant: str, q: dict) -> None:
-        series = self.app.frontend.query_range(
-            tenant, q.get("q") or q.get("query", ""),
-            start_s=float(q["start"]), end_s=float(q["end"]),
-            step_s=float(q.get("step", 60)))
+        from tempo_tpu.obs import querystats
+
+        with querystats.scope() as st:
+            series = self.app.frontend.query_range(
+                tenant, q.get("q") or q.get("query", ""),
+                start_s=float(q["start"]), end_s=float(q["end"]),
+                step_s=float(q.get("step", 60)))
         from tempo_tpu.traceql.engine_metrics import QueryRangeRequest
         req = QueryRangeRequest(
             query=q.get("q") or q.get("query", ""),
@@ -517,7 +531,8 @@ class Handler(BaseHTTPRequestHandler):
             step_ns=int(float(q.get("step", 60)) * 1e9))
         ts_ms = req.step_timestamps_ms()
         self._reply(200, _json_bytes({
-            "series": [s.to_json(ts_ms) for s in series]}))
+            "series": [s.to_json(ts_ms) for s in series],
+            "metrics": st.search_metrics()}))
 
     def _query_instant(self, tenant: str, q: dict) -> None:
         """PathMetricsQueryInstant (`http.go:80`): one value per series —

@@ -158,6 +158,17 @@ class App:
         self.obs.counter_func(
             "tempo_usage_stats_reports_written_total", reports,
             help="Usage-stats reports written by the leader reporter")
+
+        def tracer_dropped():
+            from tempo_tpu.utils import tracing
+            return [((), float(getattr(tracing.tracer(), "dropped", 0)))]
+
+        # registered unconditionally (NoopTracer reports 0) so the drift
+        # gate sees the family whether or not self-tracing is configured
+        self.obs.counter_func(
+            "tempo_self_tracer_dropped_spans_total", tracer_dropped,
+            help="Self-tracing spans lost to buffer overflow or failed "
+                 "OTLP exports (silent span loss is an alerting signal)")
         # the serving-surface histograms are registered eagerly so the
         # drift gate sees them before any request arrives; the HTTP
         # handler and gRPC server observe through these App handles (one

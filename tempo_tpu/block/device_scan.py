@@ -44,6 +44,7 @@ import functools
 import re
 import os
 import threading
+import time
 from typing import Optional, Sequence
 
 import numpy as np
@@ -565,6 +566,10 @@ class BlockScanPlane:
         self.device_bytes += int(arr.nbytes)
         from tempo_tpu.obs.jaxruntime import record_device_put
         record_device_put(int(arr.nbytes), "plane_column")
+        # per-request attribution: the query that forced this adoption
+        # pays the upload — later queries ride the resident copy for free
+        from tempo_tpu.obs import querystats
+        querystats.add(device_scan_bytes=int(arr.nbytes))
         return d
 
     def _host_col(self, attr: A.Attribute) -> Optional[Col]:
@@ -1015,8 +1020,16 @@ class BlockScanPlane:
 
     def mask(self, preds: Sequence, all_conditions: bool,
              time_range=None, row_groups=None) -> Optional[np.ndarray]:
+        from tempo_tpu.obs import querystats
+
         m = self.mask_async(preds, all_conditions, time_range, row_groups)
-        return None if m is None else self.unpack_mask(np.asarray(m))
+        if m is None:
+            return None
+        t0 = time.perf_counter_ns()
+        with querystats.stage("device_scan"):
+            packed = np.asarray(m)        # the sync point: device → host
+        querystats.add(kernel_wall_ns=time.perf_counter_ns() - t0)
+        return self.unpack_mask(packed)
 
     def unpack_mask(self, packed: np.ndarray) -> np.ndarray:
         """Bit-packed device mask → bool[n]."""

@@ -456,11 +456,19 @@ def scan_views(block: BackendBlock, req: Optional[FetchSpansRequest] = None,
     (full pipeline) decides final membership, exactly the two-pass split of
     `traceql.Engine.ExecuteSearch` (`engine.go:82-113`).
     """
+    from tempo_tpu.obs import querystats
+
     columns = columns_for_request(block, req)
     pf = block.parquet_file()
     rgs = range(pf.num_row_groups) if row_groups is None else row_groups
     for rg in rgs:
-        tbl = pf.read_row_group(rg, columns=columns)
+        with querystats.stage("block_fetch"):
+            tbl = pf.read_row_group(rg, columns=columns)
+        if req is not None:
+            # bytes materialized for an actual query scan (req=None is
+            # the plane-cache adoption read — CachedBlock.scan accounts
+            # resident-view bytes per query instead)
+            querystats.add(inspected_bytes=tbl.nbytes)
         view = view_from_table(block, tbl)
         _install_attr_hook(view)
         if req is not None:

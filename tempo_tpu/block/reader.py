@@ -22,6 +22,7 @@ import pyarrow.parquet as pq
 
 from tempo_tpu.backend.meta import BlockMeta
 from tempo_tpu.backend.raw import DoesNotExist, RawReader, block_keypath
+from tempo_tpu.obs import querystats
 from tempo_tpu.block import schema as bs
 from tempo_tpu.block.bloom import BloomFilter, shard_name
 from tempo_tpu.block.writer import DATA_NAME, INDEX_NAME
@@ -106,6 +107,7 @@ class BackendBlock:
         binary search on the index bounds → single-group read."""
         tid = bytes(trace_id).ljust(16, b"\0")[:16]
         if not self._bloom_maybe(tid):
+            querystats.add(blocks_skipped=1)      # bloom prune
             return None
         hexid = tid.hex()
         pf = self.parquet_file()
@@ -116,10 +118,15 @@ class BackendBlock:
         else:
             rgs = list(range(pf.num_row_groups))  # index lost: full scan
         if not rgs:
+            querystats.add(blocks_skipped=1)      # row-group bounds prune
             return None
+        querystats.add(blocks_scanned=1)
         out: list[dict] = []
         for rg in rgs:
-            tbl = pf.read_row_group(rg)
+            with querystats.stage("block_fetch"):
+                tbl = pf.read_row_group(rg)
+            querystats.add(inspected_bytes=tbl.nbytes,
+                           inspected_spans=tbl.num_rows)
             sel = np.asarray(tbl.column("trace_id").to_numpy(zero_copy_only=False)) == tid
             if sel.any():
                 out.extend(_rows_to_spans(tbl, np.flatnonzero(sel)))
@@ -139,7 +146,9 @@ class BackendBlock:
         index = self.row_group_index()
         rgs = range(pf.num_row_groups) if row_groups is None else row_groups
         for rg in rgs:
-            tbl = pf.read_row_group(rg, columns=list(columns) if columns else None)
+            with querystats.stage("block_fetch"):
+                tbl = pf.read_row_group(rg, columns=list(columns) if columns else None)
+            querystats.add(inspected_bytes=tbl.nbytes)
             out: dict = {"_rows": tbl.num_rows}
             out["_row_offset"] = index[rg]["row_offset"] if rg < len(index) else None
             for name in tbl.schema.names:

@@ -66,9 +66,17 @@ class CachedBlock:
         first pass runs on device when every predicate shape is supported,
         else falls back to the host mask per view."""
         from tempo_tpu.block.fetch import condition_mask, prefilter_is_noop
+        from tempo_tpu.obs import querystats
 
-        idxs = (range(len(self.views)) if row_groups is None
-                else [i for i in row_groups if 0 <= i < len(self.views)])
+        idxs = list(range(len(self.views)) if row_groups is None
+                    else (i for i in row_groups
+                          if 0 <= i < len(self.views)))
+        # read-cost attribution for cache-served scans: each row-group
+        # view the query examines charges its share of the block's
+        # resident (uncompressed) size — warm queries inspect the same
+        # data a cold scan would have read
+        querystats.add(inspected_bytes=len(idxs) * (
+            self._base_host_bytes // max(len(self.views), 1)))
         if req is None:
             for i in idxs:
                 yield self.views[i], np.arange(self.views[i].n)

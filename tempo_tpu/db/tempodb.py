@@ -26,6 +26,7 @@ from tempo_tpu.db.pool import Pool
 from tempo_tpu.db.poller import Poller, PollerConfig
 from tempo_tpu.model.combine import combine_spans
 from tempo_tpu.obs import Registry
+from tempo_tpu.obs import querystats
 
 log = logging.getLogger("tempo_tpu.db")
 
@@ -182,7 +183,8 @@ class TempoDB:
         lo = shard_bounds[0].hex() if shard_bounds else None
         hi = shard_bounds[1].hex() if shard_bounds else None
         out = []
-        for m in self.blocklist.metas(tenant):
+        metas = self.blocklist.metas(tenant)
+        for m in metas:
             if start_s is not None and m.end_time < start_s:
                 continue
             if end_s is not None and m.start_time > end_s:
@@ -192,6 +194,9 @@ class TempoDB:
             if hi is not None and m.min_trace_id and m.min_trace_id > hi:
                 continue
             out.append(m)
+        # time/shard prunes into the ambient query scope (no-op outside a
+        # request — poll and compaction loops call this too)
+        querystats.add(blocks_skipped=len(metas) - len(out))
         return out
 
     def find_trace_by_id(self, tenant: str, trace_id: bytes,
@@ -279,8 +284,11 @@ class TempoDB:
 
         def drain(to: int) -> None:
             while len(handles) > to:
-                with kernel_timer("plane_metrics_grid"):
+                t0 = time.perf_counter_ns()
+                with kernel_timer("plane_metrics_grid"), \
+                        querystats.stage("device_scan"):
                     labels, main, cnt, vcnt = handles.pop(0).fetch()
+                querystats.add(kernel_wall_ns=time.perf_counter_ns() - t0)
                 fused_parts.append(grid_series(ev.m, labels, main, cnt,
                                                vcnt))
 
@@ -294,6 +302,11 @@ class TempoDB:
                     clip_start_ns, clip_end_ns, row_groups)
             if handle is not None:
                 self.plane_stats["fused_metric_blocks"] += 1
+                # the fused path never surfaces row bytes to the host —
+                # charge the block slice's stored size as inspected
+                n_rg = max(m.row_group_count, 1)
+                frac = (len(row_groups) / n_rg) if row_groups else 1.0
+                querystats.add(inspected_bytes=int(m.size_bytes * frac))
                 handles.append(handle)
                 fused_blocks.append(cb)
                 drain(MAX_INFLIGHT - 1)   # pipeline, bounded residency

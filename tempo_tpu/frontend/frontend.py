@@ -27,6 +27,9 @@ from tempo_tpu.frontend.sharders import (
 from tempo_tpu.frontend.slos import SLOConfig, SLORecorder
 from tempo_tpu.model.combine import combine_spans, sort_spans
 from tempo_tpu.obs import Registry, exponential_buckets
+from tempo_tpu.obs import querystats
+from tempo_tpu.obs.qlog import QueryLogger
+from tempo_tpu.obs.querystats import QueryStats
 from tempo_tpu.overrides import Overrides
 from tempo_tpu.querier.querier import Querier
 from tempo_tpu.traceql.engine import MetadataCombiner
@@ -52,11 +55,17 @@ class FrontendConfig:
     # all blocks for single-writer deployments whose blocks are deduped
     metrics_block_rf: int | None = 1
     slo: dict[str, SLOConfig] = dataclasses.field(default_factory=dict)
+    # structured query log (obs/qlog.py): errors always log; queries over
+    # the sketch-estimated `qlog_slow_quantile` latency log as slow;
+    # 1-in-`qlog_sample_every` of the rest logs, under a token-bucket cap
+    qlog_slow_quantile: float = 0.95
+    qlog_sample_every: int = 100
+    qlog_rate_limit_per_s: float = 10.0
 
 
 class _Job:
     __slots__ = ("job", "fn", "spec", "result", "error", "event", "_lock",
-                 "_claimed", "enqueued_at", "queue_wait")
+                 "_claimed", "enqueued_at", "queue_wait", "stats")
 
     def __init__(self, job: SearchJob, fn: Callable[[SearchJob], Any],
                  spec: dict | None = None):
@@ -74,6 +83,11 @@ class _Job:
         # workers, remote streams, and the issuer's inline fallback
         self.enqueued_at: float | None = None
         self.queue_wait = None
+        # per-job QueryStats: the executor (worker thread, remote stream
+        # reader, or inline fallback) records into it; the issuer merges
+        # it into the parent request scope at fold time — contextvars do
+        # not cross the thread-pool boundary, per-job objects do
+        self.stats = QueryStats()
 
     def try_claim(self) -> bool:
         """Exactly-once execution claim: local workers, remote worker
@@ -83,8 +97,11 @@ class _Job:
             if self._claimed:
                 return False
             self._claimed = True
-        if self.queue_wait is not None and self.enqueued_at is not None:
-            self.queue_wait.observe(time.perf_counter() - self.enqueued_at)
+        if self.enqueued_at is not None:
+            wait_s = time.perf_counter() - self.enqueued_at
+            if self.queue_wait is not None:
+                self.queue_wait.observe(wait_s)
+            self.stats.add_stage_ns("queue_wait", int(wait_s * 1e9))
         return True
 
     def run(self) -> None:
@@ -94,7 +111,8 @@ class _Job:
 
     def run_claimed(self) -> None:
         try:
-            self.result = self.fn(self.job)
+            with querystats.scope(self.stats):
+                self.result = self.fn(self.job)
         except Exception as e:  # combiner decides whether partials suffice
             self.error = e
         self.event.set()
@@ -146,6 +164,16 @@ class Frontend:
             from tempo_tpu.backend.cache import ROLE_FRONTEND_SEARCH
 
             self._job_cache = cache_provider.cache_for(ROLE_FRONTEND_SEARCH)
+        self.qlog = QueryLogger(
+            slow_quantile=self.cfg.qlog_slow_quantile,
+            sample_every=self.cfg.qlog_sample_every,
+            rate_limit_per_s=self.cfg.qlog_rate_limit_per_s,
+            now=now)
+        # per-tenant read-cost accounting, fed once per finished request
+        # from its merged QueryStats (render-time callback families — the
+        # hot path never touches the registry)
+        self._tenant_read_lock = threading.Lock()
+        self._tenant_read_cost: dict[str, dict[str, int]] = {}
         self.obs = registry if registry is not None else Registry()
         self._register_obs(self.obs)
 
@@ -181,6 +209,31 @@ class Frontend:
             "tempo_query_frontend_shard_fanout",
             "Sub-requests one query sharded into",
             buckets=exponential_buckets(1.0, 2.0, 12))
+
+        def read_cost(field):
+            def fn():
+                with self._tenant_read_lock:
+                    return [((t,), c.get(field, 0))
+                            for t, c in self._tenant_read_cost.items()]
+            return fn
+
+        reg.counter_func(
+            "tempo_tpu_query_inspected_bytes_total",
+            read_cost("inspected_bytes"),
+            help="Bytes of block data inspected by queries, per tenant "
+                 "(merged request-scoped QueryStats — read-cost accounting)",
+            labels=("tenant",))
+        reg.counter_func(
+            "tempo_tpu_query_blocks_scanned_total",
+            read_cost("blocks_scanned"),
+            help="Backend block slices scanned by queries, per tenant",
+            labels=("tenant",))
+        reg.counter_func(
+            "tempo_query_log_records_total",
+            self.qlog.emitted_by_reason,
+            help="Query-log emission outcomes (error/slow/sampled lines "
+                 "written, suppressed = sampled-out or rate-limited)",
+            labels=("reason",))
 
     def _record_op(self, op: str, tenant: str, latency_s: float,
                    nbytes: int) -> None:
@@ -258,6 +311,7 @@ class Frontend:
         who would have executed them — inline, local worker, or remote
         worker stream. key_fn returning None marks a job uncacheable."""
         self.shard_fanout.observe(float(len(jobs)))
+        querystats.add(total_jobs=len(jobs))
         key_fn = encode = decode = None
         if cache is not None and self._job_cache is not None:
             key_fn, encode, decode = cache
@@ -288,7 +342,16 @@ class Frontend:
                     except Exception:
                         pass           # cache write is best-effort
             nbytes += _job_bytes(job)
-            return on_result(result)
+            # shard stats → parent request scope (per-job accumulators for
+            # executed jobs; a cache hit inspected nothing this time)
+            wj = wrapped[idx]
+            if wj is not None:
+                querystats.absorb(wj.stats)
+            else:
+                querystats.add(cache_hits=1)
+            querystats.add(completed_jobs=1)
+            with querystats.stage("merge"):
+                return on_result(result)
 
         if not self._workers and not self.remote_workers:
             for idx, j in enumerate(jobs):    # inline single-binary path
@@ -341,6 +404,32 @@ class Frontend:
 
     # -- endpoints ---------------------------------------------------------
 
+    def _finish_query(self, op: str, tenant: str, query: str,
+                      duration_s: float, st: QueryStats,
+                      error: Exception | None = None) -> None:
+        """Close out one frontend request: per-tenant read-cost counters
+        and exactly one structured "query complete" log decision — called
+        once per public endpoint invocation, success or failure."""
+        from tempo_tpu.utils import tracing
+
+        # normalize the label the same way every per-tenant metric does
+        # (' a ' → 'a', 'a|a' → 'a'); a true federation keeps its composite
+        # 'a|b' label — merged stats cannot be apportioned per member
+        tenant = "|".join(split_tenants(tenant))
+        sm = st.search_metrics()
+        with self._tenant_read_lock:
+            cost = self._tenant_read_cost.setdefault(tenant, {})
+            cost["inspected_bytes"] = \
+                cost.get("inspected_bytes", 0) + sm["inspectedBytes"]
+            cost["blocks_scanned"] = \
+                cost.get("blocks_scanned", 0) + sm["blocksScanned"]
+        self.qlog.log_query(
+            op=op, tenant=tenant, query=query,
+            status="error" if error is not None else "ok",
+            duration_s=duration_s, stats=st,
+            trace_id=tracing.current_trace_id_hex(),
+            error=str(error) if error is not None else None)
+
     def search(self, tenant: str, query: str, *, limit: int = 20,
                start_s: float | None = None, end_s: float | None = None,
                on_partial: Callable[[list], None] | None = None
@@ -349,25 +438,41 @@ class Frontend:
         after each fold — the hook the streaming gRPC endpoint uses to
         emit diff responses (`combiner/search.go`)."""
         from tempo_tpu.utils import tracing
-        with tracing.span_for_tenant("frontend.Search", tenant, query=query):
-            tenants = split_tenants(tenant)
-            if len(tenants) == 1:
-                # normalized: 'a|a', 'a|', ' a ' all mean tenant 'a'
-                return self._search(tenants[0], query, limit=limit,
-                                    start_s=start_s, end_s=end_s,
-                                    on_partial=on_partial)
-            # multi-tenant federation: fan out per tenant, merge through
-            # the same top-N combiner (frontend.go:113-136)
-            comb = MetadataCombiner(limit)
-            for t in tenants:
-                for md in self._search(t, query, limit=limit,
-                                       start_s=start_s, end_s=end_s):
-                    comb.add(md)
-                if on_partial is not None:
-                    on_partial(comb.results())
-                if comb.exhausted():
-                    break               # top-N full: skip remaining tenants
-            return comb.results()
+        t0 = self.now()
+        with tracing.span_for_tenant("frontend.Search", tenant, query=query), \
+                querystats.ensure_scope() as st:
+            try:
+                res = self._search_fanout(tenant, query, limit=limit,
+                                          start_s=start_s, end_s=end_s,
+                                          on_partial=on_partial)
+            except Exception as e:
+                self._finish_query("search", tenant, query,
+                                   self.now() - t0, st, error=e)
+                raise
+            self._finish_query("search", tenant, query, self.now() - t0, st)
+            return res
+
+    def _search_fanout(self, tenant: str, query: str, *, limit: int,
+                       start_s: float | None, end_s: float | None,
+                       on_partial: Callable[[list], None] | None) -> list:
+        tenants = split_tenants(tenant)
+        if len(tenants) == 1:
+            # normalized: 'a|a', 'a|', ' a ' all mean tenant 'a'
+            return self._search(tenants[0], query, limit=limit,
+                                start_s=start_s, end_s=end_s,
+                                on_partial=on_partial)
+        # multi-tenant federation: fan out per tenant, merge through
+        # the same top-N combiner (frontend.go:113-136)
+        comb = MetadataCombiner(limit)
+        for t in tenants:
+            for md in self._search(t, query, limit=limit,
+                                   start_s=start_s, end_s=end_s):
+                comb.add(md)
+            if on_partial is not None:
+                on_partial(comb.results())
+            if comb.exhausted():
+                break               # top-N full: skip remaining tenants
+        return comb.results()
 
     def _search(self, tenant: str, query: str, *, limit: int = 20,
                 start_s: float | None = None, end_s: float | None = None,
@@ -388,6 +493,7 @@ class Frontend:
                 on_partial(combiner.results())
         if be_win is not None and not combiner.exhausted():
             metas = self.db.blocks(tenant, be_win[0], be_win[1])
+            querystats.add(total_blocks=len(metas))
             jobs = backend_search_jobs(tenant, metas, be_win[0], be_win[1],
                                        self.cfg.target_bytes_per_job)
 
@@ -456,11 +562,21 @@ class Frontend:
             # the metrics endpoints (frontend.go:163-175 analog)
             raise UnsupportedMultiTenant(
                 "multi-tenant query of the metrics endpoint is not supported")
+        t0 = self.now()
         with tracing.span_for_tenant("frontend.QueryRange", tenants[0],
-                                     query=query):
-            return self._query_range(tenants[0], query, start_s=start_s,
-                                     end_s=end_s, step_s=step_s,
-                                     on_partial=on_partial)
+                                     query=query), \
+                querystats.ensure_scope() as st:
+            try:
+                res = self._query_range(tenants[0], query, start_s=start_s,
+                                        end_s=end_s, step_s=step_s,
+                                        on_partial=on_partial)
+            except Exception as e:
+                self._finish_query("metrics", tenants[0], query,
+                                   self.now() - t0, st, error=e)
+                raise
+            self._finish_query("metrics", tenants[0], query,
+                               self.now() - t0, st)
+            return res
 
     def _query_range(self, tenant: str, query: str, *,
                      start_s: float, end_s: float, step_s: float = 60.0,
@@ -493,6 +609,7 @@ class Frontend:
             metas = prune_blocks_rf(
                 self.db.blocks(tenant, start_s, min(end_s, cutoff_s)),
                 self.cfg.metrics_block_rf)
+            querystats.add(total_blocks=len(metas))
             jobs = query_range_jobs(tenant, metas, start_s,
                                     min(end_s, cutoff_s), step_s,
                                     self.cfg.metrics_target_bytes_per_job)

@@ -77,11 +77,16 @@ class GrpcIngesterClient(_BaseGrpcClient):
     def search(self, tenant: str, query: str, limit: int = 20,
                start_s: float = 0, end_s: float = 0):
         from tempo_tpu.model import tempopb
+        from tempo_tpu.obs import querystats
 
         body = self._call(
             "/tempopb.Querier/SearchRecent",
             tempopb.enc_search_request(query, limit, start_s, end_s), tenant)
-        return tempopb.dec_search_response(body)[0]
+        mds, _final, _inspected, stats = tempopb.dec_search_response(body)
+        # the remote ingester's stats trailer folds into this process's
+        # ambient request scope (the gRPC-trailer merge direction)
+        querystats.absorb(stats)
+        return mds
 
     def tag_names(self, tenant: str) -> dict[str, list[str]]:
         res = _jload(self._call("/tempopb.Querier/SearchTags", b"{}", tenant))
@@ -143,7 +148,7 @@ def streaming_search(target: str, tenant: str, query: str, *,
 
         for msg in fn(_jdump(body), timeout=timeout_s,
                       metadata=(("x-scope-orgid", tenant),)):
-            mds, final, _inspected = tempopb.dec_search_response(msg)
+            mds, final, _inspected, _stats = tempopb.dec_search_response(msg)
             yield mds, final
 
 
@@ -250,11 +255,18 @@ class FrontendWorker:
                     outbox.put(self._execute(job))
 
     def _execute(self, job: dict) -> bytes:
+        from tempo_tpu.obs import querystats
+
         jid = job["job_id"]
         try:
-            result = execute_job_spec(self.querier, job["spec"])
+            # per-job stats scope: the worker-side half of the stats
+            # trailer — serialized into the result message so the
+            # frontend can merge shard stats into the parent request
+            with querystats.scope() as st:
+                result = execute_job_spec(self.querier, job["spec"])
             self.jobs_executed += 1
-            return _jdump({"type": "result", "job_id": jid, "result": result})
+            return _jdump({"type": "result", "job_id": jid, "result": result,
+                           "stats": st.to_json()})
         except Exception as e:
             return _jdump({"type": "error", "job_id": jid, "error": str(e)})
 

@@ -207,12 +207,18 @@ class _Services:
     def search_recent(self, request: bytes, context) -> bytes:
         tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
         from tempo_tpu.model import tempopb
+        from tempo_tpu.obs import querystats
 
         d = tempopb.dec_search_request(request)
-        res = self.app.ingester.search(
-            tenant, d.get("q", "{ }"), int(d.get("limit", 20)),
-            float(d.get("start", 0)), float(d.get("end", 0)))
-        return tempopb.enc_search_response(res, inspected=len(res))
+        # per-RPC stats scope, serialized into the response's metrics
+        # submessage — the gRPC-trailer analog the remote querier merges
+        # into its own request scope
+        with querystats.scope() as st:
+            res = self.app.ingester.search(
+                tenant, d.get("q", "{ }"), int(d.get("limit", 20)),
+                float(d.get("start", 0)), float(d.get("end", 0)))
+        st.floor_inspected_traces(len(res))
+        return tempopb.enc_search_response(res, inspected=len(res), stats=st)
 
     def search_tags(self, request: bytes, context) -> bytes:
         tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
@@ -262,8 +268,10 @@ class _Services:
         tenant = _tenant(context, self.app.cfg.multitenancy_enabled)
         d = _jload(request)
         from tempo_tpu.model import tempopb
+        from tempo_tpu.obs import querystats
 
         sent: set[str] = set()
+        stats_box: dict = {}
 
         def run_fn(emit):
             def on_partial(results) -> None:
@@ -272,17 +280,30 @@ class _Services:
                     sent.update(md.trace_id for md in fresh)
                     emit(fresh)
 
-            return self.app.frontend.search(
-                tenant, d.get("q", "{ }"), limit=int(d.get("limit", 20)),
-                start_s=float(d["start"]) if "start" in d else None,
-                end_s=float(d["end"]) if "end" in d else None,
-                on_partial=on_partial)
+            # scope opened on the stream's worker thread; the FINAL
+            # message carries the merged stats (SearchMetrics trailer)
+            with querystats.scope() as st:
+                stats_box["st"] = st
+                return self.app.frontend.search(
+                    tenant, d.get("q", "{ }"), limit=int(d.get("limit", 20)),
+                    start_s=float(d["start"]) if "start" in d else None,
+                    end_s=float(d["end"]) if "end" in d else None,
+                    on_partial=on_partial)
+
+        def enc_final(res) -> bytes:
+            st = stats_box.get("st")
+            if st is not None:
+                # legacy clients read only the scalar `inspected` (field 1
+                # == inspected_traces): keep its old len(res) floor even
+                # for fully cache-served queries
+                st.floor_inspected_traces(len(res or []))
+            return tempopb.enc_search_response(
+                res or [], inspected=len(res or []), final=True, stats=st)
 
         yield from self._stream_partials(
             context, run_fn,
             lambda batch: tempopb.enc_search_response(batch, final=False),
-            lambda res: tempopb.enc_search_response(
-                res or [], inspected=len(res or []), final=True))
+            enc_final)
 
     def streaming_metrics_query_range(self, request: bytes, context):
         """Server-streaming TraceQL metrics: series-DIFF messages as
@@ -397,6 +418,14 @@ class _Services:
                         if m["type"] == "result":
                             wj.result = fe.decode_job_result(
                                 wj.spec, m.get("result"))
+                            if m.get("stats"):
+                                # the worker's serialized per-job stats —
+                                # folded into the parent request when the
+                                # issuer folds this job's result
+                                from tempo_tpu.obs.querystats import \
+                                    QueryStats
+                                wj.stats.merge(
+                                    QueryStats.from_json(m["stats"]))
                         else:
                             wj.error = RuntimeError(
                                 m.get("error", "worker error"))

@@ -21,6 +21,7 @@ from typing import Iterable, Sequence
 import numpy as np
 
 from tempo_tpu.model import proto_wire as pw
+from tempo_tpu.obs.querystats import COUNTER_FIELDS, QueryStats
 
 
 def _dec(buf: bytes) -> dict[int, list]:
@@ -166,22 +167,66 @@ def dec_trace_metadata(buf: bytes):
         span_sets=[_dec_spanset(b) for b in d.get(7, ())])
 
 
+# SearchMetrics submessage layout (field 2 of SearchResponse). Field 1 is
+# the legacy single `inspected` varint; fields 2.. follow querystats
+# COUNTER_FIELDS order (skipping inspected_traces, which IS field 1), so
+# old decoders that only read field 1 and old encoders that only write it
+# stay wire-compatible in both directions. Field 15 carries the per-stage
+# wall-time breakdown as repeated {1: stage name, 2: nanos} submessages.
+_STATS_TAIL_FIELDS = tuple(
+    (i + 2, name) for i, name in enumerate(
+        f for f in COUNTER_FIELDS if f != "inspected_traces"))
+
+
+def enc_query_stats(stats) -> bytes:
+    """QueryStats → SearchMetrics submessage body."""
+    out = pw.enc_field_varint(1, int(stats.inspected_traces))
+    for fnum, name in _STATS_TAIL_FIELDS:
+        v = int(getattr(stats, name))
+        if v:
+            out += pw.enc_field_varint(fnum, v)
+    for s, ns in stats.stage_ns.items():
+        out += pw.enc_field_msg(
+            15, pw.enc_field_str(1, s) + pw.enc_field_varint(2, int(ns)))
+    return out
+
+
+def dec_query_stats(buf: bytes):
+    """SearchMetrics submessage body → QueryStats (old single-`inspected`
+    bodies decode with just inspected_traces set)."""
+    d = _dec(buf)
+    st = QueryStats()
+    st.inspected_traces = _first(d, 1, 0)
+    for fnum, name in _STATS_TAIL_FIELDS:
+        setattr(st, name, _first(d, fnum, 0))
+    for b in d.get(15, ()):
+        sd = _dec(b)
+        st.stage_ns[_s(_first(sd, 1))] = _first(sd, 2, 0)
+    return st
+
+
 def enc_search_response(mds: Sequence, *, inspected: int = 0,
-                        final: bool = True) -> bytes:
-    """SearchResponse (+ `final` marker for the streaming diff variant)."""
+                        final: bool = True, stats=None) -> bytes:
+    """SearchResponse (+ `final` marker for the streaming diff variant).
+    `stats` (QueryStats, optional) rides the SearchMetrics submessage —
+    wire-compatible extension of the single `inspected` varint."""
     out = b"".join(pw.enc_field_msg(1, enc_trace_metadata(m)) for m in mds)
-    out += pw.enc_field_msg(2, pw.enc_field_varint(1, int(inspected)))
+    if stats is not None:
+        out += pw.enc_field_msg(2, enc_query_stats(stats))
+    else:
+        out += pw.enc_field_msg(2, pw.enc_field_varint(1, int(inspected)))
     out += pw.enc_field_varint(15, 1 if final else 0)
     return out
 
 
 def dec_search_response(buf: bytes):
+    """Returns (metadatas, final, inspected, stats). `inspected` keeps the
+    legacy scalar (== stats.inspected_traces); `stats` is the full
+    QueryStats, zero-filled when the peer sent the old format."""
     d = _dec(buf)
     mds = [dec_trace_metadata(b) for b in d.get(1, ())]
-    inspected = 0
-    if 2 in d:
-        inspected = _first(_dec(d[2][0]), 1, 0)
-    return mds, bool(_first(d, 15, 1)), inspected
+    stats = dec_query_stats(d[2][0]) if 2 in d else QueryStats()
+    return mds, bool(_first(d, 15, 1)), stats.inspected_traces, stats
 
 
 # -- query range (TimeSeries; internal dense-sample layout) -----------------
@@ -257,6 +302,7 @@ def dec_push_response(buf: bytes, n: int) -> list:
 __all__ = [
     "enc_search_request", "dec_search_request",
     "enc_search_response", "dec_search_response",
+    "enc_query_stats", "dec_query_stats",
     "enc_trace_metadata", "dec_trace_metadata",
     "enc_query_range_response", "dec_query_range_response",
     "enc_trace_by_id_request", "dec_trace_by_id_request",

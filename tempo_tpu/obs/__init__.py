@@ -4,6 +4,10 @@
   HELP/TYPE text exposition, conformance parser.
 - `jaxruntime`: process-wide JAX/TPU runtime metrics (jit compiles,
   device-put bytes, kernel wall time) in the shared `RUNTIME` registry.
+- `querystats`: contextvar-scoped per-request read-path statistics
+  (the dskit `stats` / SearchMetrics axis).
+- `qlog`: structured JSON "query complete" logging with tail-based
+  slow-query capture.
 - `drift`: alert/dashboard ↔ registry drift gate.
 """
 
@@ -17,7 +21,8 @@ from tempo_tpu.obs.registry import (
     exponential_buckets,
     parse_exposition,
 )
+from tempo_tpu.obs.querystats import QueryStats
 
 __all__ = ["Registry", "Counter", "Gauge", "Histogram", "escape_label",
            "exponential_buckets", "parse_exposition",
-           "DEFAULT_DURATION_BUCKETS"]
+           "DEFAULT_DURATION_BUCKETS", "QueryStats"]

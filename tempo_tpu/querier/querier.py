@@ -18,6 +18,7 @@ from tempo_tpu.backend.meta import BlockMeta
 from tempo_tpu.db.tempodb import TempoDB
 from tempo_tpu.model.combine import combine_spans, sort_spans
 from tempo_tpu.obs import Registry
+from tempo_tpu.obs import querystats
 from tempo_tpu.ops.hashing import token_for
 from tempo_tpu.overrides import Overrides
 from tempo_tpu.ring import Ring
@@ -112,6 +113,7 @@ class Querier:
                      start_s: float | None = None, end_s: float | None = None):
         """One frontend-sharded backend job (`SearchBlock` `querier.go:780`)."""
         t0 = time.perf_counter()
+        querystats.add(blocks_scanned=1)
         try:
             return self.db.search(tenant, query, limit=limit,
                                   start_s=start_s, end_s=end_s,
@@ -127,6 +129,7 @@ class Querier:
         """One metrics job: raw evaluator over a block slice; job-level
         series to be combined at the frontend (AggregateModeSum)."""
         t0 = time.perf_counter()
+        querystats.add(blocks_scanned=1)
         try:
             return self.db.query_range(tenant, req, metas=[meta],
                                        row_groups=row_groups,

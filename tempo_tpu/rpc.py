@@ -73,11 +73,15 @@ class RemoteIngesterClient(_BaseClient):
 
     def search(self, tenant: str, query: str, limit: int = 20,
                start_s: float = 0, end_s: float = 0):
+        from tempo_tpu.obs.querystats import QueryStats, absorb
         from tempo_tpu.traceql.engine import TraceSearchMetadata
 
         res = self._get("/internal/ingester/search", tenant,
                         {"q": query, "limit": limit,
                          "start": start_s, "end": end_s})
+        # the remote ingester's per-request stats merge into this
+        # process's ambient scope (absent from old-format responses)
+        absorb(QueryStats.from_json(res.get("stats")))
         return [TraceSearchMetadata.from_json(t)
                 for t in res.get("traces", [])]
 

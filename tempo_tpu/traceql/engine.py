@@ -13,6 +13,7 @@ from typing import Iterable, Iterator, Optional
 
 import numpy as np
 
+from tempo_tpu.obs import querystats
 from tempo_tpu.traceql import ast as A
 from tempo_tpu.traceql.conditions import FetchSpansRequest, extract_conditions
 from tempo_tpu.traceql.eval import ColumnView, Spanset, evaluate_pipeline
@@ -140,15 +141,25 @@ def execute_search(
     for view, cand in view_iter:
         if len(cand) == 0:
             continue
-        if simple:
-            # all-filter pipeline: one vectorized mask + reduceat ranking
-            # replaces the per-trace Spanset loop; only the top-`limit`
-            # traces materialize Python objects (the second-pass analog
-            # of the pre-pass below, pulled before object construction)
-            spansets = _simple_filter_spansets(q, view, limit,
-                                               start_ns, end_ns)
-        else:
-            spansets = evaluate_pipeline(q, view)
+        st = querystats.current()
+        if st is not None:
+            # candidate spans evaluated; trace count via contiguous-run
+            # boundaries (spans of one trace are stored adjacent), O(n)
+            # instead of a unique() sort
+            t = view.trace_idx[cand]
+            st.add(inspected_spans=int(len(cand)),
+                   inspected_traces=int((np.diff(t) != 0).sum()) + 1)
+        with querystats.stage("engine_eval"):
+            if simple:
+                # all-filter pipeline: one vectorized mask + reduceat
+                # ranking replaces the per-trace Spanset loop; only the
+                # top-`limit` traces materialize Python objects (the
+                # second-pass analog of the pre-pass below, pulled before
+                # object construction)
+                spansets = _simple_filter_spansets(q, view, limit,
+                                                   start_ns, end_ns)
+            else:
+                spansets = evaluate_pipeline(q, view)
         if not spansets:
             continue
         # Vectorized pre-pass: per-spanset time bounds via one reduceat,

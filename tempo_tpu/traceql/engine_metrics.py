@@ -30,6 +30,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from tempo_tpu.obs import querystats
 from tempo_tpu.traceql import ast as A
 from tempo_tpu.traceql.conditions import extract_conditions
 from tempo_tpu.traceql.eval import (NUM, Col, ColumnView, eval_expr,
@@ -236,7 +237,12 @@ class MetricsEvaluator:
     # -- observation --------------------------------------------------------
 
     def observe(self, view: ColumnView) -> None:
+        with querystats.stage("engine_eval"):
+            self._observe(view)
+
+    def _observe(self, view: ColumnView) -> None:
         rows = self._matching_rows(view)
+        querystats.add(inspected_spans=len(rows))
         if len(rows) == 0:
             return
         st = view.col("__startTime")

@@ -90,6 +90,13 @@ class SelfTracer:
                 else:
                     self._dropped += 1
 
+    @property
+    def dropped(self) -> int:
+        """Spans lost to buffer overflow OR failed exports — the span-loss
+        signal behind `tempo_self_tracer_dropped_spans_total`."""
+        with self._lock:
+            return self._dropped
+
     def traceparent(self) -> str | None:
         """W3C traceparent for outgoing RPCs (`main.go:252-258`)."""
         s = _current_span.get()
@@ -144,7 +151,13 @@ class SelfTracer:
             self.exported += len(spans)
             return len(spans)
         except Exception:
-            return 0      # self-tracing must never hurt the service
+            # self-tracing must never hurt the service — but the loss must
+            # be visible: a failed export drops the whole batch, and the
+            # dropped gauge is what check_metrics_drift-gated alerting
+            # watches for span loss (silent-swallow bugfix)
+            with self._lock:
+                self._dropped += len(spans)
+            return 0
 
     def _loop(self, interval_s: float) -> None:
         while not self._stop.wait(interval_s):
@@ -158,6 +171,8 @@ class SelfTracer:
 
 class NoopTracer:
     """Disabled tracer: the default; `span()` costs one None check."""
+
+    dropped = 0
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs):

@@ -274,8 +274,9 @@ def test_tempopb_wire_is_protobuf():
                     "matched": 3}])
     body = tempopb.enc_search_response([md], inspected=7, final=False)
     assert body[:1] != b"{"                      # not JSON
-    mds, final, inspected = tempopb.dec_search_response(body)
+    mds, final, inspected, stats = tempopb.dec_search_response(body)
     assert not final and inspected == 7
+    assert stats.inspected_traces == 7       # legacy scalar → stats field
     got = mds[0]
     assert got.trace_id == md.trace_id
     assert got.start_time_unix_nano == md.start_time_unix_nano
