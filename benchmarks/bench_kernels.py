@@ -144,6 +144,72 @@ def main() -> None:
                                atol=1e-3)
     print(json.dumps({"check": "outputs_match", "ok": True}))
 
+    # device-scheduler amortization on the same fused kernel: many
+    # 256-row caller batches coalesced into padded pow-2 dispatches vs
+    # one dispatch per caller (tempo_tpu/sched; ISSUE 3 bench line)
+    from tempo_tpu.sched import DeviceScheduler, SchedConfig
+
+    small = 256
+    n_jobs = 256
+    srng = np.random.default_rng(1)
+    jobs = [(srng.integers(0, n_series, small).astype(np.int32),
+             srng.lognormal(-3, 1.5, small).astype(np.float32),
+             srng.integers(100, 5000, small).astype(np.float32),
+             np.ones(small, np.float32)) for _ in range(n_jobs)]
+
+    def small_step(slots, dur, sizes, w):
+        return fused_spanmetrics_scatter(slots, dur, sizes, w,
+                                         n_series=n_series, edges=EDGES)
+
+    from tempo_tpu.sched import bucket_rows
+
+    sstep = jax.jit(small_step)
+    # deterministic warmup: the 256-row direct shape plus every pow-2
+    # bucket the coalescer can produce for this load (chunk sizes are
+    # timing-dependent multiples of 256)
+    for b in sorted({small} | {bucket_rows(r)
+                               for r in range(small, 16384 + 1, small)}):
+        jax.block_until_ready(sstep(
+            jnp.full((b,), -1, jnp.int32), jnp.zeros(b, jnp.float32),
+            jnp.zeros(b, jnp.float32), jnp.zeros(b, jnp.float32)))
+    t0 = time.time()
+    outs = [sstep(*map(jnp.asarray, j)) for j in jobs]
+    jax.block_until_ready(outs)
+    dt_direct = time.time() - t0
+
+    acc = []
+    sc = DeviceScheduler(SchedConfig(batch_window_ms=20.0),
+                         start_worker=True)
+    for j in jobs:                                             # warm buckets
+        sc.submit_rows("bench_kernels_sched", "m", j, small,
+                       lambda *a: acc.append(sstep(*a)))
+    sc.flush()
+    jax.block_until_ready(acc)
+    acc.clear()
+    t0 = time.time()
+    for j in jobs:
+        sc.submit_rows("bench_kernels_sched", "m", j, small,
+                       lambda *a: acc.append(sstep(*a)))
+    sc.flush()
+    jax.block_until_ready(acc)
+    dt_sched = time.time() - t0
+    sc.stop()
+    print(json.dumps({
+        "metric": "sched_dispatch_amortization",
+        "value": round(dt_direct / dt_sched, 2) if dt_sched else 0.0,
+        "unit": "x_vs_direct_256row_calls",
+        "extra": {
+            "batch_occupancy": round(
+                sc.mean_occupancy("bench_kernels_sched"), 3),
+            "batches": sc.batches_total.get("bench_kernels_sched", 0),
+            "jobs_coalesced": sc.coalesced_total.get(
+                "bench_kernels_sched", 0),
+            "padding_waste_bytes": sc.padding_waste_bytes.get(
+                "bench_kernels_sched", 0),
+        },
+        "platform": jax.devices()[0].platform,
+    }))
+
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -223,6 +223,58 @@ def dashboards() -> dict[str, dict]:
                 p("Compaction cycle p99",
                   _p99("tempo_compactor_cycle_duration_seconds")),
             ]),
+        "tempo-tpu-sched.json": dash(
+            "Tempo-TPU / Device scheduler",
+            "Shared device-execution scheduler (tempo_tpu.sched):"
+            " continuous micro-batching health — queue saturation,"
+            " batch occupancy, padding waste, shedding, backpressure"
+            " (runbook: 'Reading the scheduler')",
+            [
+                p("Queue depth by class",
+                  "tempo_sched_queue_depth", legend="{{class}}"),
+                p("Queue fill ratio by class",
+                  "tempo_sched_queue_depth / tempo_sched_queue_limit",
+                  unit="percentunit", legend="{{class}}"),
+                p("Jobs /s by class",
+                  _rate("tempo_sched_jobs_total", "class"),
+                  legend="{{class}}"),
+                p("Shed jobs /s by class",
+                  _rate("tempo_sched_shed_jobs_total", "class"),
+                  legend="{{class}}"),
+                p("Batches /s by kernel",
+                  _rate("tempo_sched_batches_total", "kernel"),
+                  legend="{{kernel}}"),
+                p("Jobs coalesced per batch",
+                  "sum(rate(tempo_sched_coalesced_jobs_total[5m]))"
+                  " by (kernel) /"
+                  " sum(rate(tempo_sched_batches_total[5m])) by (kernel)",
+                  legend="{{kernel}}"),
+                p("Batch occupancy p50 by kernel",
+                  "histogram_quantile(0.5, sum(rate("
+                  "tempo_sched_batch_occupancy_ratio_bucket[5m]))"
+                  " by (le, kernel))",
+                  unit="percentunit", legend="{{kernel}}"),
+                p("Padding waste MB/s by kernel",
+                  "sum(rate(tempo_sched_padding_waste_bytes_total[5m]))"
+                  " by (kernel) / 1e6", legend="{{kernel}}"),
+                p("Dispatch p99 by kernel",
+                  _p99("tempo_sched_dispatch_duration_seconds", "kernel"),
+                  legend="{{kernel}}"),
+                p("Queue wait p99 by class",
+                  _p99("tempo_sched_queue_wait_seconds", "class"),
+                  legend="{{class}}"),
+                p("Shape-bucket warmups /h (flat = no re-traces)",
+                  _rate("tempo_sched_bucket_warmups_total", "kernel",
+                        win="1h"), legend="{{kernel}}"),
+                p("Backpressure rejections /s (429s)",
+                  'sum(rate(tempo_discarded_spans_total{'
+                  'reason="sched_backpressure"}[5m]))'),
+                p("Dispatch errors /s (dropped ingest batches)",
+                  "rate(tempo_sched_dispatch_errors_total[5m])"),
+                p("Frontend query sheds /s (503s) by op",
+                  _rate("tempo_query_frontend_shed_total", "op"),
+                  legend="{{op}}"),
+            ]),
     }
 
 

@@ -267,12 +267,22 @@ class Handler(BaseHTTPRequestHandler):
         try:
             errs = self.app.distributor.push_spans(
                 tenant, spans, raw_otlp=raw_otlp, raw_recs=raw_recs)
-        except RateLimited:
-            self.send_response(429)
-            self.send_header("Retry-After", "1")
-            self.end_headers()
-            return
+        except RateLimited as e:
+            return self._reply_429(e)
         self._reply(ok_status, _json_bytes({"errors": errs} if errs else {}))
+
+    def _reply_retry(self, code: int, retry_after_s: float) -> None:
+        """Rejection with an advertised backoff: 429 (rate limit /
+        ingest backpressure) and 503 (query shed) share the header
+        formatting."""
+        self.send_response(code)
+        self.send_header("Retry-After",
+                         str(max(1, int(round(retry_after_s)))))
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def _reply_429(self, e) -> None:
+        self._reply_retry(429, getattr(e, "retry_after_s", 1.0))
 
     def _push(self, tenant: str) -> None:
         body = self._ingest_body()
@@ -296,11 +306,8 @@ class Handler(BaseHTTPRequestHandler):
             errs = self.app.distributor.push_otlp(tenant, body)
         except MalformedPayload as e:
             return self._err(400, f"malformed otlp payload: {e}")
-        except RateLimited:
-            self.send_response(429)
-            self.send_header("Retry-After", "1")
-            self.end_headers()
-            return
+        except RateLimited as e:
+            return self._reply_429(e)
         self._reply(200, _json_bytes({"errors": errs} if errs else {}))
 
     def _push_jaeger(self, tenant: str) -> None:
@@ -406,6 +413,11 @@ class Handler(BaseHTTPRequestHandler):
             # (frontend.UnsupportedMultiTenant), malformed params → 400
             return self._err(400, str(e))
         except Exception as e:
+            from tempo_tpu.sched import QueryBackpressure
+            if isinstance(e, QueryBackpressure):
+                # device scheduler's query class is saturated: shed the
+                # request with an explicit backoff instead of queuing it
+                return self._reply_retry(503, e.retry_after_s)
             return self._err(500, str(e))
         self._err(404, f"unknown path {path}")
 
@@ -572,6 +584,8 @@ class Handler(BaseHTTPRequestHandler):
             return self._reply(200, _json_bytes(
                 ur.build_report(ur.cached_seed())))
         cfg_warnings = self.app.cfg.check()
+        from tempo_tpu import sched
+        sc = sched.scheduler()
         body = {
             "target": self.app.cfg.target,
             "ready": self.app.ready,
@@ -579,6 +593,10 @@ class Handler(BaseHTTPRequestHandler):
             "modules": [m for m in ("distributor", "ingester", "generator",
                                     "querier", "frontend", "db")
                         if getattr(self.app, m) is not None],
+            # device-scheduler fill ratios per priority class — the
+            # backpressure signal, also on /metrics as
+            # tempo_sched_queue_depth / tempo_sched_queue_limit
+            "sched_pressure": sc.pressure() if sc is not None else None,
         }
         self._reply(200, _json_bytes(body))
 

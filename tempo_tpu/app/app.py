@@ -185,6 +185,11 @@ class App:
 
     def _build(self) -> None:
         mods = TARGETS[self.cfg.target]
+        # the shared device-execution scheduler is process-wide state
+        # (like the JAX runtime registry): configure it before any module
+        # that dispatches kernels is constructed
+        from tempo_tpu import sched
+        self.sched = sched.configure(self.cfg.sched)
         self._init_backend()
         self._init_bus()
         if OVERRIDES in mods:
@@ -560,6 +565,10 @@ class App:
     def shutdown(self) -> None:
         self.ready = False
         self._stop.set()
+        # drain queued device batches so final collections see them (the
+        # process-wide scheduler itself stays up: other Apps may share it)
+        if getattr(self, "sched", None) is not None:
+            self.sched.flush()
         if getattr(self, "usage_reporter", None) is not None:
             self.usage_reporter.shutdown()
         mine = getattr(self, "_self_tracer", None)

@@ -24,6 +24,7 @@ from tempo_tpu.ingester.ingester import IngesterConfig
 from tempo_tpu.ingester.instance import InstanceConfig
 from tempo_tpu.overrides.limits import Limits
 from tempo_tpu.querier.querier import QuerierConfig
+from tempo_tpu.sched import SchedConfig
 
 
 @dataclasses.dataclass
@@ -118,6 +119,10 @@ class Config:
     querier: QuerierConfig = dataclasses.field(default_factory=QuerierConfig)
     querier_worker: WorkerConfig = dataclasses.field(default_factory=WorkerConfig)
     compactor: CompactorConfig = dataclasses.field(default_factory=CompactorConfig)
+    # shared device-execution scheduler (tempo_tpu.sched): continuous
+    # micro-batching of kernel dispatch across the write and read paths,
+    # default on; `sched.enabled: false` restores direct dispatch
+    sched: SchedConfig = dataclasses.field(default_factory=SchedConfig)
     overrides_defaults: Limits = dataclasses.field(default_factory=Limits)
     per_tenant_override_config: str = ""   # runtime-config file path
     compaction_interval_s: float = 30.0
@@ -146,6 +151,11 @@ class Config:
             warnings.append(f"unknown storage backend {self.storage.backend!r}")
         if self.compactor.retention_s and self.compactor.retention_s < 3600:
             warnings.append("compactor.retention_s < 1h deletes data quickly")
+        if self.sched.enabled and self.sched.batch_window_ms > 100:
+            warnings.append("sched.batch_window_ms > 100ms adds that much "
+                            "to ingest-visible metrics latency per batch")
+        if self.sched.enabled and not (0 < self.sched.occupancy_target <= 1):
+            warnings.append("sched.occupancy_target must be in (0, 1]")
         return warnings
 
 

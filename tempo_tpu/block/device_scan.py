@@ -1016,7 +1016,12 @@ class BlockScanPlane:
         esig, eargs, eints = extra
         fn = _block_mask_kernel(self.n, sig, esig, all_conditions)
         ivec = np.asarray(ints + eints, np.int32)
-        return fn(ivec, *args, *eargs)
+        # query-class job on the shared device scheduler: live-ingest
+        # batches order ahead of scans, the dispatch is accounted, and
+        # the launch stays async (the handle returns without a sync)
+        from tempo_tpu import sched
+        return sched.run(lambda: fn(ivec, *args, *eargs),
+                         kernel="plane_packed_mask")
 
     def mask(self, preds: Sequence, all_conditions: bool,
              time_range=None, row_groups=None) -> Optional[np.ndarray]:
@@ -1284,10 +1289,15 @@ class BlockScanPlane:
         ivec = np.asarray(ivals, np.int32)
         fvec = np.asarray([frac_ns / 1e9, step_ns / 1e9], np.float32)
         trel, thi, tlo = self._cols[("times",)]
-        packed = fn(trel, thi, tlo, ivec, fvec,
-                    gcodes, gex, vargs[0] if vargs else None,
-                    vargs[1] if len(vargs) > 1 else None,
-                    *args, *eargs)
+        # fused grid launch rides the scheduler's query class (async —
+        # the GridHandle fetch is the only sync point)
+        from tempo_tpu import sched
+        packed = sched.run(
+            lambda: fn(trel, thi, tlo, ivec, fvec,
+                       gcodes, gex, vargs[0] if vargs else None,
+                       vargs[1] if len(vargs) > 1 else None,
+                       *args, *eargs),
+            kernel="plane_query_range_grid")
         main_shape = ((n_groups, n_steps, 64) if kind_tag == "hist"
                       else (n_groups, n_steps))
         return GridHandle(glabels, packed, main_shape, (n_groups, n_steps))

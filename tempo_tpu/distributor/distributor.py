@@ -14,7 +14,8 @@ from typing import Callable, Protocol, Sequence
 
 import numpy as np
 
-from tempo_tpu.distributor.limiter import RateLimiter, effective_rate
+from tempo_tpu.distributor.limiter import (IngestBackpressure, RateLimiter,
+                                           effective_rate)
 from tempo_tpu.native import group_keys  # native hash group; numpy fallback
 from tempo_tpu.native import token_for   # native fnv batch; numpy fallback
 from tempo_tpu.obs import Registry
@@ -25,6 +26,7 @@ from tempo_tpu.utils.livetraces import _approx_size
 # discard reasons (mirroring the reference's discard metric reasons,
 # `modules/distributor/distributor.go` reasonRateLimited etc.)
 REASON_RATE_LIMITED = "rate_limited"
+REASON_BACKPRESSURE = "sched_backpressure"
 REASON_TRACE_TOO_LARGE = "trace_too_large"
 REASON_INVALID_TRACE_ID = "invalid_trace_id"
 REASON_INTERNAL = "internal_error"
@@ -58,11 +60,21 @@ class DistributorConfig:
 
 class RateLimited(RuntimeError):
     """Maps to gRPC ResourceExhausted + RetryInfo at the receiver shim
-    (`modules/distributor/receiver/shim.go` RetryableError)."""
+    (`modules/distributor/receiver/shim.go` RetryableError) and to 429 +
+    Retry-After on the HTTP receivers. Raised for per-tenant rate limits
+    AND for process-wide device-scheduler backpressure (`reason`
+    distinguishes them; `retry_after_s` is advertised to the client)."""
 
-    def __init__(self, tenant: str, n_bytes: int):
-        super().__init__(f"tenant {tenant} over ingestion rate ({n_bytes}B)")
+    def __init__(self, tenant: str, n_bytes: int,
+                 retry_after_s: float = 1.0,
+                 reason: str = REASON_RATE_LIMITED):
+        super().__init__(f"tenant {tenant} over ingestion rate ({n_bytes}B)"
+                         if reason == REASON_RATE_LIMITED else
+                         f"ingest backpressure: device scheduler saturated "
+                         f"({n_bytes}B rejected)")
         self.tenant = tenant
+        self.retry_after_s = retry_after_s
+        self.reason = reason
 
 
 class MalformedPayload(ValueError):
@@ -91,6 +103,7 @@ class Distributor:
         self.generator_ring = generator_ring
         self.generator_clients = generator_clients or {}
         self.limiter = RateLimiter(now=now)
+        self.backpressure = IngestBackpressure()
         self.n_distributors = n_distributors
         from tempo_tpu.distributor.forwarder import (
             Forwarder,
@@ -242,6 +255,15 @@ class Distributor:
         rate = effective_rate(lim.ingestion.rate_strategy,
                               lim.ingestion.rate_limit_bytes,
                               self.n_distributors())
+        # backpressure BEFORE the token bucket: a shed push must not
+        # debit the tenant's rate budget, or retries during a device
+        # stall would exhaust the bucket and misreport the 429 cause as
+        # rate_limited long after the scheduler recovers
+        retry = self.backpressure.retry_after()
+        if retry is not None:
+            self._discard(REASON_BACKPRESSURE, n)
+            raise RateLimited(tenant, sz, retry_after_s=retry,
+                              reason=REASON_BACKPRESSURE)
         if not self.limiter.allow(tenant, sz, rate,
                                   lim.ingestion.burst_size_bytes):
             self._discard(REASON_RATE_LIMITED, n)
@@ -418,6 +440,13 @@ class Distributor:
         rate = effective_rate(lim.ingestion.rate_strategy,
                               lim.ingestion.rate_limit_bytes,
                               self.n_distributors())
+        # backpressure first: same token-bucket-preservation ordering as
+        # the columnar path above
+        retry = self.backpressure.retry_after()
+        if retry is not None:
+            self._discard(REASON_BACKPRESSURE, len(spans))
+            raise RateLimited(tenant, sz, retry_after_s=retry,
+                              reason=REASON_BACKPRESSURE)
         if not self.limiter.allow(tenant, sz, rate,
                                   lim.ingestion.burst_size_bytes):
             self._discard(REASON_RATE_LIMITED, len(spans))

@@ -51,3 +51,30 @@ def effective_rate(strategy: str, rate: float, n_distributors: int) -> float:
     if strategy == "global" and n_distributors > 0:
         return rate / n_distributors
     return rate
+
+
+class IngestBackpressure:
+    """Admission gate fed by the device scheduler's ingest queue.
+
+    The token-bucket limiter above protects against tenants exceeding
+    their CONFIGURED rate; this hook protects the process itself: when
+    the shared device-execution scheduler's live-ingest queue is
+    saturated (the chip cannot keep up), the distributor rejects pushes
+    with 429 + Retry-After instead of queuing unboundedly — clients back
+    off, memory stays bounded, and the queue drains. Rejections are
+    visible as `tempo_discarded_spans_total{reason="sched_backpressure"}`
+    and the queue itself as `tempo_sched_queue_depth{class="ingest"}`.
+    """
+
+    def __init__(self, retry_after_fn: "Callable[[], float | None] | None"
+                 = None) -> None:
+        # injectable for tests; default consults the process scheduler
+        self._fn = retry_after_fn
+
+    def retry_after(self) -> "float | None":
+        """Seconds the producer should back off, or None to admit."""
+        if self._fn is not None:
+            return self._fn()
+        from tempo_tpu import sched
+        sc = sched.scheduler()
+        return sc.ingest_retry_after() if sc is not None else None

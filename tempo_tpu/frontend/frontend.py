@@ -174,6 +174,9 @@ class Frontend:
         # hot path never touches the registry)
         self._tenant_read_lock = threading.Lock()
         self._tenant_read_cost: dict[str, dict[str, int]] = {}
+        # requests rejected with 503 under device-scheduler query
+        # backpressure, by op (rendered via a callback family below)
+        self.shed_requests: dict[str, int] = {}
         self.obs = registry if registry is not None else Registry()
         self._register_obs(self.obs)
 
@@ -228,6 +231,16 @@ class Frontend:
             read_cost("blocks_scanned"),
             help="Backend block slices scanned by queries, per tenant",
             labels=("tenant",))
+
+        def shed():
+            with self._tenant_read_lock:
+                return [((op,), n) for op, n in self.shed_requests.items()]
+
+        reg.counter_func(
+            "tempo_query_frontend_shed_total", shed,
+            help="Requests rejected with 503 + Retry-After because the "
+                 "device scheduler's query class was saturated, by op",
+            labels=("op",))
         reg.counter_func(
             "tempo_query_log_records_total",
             self.qlog.emitted_by_reason,
@@ -292,6 +305,21 @@ class Frontend:
         for t in self._workers:
             t.join(timeout=2)
         self.queue.close()
+
+    def _check_device_pressure(self, op: str) -> None:
+        """Shed NEW queries when the device scheduler's query class is
+        saturated (503 + Retry-After at the API) — admitted work keeps
+        running; backpressure applies at the request boundary, like the
+        ingest-side 429 at the distributor. Sheds are counted per op
+        (tempo_query_frontend_shed_total) so an operator can see the
+        503s the scheduler's own shed counter (which tracks JOBS, not
+        requests) does not cover."""
+        from tempo_tpu import sched
+        sc = sched.scheduler()
+        if sc is not None and sc.query_saturated():
+            with self._tenant_read_lock:
+                self.shed_requests[op] = self.shed_requests.get(op, 0) + 1
+            raise sched.QueryBackpressure(sc.cfg.retry_after_s)
 
     def _run_jobs(self, tenant: str, jobs: Sequence[SearchJob],
                   fn: Callable[[SearchJob], Any],
@@ -438,6 +466,7 @@ class Frontend:
         after each fold — the hook the streaming gRPC endpoint uses to
         emit diff responses (`combiner/search.go`)."""
         from tempo_tpu.utils import tracing
+        self._check_device_pressure("search")
         t0 = self.now()
         with tracing.span_for_tenant("frontend.Search", tenant, query=query), \
                 querystats.ensure_scope() as st:
@@ -562,6 +591,7 @@ class Frontend:
             # the metrics endpoints (frontend.go:163-175 analog)
             raise UnsupportedMultiTenant(
                 "multi-tenant query of the metrics endpoint is not supported")
+        self._check_device_pressure("metrics")
         t0 = self.now()
         with tracing.span_for_tenant("frontend.QueryRange", tenants[0],
                                      query=query), \

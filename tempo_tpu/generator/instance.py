@@ -196,6 +196,12 @@ class GeneratorInstance:
     def collect_and_push(self, ts_ms: int | None = None) -> int:
         """One collection: purge stale series, gather device state, remote
         write. Returns number of scalar samples pushed."""
+        # drain the device scheduler first: updates accepted before this
+        # tick must land in the collected state, and a stale-series purge
+        # must never zero a slot that still has a queued batch targeting
+        # it (slot reuse would misroute the update to a new series)
+        from tempo_tpu import sched
+        sched.flush()
         if self.now() - self._last_purge > 60.0:
             self.registry.purge_stale()
             self._last_purge = self.now()

@@ -163,26 +163,34 @@ class ServiceGraphsProcessor:
     # -- emission ----------------------------------------------------------
 
     def _emit(self, edges: list[tuple]) -> None:
+        from tempo_tpu.sched import bucket_rows
+
         it = self.registry.interner
         conn_ids = {c: it.intern(c) for c in ("", "messaging_system", "virtual_node")}
         n = len(edges)
+        # pad the edge batch to a pow-2 shape bucket: the matched-edge
+        # count varies per tick and unbucketed scatters would re-trace on
+        # every new cardinality (padding rows ride slot -1 → dropped)
+        cap = bucket_rows(max(n, 1), lo=16)
         rows = np.zeros((n, 3), np.int32)
-        cdur = np.zeros(n, np.float32)
-        sdur = np.zeros(n, np.float32)
-        fail = np.zeros(n, np.float32)
-        mdur = np.zeros(n, np.float32)
+        cdur = np.zeros(cap, np.float32)
+        sdur = np.zeros(cap, np.float32)
+        fail = np.zeros(cap, np.float32)
+        mdur = np.zeros(cap, np.float32)
         for j, (cid, sid, conn, cd, sd, failed, msg_delay) in enumerate(edges):
             rows[j] = (cid, sid, conn_ids[conn])
             cdur[j], sdur[j], fail[j] = cd, sd, 1.0 if failed else 0.0
             mdur[j] = msg_delay
-        slots = self.total.resolve_slots(rows)
+        slots = np.full(cap, -1, np.int32)
+        slots[:n] = self.total.resolve_slots(rows)
         from tempo_tpu.registry import metrics as rmx
         self.total.state = rmx.counter_update(self.total.state, slots)
         self.failed.state = rmx.counter_update(self.failed.state, slots, fail)
         self.client_hist.state = rmx.histogram_update(self.client_hist.state, slots, cdur)
         self.server_hist.state = rmx.histogram_update(self.server_hist.state, slots, sdur)
         if self.messaging_hist is not None:
-            msg = np.array([e[2] == "messaging_system" for e in edges])
+            msg = np.zeros(cap, bool)
+            msg[:n] = [e[2] == "messaging_system" for e in edges]
             self.messaging_hist.state = rmx.histogram_update(
                 self.messaging_hist.state, np.where(msg, slots, -1), mdur)
 

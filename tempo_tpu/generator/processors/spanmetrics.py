@@ -55,6 +55,12 @@ class SpanMetricsConfig:
     sketch_max_s: float = 1e5
     sketch_max_series: int = 16384            # HBM bound for the sketch plane
     subprocessors: tuple[str, ...] = ("count", "latency", "size")
+    # route fused updates through the process device scheduler
+    # (tempo_tpu.sched): many small pushes coalesce into one padded
+    # pow-2 dispatch. The synchronous direct path below is preserved
+    # bit-identically and taken whenever this is off or no scheduler is
+    # configured.
+    use_scheduler: bool = True
 
 
 def _fused_update_impl(calls, latency, sizes, dd, slots, dur_s, size_bytes,
@@ -104,6 +110,22 @@ _fused_update_packed = instrumented_jit(
     donate_argnums=(0, 1, 2, 3))
 
 
+def _fused_update_packed4_impl(calls, latency, sizes, dd, packed):
+    """The scheduler-coalesced form: the merged batch arrives as ONE
+    [4, bucket] f32 matrix (slots, dur_s, size_bytes, weights) — one H2D
+    per merged dispatch, the coalescer-side twin of the [3, cap] packed
+    push path. Slots ride f32 exactly under the same capacity < 2^24
+    gate; padding rows carry slot -1 and drop on device."""
+    slots = packed[0].astype(jax.numpy.int32)
+    return _fused_update_impl(calls, latency, sizes, dd, slots, packed[1],
+                              packed[2], packed[3])
+
+
+_fused_update_packed4 = instrumented_jit(
+    _fused_update_packed4_impl, name="spanmetrics_fused_update",
+    donate_argnums=(0, 1, 2, 3))
+
+
 class SpanMetricsProcessor:
     def __init__(self, registry: ManagedRegistry, config: SpanMetricsConfig | None = None):
         self.cfg = config or SpanMetricsConfig()
@@ -136,6 +158,63 @@ class SpanMetricsProcessor:
 
     def name(self) -> str:
         return "span-metrics"
+
+    # -- device-scheduler route (tempo_tpu.sched) --------------------------
+
+    def _sched(self):
+        """The process scheduler when this processor's fused updates
+        should ride it (config flag, default on), else None — callers
+        then take the original synchronous dispatch unchanged."""
+        if not self.cfg.use_scheduler:
+            return None
+        from tempo_tpu import sched as sched_mod
+        sc = sched_mod.scheduler()
+        return sc if sc is not None and sc.cfg.enabled else None
+
+    def _sched_dispatch(self, slots, dur_s, sizes, weights) -> None:
+        """One merged-batch device step, on the scheduler worker: the
+        same donating fused kernel + state-lock discipline as the direct
+        paths. Padding/merged-away rows carry slot -1 and are dropped on
+        device, so cross-push (and cross-tenant-window) concatenation is
+        exact for the commutative sketch updates."""
+        with self.registry.state_lock:
+            (self.calls.state, self.latency.state, self.sizes.state,
+             self.dd) = _fused_update_donated(
+                self.calls.state, self.latency.state, self.sizes.state,
+                self.dd, slots, dur_s, sizes, weights)
+
+    def _sched_dispatch_packed(self, packed) -> None:
+        """Packed-coalescer dispatch: the merged batch is one [4, bucket]
+        f32 matrix — ONE H2D per dispatch behind a high-latency device
+        link. Gated by the caller on capacity < 2^24 (slot ids exact in
+        f32)."""
+        with self.registry.state_lock:
+            (self.calls.state, self.latency.state, self.sizes.state,
+             self.dd) = _fused_update_packed4(
+                self.calls.state, self.latency.state, self.sizes.state,
+                self.dd, packed)
+
+    def _submit_rows(self, sc, slots: np.ndarray, dur_s: np.ndarray,
+                     sizes: np.ndarray, weights: np.ndarray) -> None:
+        arrays = (np.asarray(slots, np.float32 if
+                             self.calls.table.capacity < (1 << 24)
+                             else np.int32),
+                  np.asarray(dur_s, np.float32),
+                  np.asarray(sizes, np.float32),
+                  np.asarray(weights, np.float32))
+        if self.calls.table.capacity < (1 << 24):
+            # slot ids round-trip f32 exactly below 2^24: ride the packed
+            # single-transfer dispatch (same gate as the direct packed
+            # push path)
+            sc.submit_rows("spanmetrics_fused_update", self, arrays,
+                           len(slots), self._sched_dispatch_packed,
+                           pads=(-1.0, 0.0, 0.0, 0.0),
+                           tenant=self.registry.tenant, pack=True)
+        else:
+            sc.submit_rows("spanmetrics_fused_update", self, arrays,
+                           len(slots), self._sched_dispatch,
+                           pads=(-1, 0.0, 0.0, 0.0),
+                           tenant=self.registry.tenant)
 
     def needs_attr_columns(self) -> tuple[bool, bool]:
         """(span_attrs, res_attrs) this processor reads — owned HERE so a
@@ -221,6 +300,19 @@ class SpanMetricsProcessor:
         slots, packed, rows, valid, miss, n_valid, n_filtered = got
         if miss.size:
             self.calls.table.apply_misses(rows, slots, miss, valid, now)
+        sc = self._sched()
+        if sc is not None:
+            # scheduler route: trim to the real rows (filtered rows carry
+            # slot -1 and drop on device; the coalescer re-pads the merged
+            # batch to its pow-2 bucket) and enqueue for the next batch
+            # window — the dispatch itself runs on the worker thread.
+            if n:
+                self._submit_rows(sc, slots[:n], packed[1][:n],
+                                  packed[2][:n], np.ones(n, np.float32))
+            self.calls.note_exemplars(slots[:n], trace_ids, packed[1],
+                                      int(now * 1000))
+            self.latency.exemplars = self.calls.exemplars
+            return n_valid, n_filtered
         cap = len(slots)
         ones = self._ones_cache.get(cap)
         if ones is None:
@@ -306,12 +398,17 @@ class SpanMetricsProcessor:
         if self.cfg.span_multiplier_key:
             mult = _attr_fval(sb, self.cfg.span_multiplier_key)
             weights = np.where(mult > 0, mult, 1.0).astype(np.float32)
-        with self.registry.state_lock:
-            (self.calls.state, self.latency.state, self.sizes.state,
-             self.dd) = _fused_update_donated(
-                self.calls.state, self.latency.state, self.sizes.state,
-                self.dd, slots, dur_s, span_sizes.astype(np.float32),
-                weights)
+        sc = self._sched()
+        if sc is not None:
+            self._submit_rows(sc, slots, dur_s,
+                              span_sizes.astype(np.float32), weights)
+        else:
+            with self.registry.state_lock:
+                (self.calls.state, self.latency.state, self.sizes.state,
+                 self.dd) = _fused_update_donated(
+                    self.calls.state, self.latency.state, self.sizes.state,
+                    self.dd, slots, dur_s, span_sizes.astype(np.float32),
+                    weights)
         ts_ms = int(self.registry.now() * 1000)
         self.calls.note_exemplars(slots, sb.trace_id, dur_s, ts_ms)
         self.latency.exemplars = self.calls.exemplars
@@ -327,6 +424,10 @@ class SpanMetricsProcessor:
         previous dd buffers at dispatch."""
         if self.dd is None:
             return {}
+        # drain any queued scheduler batches first: a quantile read must
+        # see every update that was accepted before it
+        from tempo_tpu import sched as sched_mod
+        sched_mod.flush()
         # The sketch plane may be smaller than the series table
         # (sketch_max_series < max_active_series); slots beyond it were
         # masked out of dd_update and have no quantile. The whole device

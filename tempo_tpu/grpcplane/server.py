@@ -259,6 +259,12 @@ class _Services:
             yield enc_diff(batch)
         t.join()
         if "err" in out:
+            from tempo_tpu.sched import QueryBackpressure
+            if isinstance(out["err"], QueryBackpressure):
+                # shed load is RETRYABLE, not a server bug: mirror the
+                # HTTP 503 + Retry-After semantics (shim RetryableError)
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                              str(out["err"]))
             context.abort(grpc.StatusCode.INTERNAL, str(out["err"]))
         yield enc_final(out.get("res"))
 

@@ -52,11 +52,13 @@ COUNTER_FIELDS = (
     "cache_hits",            # sub-requests served from the response cache
     "device_scan_bytes",     # bytes uploaded to the device read plane
     "kernel_wall_ns",        # wall nanos blocked on device kernel results
+    "sched_jobs",            # device-scheduler jobs this request queued
 )
 
 # canonical per-stage wall-time breakdown keys (free-form keys are
 # accepted; these are the ones the read path records)
-STAGES = ("queue_wait", "block_fetch", "device_scan", "engine_eval", "merge")
+STAGES = ("queue_wait", "block_fetch", "device_scan", "engine_eval", "merge",
+          "sched_wait")
 
 
 @dataclasses.dataclass
@@ -78,6 +80,7 @@ class QueryStats:
     cache_hits: int = 0
     device_scan_bytes: int = 0
     kernel_wall_ns: int = 0
+    sched_jobs: int = 0
     stage_ns: dict = dataclasses.field(default_factory=dict)
 
     def __post_init__(self) -> None:
@@ -155,6 +158,7 @@ class QueryStats:
                 "cacheHits": self.cache_hits,
                 "deviceScanBytes": self.device_scan_bytes,
                 "kernelWallNanos": self.kernel_wall_ns,
+                "schedJobs": self.sched_jobs,
                 "stageDurationNanos": dict(self.stage_ns),
             }
 

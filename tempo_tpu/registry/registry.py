@@ -19,7 +19,6 @@ runs one scatter kernel; `collect` gathers each family's arrays once.
 from __future__ import annotations
 
 import dataclasses
-import math
 import threading
 import time
 from typing import Callable, Iterable, Sequence
@@ -160,7 +159,16 @@ class Gauge(_MetricBase):
         if not keep:
             return
         idx = np.fromiter(keep.values(), int)
-        self.state = m.gauge_set(self.state, slots[idx], values[idx], None)
+        # pad to a pow-2 shape bucket: the distinct-slot count varies per
+        # batch and an unbucketed scatter would re-trace on every new
+        # cardinality (padding slots are -1 → dropped on device)
+        n = len(idx)
+        cap = _pad_len(n)
+        s = np.full(cap, -1, np.int32)
+        s[:n] = slots[idx]
+        v = np.zeros(cap, np.float32)
+        v[:n] = values[idx]
+        self.state = m.gauge_set(self.state, s, v, None)
 
     def set(self, label_values: Sequence[str], value: float) -> None:
         row = self.registry.interner.intern_many(label_values)[None, :]
@@ -393,4 +401,7 @@ class ManagedRegistry:
 
 
 def _pad_len(n: int) -> int:
-    return max(16, 1 << math.ceil(math.log2(n)))
+    # the shared shape-bucket policy (device scheduler coalescer), floor 16
+    from tempo_tpu.sched import bucket_rows
+
+    return bucket_rows(max(n, 1), lo=16)

@@ -1,0 +1,34 @@
+"""tempo_tpu.sched — shared device-execution scheduler.
+
+Continuous micro-batching for every device caller in the process:
+bounded per-priority-class queues (live-ingest > query > compaction)
+with load shedding and backpressure, a cross-tenant coalescer over
+padded power-of-two shape buckets, and an adaptive batch window. See
+`scheduler.py` for the design notes and `operations/runbook.md`
+("Reading the scheduler") for the operational story.
+"""
+
+from tempo_tpu.sched.scheduler import (
+    CLASS_NAMES,
+    DeviceScheduler,
+    Job,
+    PRIO_COMPACTION,
+    PRIO_INGEST,
+    PRIO_QUERY,
+    QueryBackpressure,
+    SchedConfig,
+    bucket_rows,
+    configure,
+    flush,
+    reset,
+    run,
+    scheduler,
+    use,
+)
+
+__all__ = [
+    "CLASS_NAMES", "DeviceScheduler", "Job", "PRIO_COMPACTION",
+    "PRIO_INGEST", "PRIO_QUERY", "QueryBackpressure", "SchedConfig",
+    "bucket_rows", "configure", "flush", "reset",
+    "run", "scheduler", "use",
+]

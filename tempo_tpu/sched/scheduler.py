@@ -1,0 +1,791 @@
+"""Process-wide device-execution scheduler: continuous micro-batching.
+
+Every device caller in this codebase — spanmetrics fused updates on the
+write path, `BlockScanPlane` masks/grids and the metrics-engine scatter
+kernels on the read path — used to dispatch its own small, oddly-shaped
+batches straight into jit, paying per-call dispatch overhead and a fresh
+XLA trace on every new shape. LLM inference stacks solved exactly this
+with continuous batching over padded, bucketed shapes (cf. ragged paged
+attention batching for TPU serving), and the mergeable-sketch kernels we
+run are commutative (counts/histograms/DDSketch merge by addition), so
+coalescing update batches is safe by construction.
+
+This module is the shared seam:
+
+- **Bounded per-priority-class queues** (live-ingest > query >
+  compaction) with load shedding and backpressure: ingest admission is
+  gated at the distributor (429 + Retry-After via
+  `distributor/limiter.IngestBackpressure`), the frontend sheds new
+  queries with `QueryBackpressure` (503) when the query class saturates,
+  and an over-full class never queues unboundedly — excess jobs execute
+  inline on the caller (shed) and are counted.
+- **A coalescer** that merges same-kernel jobs that target the same
+  device state plane into ONE padded tensor per array role. Jobs from
+  different tenants share the batch window, the drain cycle, and the
+  shape-bucket cache (one wake, one lock, zero re-traces); jobs whose
+  `merge_key` matches (same state plane — sketch updates commute, so
+  concatenation is exact for the counts) additionally merge into a
+  single dispatch. Padding rows carry slot -1 / weight 0 and are
+  dropped by the scatter kernels (`mode="drop"`).
+- **Power-of-two shape bucketing** with a warm-bucket cache: merged
+  batches pad to the next power of two (floor `min_bucket_rows`), so the
+  set of shapes reaching jit is small and steady state never re-traces
+  — the compile counters in `obs/jaxruntime` are the proof surface.
+- **An adaptive batch window**: a merge group closes when its occupancy
+  reaches `occupancy_target * max_batch_rows` OR when `batch_window_ms`
+  elapses since its first job, whichever comes first — p99 ingest
+  latency stays bounded under light load, batches stay full under heavy
+  load. Query-class jobs never wait on the window.
+
+Everything is observable: queue depth/limit gauges, per-class job and
+shed counters, per-kernel batch/occupancy/padding-waste/dispatch-wall
+families (registered in the process-wide `obs.jaxruntime.RUNTIME`
+registry, rendered on /metrics next to the jit-compile counters), and
+read-path jobs thread their scheduler wait + job count into the ambient
+per-request `QueryStats`.
+
+The scheduler is config-gated (`SchedConfig.enabled`, default on via
+`app.config.Config.sched`); every caller preserves its original
+synchronous dispatch as the fallback path, bit-identical to the
+pre-scheduler behavior when disabled.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import logging
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Callable, Sequence
+
+import numpy as np
+
+_LOG = logging.getLogger("tempo_tpu.sched")
+
+# priority classes, best first (live ingest must never starve behind an
+# expensive analytical scan; compaction yields to both)
+PRIO_INGEST, PRIO_QUERY, PRIO_COMPACTION = 0, 1, 2
+CLASS_NAMES = ("ingest", "query", "compaction")
+
+
+class QueryBackpressure(RuntimeError):
+    """The query class is saturated: the frontend rejects NEW requests
+    (503 + Retry-After) instead of queuing them unboundedly — already
+    admitted work still runs (shed executes inline)."""
+
+    def __init__(self, retry_after_s: float = 1.0) -> None:
+        super().__init__("device scheduler query queue saturated")
+        self.retry_after_s = retry_after_s
+
+
+@dataclasses.dataclass
+class SchedConfig:
+    """Knobs for the shared device-execution scheduler (`sched:` in the
+    app YAML)."""
+
+    enabled: bool = True
+    # bounded submission queues per priority class (jobs, not rows)
+    max_queue_ingest: int = 1024
+    max_queue_query: int = 512
+    max_queue_compaction: int = 256
+    # adaptive batch window: a merge group closes on occupancy target or
+    # deadline, whichever first
+    batch_window_ms: float = 2.0
+    occupancy_target: float = 0.75
+    max_batch_rows: int = 16384          # coalesced rows per dispatch
+    min_bucket_rows: int = 64            # smallest pow-2 shape bucket
+    retry_after_s: float = 1.0           # advertised on 429/503 rejections
+
+
+def bucket_rows(n: int, lo: int = 64, hi: int | None = None) -> int:
+    """Power-of-two shape bucket for a row count: next pow2 >= max(n, lo)
+    (capped at `hi` when given). The whole point of bucketing is a SMALL
+    closed set of shapes reaching jit, so steady state never re-traces."""
+    b = max(int(lo), 1)
+    while b < n:
+        b <<= 1
+    if hi is not None:
+        b = min(b, hi)
+    return b
+
+
+class Job:
+    """One unit of device work. Row jobs (`arrays` set) are coalescible:
+    same-merge_key jobs concatenate into one padded tensor per array
+    role. Fn jobs (`fn` set) execute as-is in priority order."""
+
+    __slots__ = ("priority", "kernel", "merge_key", "arrays", "pads",
+                 "n_rows", "dispatch", "fn", "tenant", "enqueue_t",
+                 "event", "result", "error", "stats", "wait_s")
+
+    def __init__(self, *, priority: int, kernel: str, merge_key=None,
+                 arrays: "tuple | None" = None,
+                 pads: "tuple | None" = None, n_rows: int = 0,
+                 dispatch: "Callable | None" = None,
+                 fn: "Callable | None" = None, tenant: str = "",
+                 stats=None) -> None:
+        self.priority = priority
+        self.kernel = kernel
+        self.merge_key = merge_key
+        self.arrays = arrays
+        self.pads = pads
+        self.n_rows = n_rows
+        self.dispatch = dispatch
+        self.fn = fn
+        self.tenant = tenant
+        self.enqueue_t = 0.0
+        self.event = threading.Event()
+        self.result = None
+        self.error: "BaseException | None" = None
+        self.stats = stats     # caller's QueryStats, adopted by the worker
+        self.wait_s = 0.0      # enqueue → execution-start (set by worker)
+
+    def wait(self, timeout: "float | None" = None) -> bool:
+        """Block until dispatched; re-raises the dispatch error, if any."""
+        ok = self.event.wait(timeout)
+        if ok and self.error is not None:
+            raise self.error
+        return ok
+
+
+class _MergeGroup:
+    """Pending coalescible jobs sharing one merge_key: one state plane,
+    one dispatch closure, one eventual padded tensor."""
+
+    __slots__ = ("kernel", "pads", "dispatch", "jobs", "rows", "first_t",
+                 "pack")
+
+    def __init__(self, kernel: str, pads: tuple, dispatch: Callable,
+                 first_t: float, pack: bool = False) -> None:
+        self.kernel = kernel
+        self.pads = pads
+        self.dispatch = dispatch
+        self.jobs: list[Job] = []
+        self.rows = 0
+        self.first_t = first_t
+        self.pack = pack
+
+
+class DeviceScheduler:
+    """The shared scheduler. One per process in production (see
+    `configure()` / `scheduler()`); tests construct their own with
+    `start_worker=False` and drive `drain_once()` by hand."""
+
+    def __init__(self, cfg: SchedConfig | None = None,
+                 now: Callable[[], float] = time.monotonic,
+                 start_worker: bool = True) -> None:
+        self.cfg = cfg or SchedConfig()
+        self.now = now
+        self._cond = threading.Condition()
+        # fn jobs per class; row jobs live in merge groups (ingest class)
+        self._queues: tuple[deque, ...] = (deque(), deque(), deque())
+        self._groups: "OrderedDict[object, _MergeGroup]" = OrderedDict()
+        self._inflight = 0
+        # re-entrant: a dispatched job may itself flush() (e.g. a
+        # scheduled read that needs queued sketch updates drained first)
+        self._drain_lock = threading.RLock()
+        self._drainer: "int | None" = None
+        # guards the per-kernel stat dicts: the worker and shed-path
+        # caller threads dispatch concurrently, and losing increments
+        # during saturation would corrupt exactly the metrics that
+        # diagnose saturation
+        self._stats_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._worker: "threading.Thread | None" = None
+        self._worker_ident: "int | None" = None
+        # plain-dict stats (obs renders them through callback families;
+        # the hot path pays dict increments, never registry locks)
+        self.jobs_total = {c: 0 for c in CLASS_NAMES}
+        self.shed_total = {c: 0 for c in CLASS_NAMES}
+        self.batches_total: dict[str, int] = {}
+        self.coalesced_total: dict[str, int] = {}
+        self.padding_waste_bytes: dict[str, int] = {}
+        self.bucket_warmups: dict[str, int] = {}
+        self.dispatch_errors = 0
+        self.occupancy_sum: dict[str, float] = {}
+        self._warm_buckets: set[tuple] = set()
+        if start_worker and self.cfg.enabled:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._worker is not None and self._worker.is_alive():
+            return
+        self._stop.clear()
+        self._worker = threading.Thread(target=self._worker_loop,
+                                        name="tempo-sched", daemon=True)
+        self._worker.start()
+
+    def stop(self, flush: bool = True) -> None:
+        if flush:
+            self.flush()
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=2)
+            self._worker = None
+            self._worker_ident = None
+
+    def reconfigure(self, cfg: SchedConfig) -> None:
+        """Adopt new knobs in place (multiple Apps in one process share
+        the singleton; last writer wins, like jax runtime flags)."""
+        self.cfg = cfg
+        if cfg.enabled:
+            self.start()
+
+    # -- introspection -----------------------------------------------------
+
+    def _limit(self, prio: int) -> int:
+        return (self.cfg.max_queue_ingest, self.cfg.max_queue_query,
+                self.cfg.max_queue_compaction)[prio]
+
+    def depth(self, prio: int) -> int:
+        with self._cond:
+            n = len(self._queues[prio])
+            if prio == PRIO_INGEST:
+                n += sum(len(g.jobs) for g in self._groups.values())
+            return n
+
+    def pending(self) -> int:
+        with self._cond:
+            return (sum(len(q) for q in self._queues)
+                    + sum(len(g.jobs) for g in self._groups.values())
+                    + self._inflight)
+
+    def pressure(self) -> dict[str, float]:
+        """class → fill ratio of its bounded queue (the backpressure
+        signal the distributor and frontend consult)."""
+        return {CLASS_NAMES[p]: self.depth(p) / max(self._limit(p), 1)
+                for p in (PRIO_INGEST, PRIO_QUERY, PRIO_COMPACTION)}
+
+    def ingest_saturated(self) -> bool:
+        return self.cfg.enabled and \
+            self.depth(PRIO_INGEST) >= self._limit(PRIO_INGEST)
+
+    def query_saturated(self) -> bool:
+        return self.cfg.enabled and \
+            self.depth(PRIO_QUERY) >= self._limit(PRIO_QUERY)
+
+    def ingest_retry_after(self) -> "float | None":
+        """Seconds a rejected producer should back off, or None to
+        admit — the `IngestBackpressure` hook contract."""
+        return self.cfg.retry_after_s if self.ingest_saturated() else None
+
+    def mean_occupancy(self, kernel: "str | None" = None) -> float:
+        if kernel is not None:
+            n = self.batches_total.get(kernel, 0)
+            return self.occupancy_sum.get(kernel, 0.0) / n if n else 0.0
+        n = sum(self.batches_total.values())
+        return sum(self.occupancy_sum.values()) / n if n else 0.0
+
+    # -- submission --------------------------------------------------------
+
+    def submit_rows(self, kernel: str, merge_key, arrays: Sequence,
+                    n_rows: int, dispatch: Callable,
+                    pads: "Sequence | None" = None,
+                    tenant: str = "", pack: bool = False) -> Job:
+        """Enqueue a coalescible row batch (live-ingest class).
+
+        `arrays` are row-aligned host vectors (one per kernel argument
+        role); `pads[i]` is the fill value padding rows take in role i
+        (defaults: -1 for the first role — the slot ids every scatter
+        kernel drops — and 0 for the rest). `dispatch(*padded_arrays)`
+        runs ONCE per merged batch on the worker thread and must bind the
+        new device state itself (under its own state lock).
+
+        `pack=True` ships the merged batch as ONE row-major f32 matrix
+        `[n_roles, bucket]` (dispatch receives a single array): behind a
+        high-latency device link the per-dispatch transfer COUNT is the
+        cost, so all roles ride one H2D — the coalescer-side twin of the
+        spanmetrics packed fast path. Every role must survive an f32
+        round trip (slot ids do while the series capacity is < 2^24; the
+        caller owns that gate).
+
+        Never blocks and never drops data: on a saturated queue the job
+        executes inline on the caller (shed, counted) — ADMISSION control
+        lives at the distributor boundary, which consults
+        `ingest_retry_after()` before accepting the bytes at all.
+        """
+        pads = tuple(pads) if pads is not None else \
+            (-1,) + (0,) * (len(arrays) - 1)
+        job = Job(priority=PRIO_INGEST, kernel=kernel, merge_key=merge_key,
+                  arrays=tuple(arrays), pads=pads, n_rows=int(n_rows),
+                  dispatch=dispatch, tenant=tenant)
+        if not self.cfg.enabled:
+            self._run_group(_group_of(job, pack))
+            return job
+        with self._cond:
+            depth = len(self._queues[PRIO_INGEST]) + sum(
+                len(g.jobs) for g in self._groups.values())
+            if depth >= self._limit(PRIO_INGEST):
+                self.shed_total["ingest"] += 1
+            else:
+                job.enqueue_t = self.now()
+                g = self._groups.get(merge_key)
+                if g is None:
+                    g = self._groups[merge_key] = _MergeGroup(
+                        kernel, pads, dispatch, job.enqueue_t, pack=pack)
+                g.jobs.append(job)
+                g.rows += job.n_rows
+                self.jobs_total["ingest"] += 1
+                # wake the worker only when it has something new to DO:
+                # the first job of a group (arm the deadline timer) or an
+                # occupancy-threshold crossing (close now). Waking per
+                # submit costs a context switch per push and was measured
+                # to eat the whole coalescing win on the CPU backend.
+                target = self.cfg.occupancy_target * self.cfg.max_batch_rows
+                if len(g.jobs) == 1 or (g.rows >= target
+                                        and g.rows - job.n_rows < target):
+                    self._cond.notify_all()
+                return job
+        # shed path: dispatch inline, outside the lock
+        self._run_group(_group_of(job, pack))
+        return job
+
+    def run(self, fn: Callable, kernel: str = "fn",
+            priority: int = PRIO_QUERY, tenant: str = ""):
+        """Execute `fn` (a device-dispatching closure) under scheduler
+        ordering and return its result. Runs inline when the scheduler is
+        disabled, when called FROM the worker (re-entrancy), when the
+        scheduler is idle (no queue to order against — zero added
+        latency on the common light-load path), or when the class queue
+        is full (shed, counted)."""
+        if not self.cfg.enabled or \
+                threading.get_ident() == self._worker_ident:
+            return fn()
+        cls = CLASS_NAMES[priority]
+        with self._cond:
+            idle = not any(self._queues) and not self._groups \
+                and self._inflight == 0
+            if idle:
+                self.jobs_total[cls] += 1
+            elif len(self._queues[priority]) >= self._limit(priority):
+                self.shed_total[cls] += 1
+                idle = True            # run inline below
+            else:
+                from tempo_tpu.obs import querystats
+                job = Job(priority=priority, kernel=kernel, fn=fn,
+                          tenant=tenant, stats=querystats.current())
+                job.enqueue_t = self.now()
+                self._queues[priority].append(job)
+                self.jobs_total[cls] += 1
+                self._cond.notify_all()
+        if idle:
+            return fn()
+        job.wait()
+        # pure QUEUE wait (enqueue → execution start, stamped by the
+        # worker): the kernel's own wall time is already attributed by
+        # the job's recording inside the adopted QueryStats scope
+        wait_ns = max(int(job.wait_s * 1e9), 0)
+        if job.stats is not None:
+            job.stats.add_stage_ns("sched_wait", wait_ns)
+            job.stats.add(sched_jobs=1)
+        _QUEUE_WAIT.observe(wait_ns / 1e9, (cls,))
+        return job.result
+
+    def _queued_count(self) -> int:
+        with self._cond:
+            return (sum(len(q) for q in self._queues)
+                    + sum(len(g.jobs) for g in self._groups.values()))
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Barrier: force-dispatch everything queued (windows ignored)
+        and wait for in-flight work; returns True on a clean drain.
+        Collection ticks, sketch-quantile reads, and stale-series purges
+        call this so reads never miss queued updates (and slot reuse can
+        never misroute one). Safe to call from INSIDE a dispatched job:
+        the nested drain runs on the same thread and only waits for
+        queued work, never for its own in-flight frame. Must not be
+        called while holding a registry state_lock (dispatch closures
+        take those locks)."""
+        deadline = time.monotonic() + timeout
+        inside = threading.get_ident() == self._drainer
+        while time.monotonic() < deadline:
+            if (self._queued_count() if inside else self.pending()) == 0:
+                return True
+            if not self.drain_once(force=True) and not inside:
+                time.sleep(0.0005)
+        # NEVER time out silently with work still queued: the caller is
+        # about to read (or purge) state this barrier was supposed to
+        # cover — a slot-reuse misroute downstream would be invisible
+        _LOG.error("tempo-sched: flush timed out after %ss with %d jobs "
+                   "still queued", timeout, self._queued_count())
+        return False
+
+    # -- draining ----------------------------------------------------------
+
+    def _group_ready(self, g: _MergeGroup, now: float) -> bool:
+        return (g.rows >= self.cfg.occupancy_target * self.cfg.max_batch_rows
+                or (now - g.first_t) * 1000.0 >= self.cfg.batch_window_ms)
+
+    def _wait_budget_locked(self) -> "float | None":
+        """How long the worker may sleep (caller holds _cond): 0 when
+        anything is dispatchable right now, the nearest group deadline
+        otherwise, None when idle."""
+        if any(self._queues):
+            return 0.0
+        if not self._groups:
+            return None
+        now = self.now()
+        if any(self._group_ready(g, now) for g in self._groups.values()):
+            return 0.0
+        return max(0.0, min(
+            g.first_t + self.cfg.batch_window_ms / 1000.0 - now
+            for g in self._groups.values()))
+
+    def drain_once(self, force: bool = False) -> bool:
+        """One scheduling cycle: pop everything dispatchable right now
+        and execute it in priority order (ready ingest groups, ingest
+        fns, query fns; compaction only when nothing better is pending).
+        Returns True when any work ran. Thread-safe: the worker loop and
+        `flush()` callers serialize on the drain lock."""
+        with self._drain_lock:
+            prev_drainer, self._drainer = self._drainer, threading.get_ident()
+            try:
+                return self._drain_locked(force)
+            finally:
+                self._drainer = prev_drainer
+
+    def _drain_locked(self, force: bool) -> bool:
+        with self._cond:
+            now = self.now()
+            groups = [k for k, g in self._groups.items()
+                      if force or self._group_ready(g, now)]
+            ready = [self._groups.pop(k) for k in groups]
+            ingest_fns = list(self._queues[PRIO_INGEST])
+            self._queues[PRIO_INGEST].clear()
+            query_fns = list(self._queues[PRIO_QUERY])
+            self._queues[PRIO_QUERY].clear()
+            comp_fns: list[Job] = []
+            if (not ready and not ingest_fns and not query_fns
+                    and not self._groups) or force:
+                comp_fns = list(self._queues[PRIO_COMPACTION])
+                self._queues[PRIO_COMPACTION].clear()
+            n = (len(ready) + len(ingest_fns) + len(query_fns)
+                 + len(comp_fns))
+            self._inflight += n
+        if n == 0:
+            return False
+        try:
+            for g in ready:
+                self._run_group(g)
+            for job in ingest_fns + query_fns + comp_fns:
+                self._run_fn(job)
+        finally:
+            with self._cond:
+                self._inflight -= n
+                self._cond.notify_all()
+        return True
+
+    def _worker_loop(self) -> None:
+        self._worker_ident = threading.get_ident()
+        while not self._stop.is_set():
+            with self._cond:
+                # the readiness check and the wait share ONE lock
+                # acquisition: a submit's notify between a check and a
+                # separate wait would otherwise be lost and stretch a
+                # 2ms batch window to the 200ms fallback sleep
+                wait = self._wait_budget_locked()
+                if wait is None or wait > 0:
+                    self._cond.wait(min(wait, 0.2) if wait is not None
+                                    else 0.2)
+            if self._stop.is_set():
+                break
+            try:
+                self.drain_once()
+            except BaseException as e:       # noqa: BLE001 — keep alive
+                # a dead worker is a total silent outage (every queued
+                # caller hangs, ingest fills to 429): log and keep going
+                _LOG.exception("tempo-sched: drain cycle failed: %r", e)
+
+    # -- execution ---------------------------------------------------------
+
+    def _run_group(self, g: _MergeGroup) -> None:
+        """Coalesce one merge group into padded pow-2 tensors and
+        dispatch, chunked at `max_batch_rows`."""
+        jobs = g.jobs
+        i = 0
+        while i < len(jobs):
+            chunk = [jobs[i]]
+            rows = jobs[i].n_rows
+            i += 1
+            while i < len(jobs) and \
+                    rows + jobs[i].n_rows <= self.cfg.max_batch_rows:
+                rows += jobs[i].n_rows
+                chunk.append(jobs[i])
+                i += 1
+            self._dispatch_chunk(g, chunk, rows)
+
+    def _dispatch_chunk(self, g: _MergeGroup, chunk: list[Job],
+                        rows: int) -> None:
+        t0 = time.perf_counter()
+        err: "BaseException | None" = None
+        try:
+            # the WHOLE build+dispatch sits under the guard: a failure
+            # anywhere (allocation, a bad job array, the kernel itself)
+            # must land on the jobs, never escape to kill the worker
+            bucket = bucket_rows(max(rows, 1), self.cfg.min_bucket_rows)
+            waste = 0
+            if g.pack:
+                # one row-major f32 matrix = ONE H2D for the whole batch
+                mat = np.empty((len(g.pads), bucket), np.float32)
+                for role, pad_val in enumerate(g.pads):
+                    off = 0
+                    for j in chunk:
+                        a = j.arrays[role]
+                        mat[role, off:off + len(a)] = a
+                        off += len(a)
+                    mat[role, off:] = pad_val
+                waste = (bucket - rows) * mat.dtype.itemsize * len(g.pads)
+                padded = [mat]
+            else:
+                padded = []
+                for role, pad_val in enumerate(g.pads):
+                    parts = [np.asarray(j.arrays[role]) for j in chunk]
+                    cat = parts[0] if len(parts) == 1 \
+                        else np.concatenate(parts)
+                    if len(cat) < bucket:
+                        out = np.full(bucket, pad_val, dtype=cat.dtype)
+                        out[: len(cat)] = cat
+                        cat = out
+                    waste += (bucket - rows) * cat.dtype.itemsize
+                    padded.append(cat)
+            sig = (g.kernel, bucket) + tuple(a.dtype.str for a in padded)
+            occ = rows / bucket
+            with self._stats_lock:
+                if sig not in self._warm_buckets:
+                    self._warm_buckets.add(sig)
+                    self.bucket_warmups[g.kernel] = \
+                        self.bucket_warmups.get(g.kernel, 0) + 1
+                self.occupancy_sum[g.kernel] = \
+                    self.occupancy_sum.get(g.kernel, 0.0) + occ
+                self.batches_total[g.kernel] = \
+                    self.batches_total.get(g.kernel, 0) + 1
+                self.coalesced_total[g.kernel] = \
+                    self.coalesced_total.get(g.kernel, 0) + len(chunk)
+                self.padding_waste_bytes[g.kernel] = \
+                    self.padding_waste_bytes.get(g.kernel, 0) + waste
+            _OCCUPANCY.observe(occ, (g.kernel,))
+            g.dispatch(*padded)
+        except BaseException as e:           # noqa: BLE001 — propagated
+            err = e
+            self._note_dispatch_error(g.kernel, e)
+        _DISPATCH_SECONDS.observe(time.perf_counter() - t0, (g.kernel,))
+        for j in chunk:
+            j.error = err
+            j.event.set()
+
+    def _note_dispatch_error(self, kernel: str, e: BaseException) -> None:
+        """Dispatch failures must never be silent: ingest-route jobs are
+        fire-and-forget, so the error is counted (exported as
+        tempo_sched_dispatch_errors_total) AND logged — a persistently
+        failing kernel means updates are being dropped."""
+        with self._stats_lock:
+            self.dispatch_errors += 1
+        _LOG.error("tempo-sched: dispatch of kernel %r failed: %r",
+                   kernel, e)
+
+    def _run_fn(self, job: Job) -> None:
+        from tempo_tpu.obs import querystats
+
+        if job.enqueue_t:
+            job.wait_s = max(self.now() - job.enqueue_t, 0.0)
+        t0 = time.perf_counter()
+        try:
+            if job.stats is not None:
+                # adopt the caller's per-request QueryStats on this
+                # thread so the kernel's own recording (device_scan
+                # bytes, kernel wall) lands in the right request scope
+                with querystats.scope(job.stats):
+                    job.result = job.fn()
+            else:
+                job.result = job.fn()
+        except BaseException as e:           # noqa: BLE001 — propagated
+            # fn jobs have a waiting caller who re-raises and owns the
+            # error surface; dispatch_errors stays a dropped-ingest-batch
+            # signal (its family help + dashboard panel say so)
+            job.error = e
+        _DISPATCH_SECONDS.observe(time.perf_counter() - t0, (job.kernel,))
+        job.event.set()
+
+
+def _group_of(job: Job, pack: bool = False) -> _MergeGroup:
+    g = _MergeGroup(job.kernel, job.pads, job.dispatch, job.enqueue_t,
+                    pack=pack)
+    g.jobs.append(job)
+    g.rows = job.n_rows
+    return g
+
+
+# ---------------------------------------------------------------------------
+# the process-wide scheduler (configured by App, consulted everywhere)
+# ---------------------------------------------------------------------------
+
+_default: "DeviceScheduler | None" = None
+_default_lock = threading.Lock()
+
+
+def configure(cfg: SchedConfig | None = None,
+              now: Callable[[], float] = time.monotonic) -> DeviceScheduler:
+    """Create or reconfigure the process-wide scheduler (App wiring).
+    Like the JAX runtime registry, it is process-level state: several
+    Apps in one test process share it, last configuration wins."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = DeviceScheduler(cfg, now=now)
+        else:
+            _default.reconfigure(cfg or SchedConfig())
+        return _default
+
+
+def scheduler() -> "DeviceScheduler | None":
+    """The process-wide scheduler, or None when never configured —
+    callers fall back to their original synchronous dispatch."""
+    return _default
+
+
+def reset() -> None:
+    """Flush + drop the process scheduler (test isolation: a test that
+    booted an App must not leave later standalone tests' dispatches
+    riding a scheduler they never asked for)."""
+    global _default
+    with _default_lock:
+        sc, _default = _default, None
+    if sc is not None:
+        sc.stop(flush=True)
+
+
+@contextlib.contextmanager
+def use(sc: "DeviceScheduler | None"):
+    """Install `sc` as the process scheduler for a with-block (tests)."""
+    global _default
+    with _default_lock:
+        prev, _default = _default, sc
+    try:
+        yield sc
+    finally:
+        with _default_lock:
+            _default = prev
+
+
+def run(fn: Callable, kernel: str = "fn",
+        priority: int = PRIO_QUERY, tenant: str = ""):
+    """Route one device-dispatching closure through the process
+    scheduler; plain `fn()` when none is configured or it is disabled."""
+    sc = _default
+    if sc is None or not sc.cfg.enabled:
+        return fn()
+    return sc.run(fn, kernel=kernel, priority=priority, tenant=tenant)
+
+
+def flush() -> None:
+    """Barrier on the process scheduler, if any (collection ticks,
+    state readers)."""
+    sc = _default
+    if sc is not None and sc.cfg.enabled:
+        sc.flush()
+
+
+# ---------------------------------------------------------------------------
+# obs: scheduler families in the process-wide runtime registry
+# ---------------------------------------------------------------------------
+
+from tempo_tpu.obs.jaxruntime import RUNTIME  # noqa: E402
+from tempo_tpu.obs.registry import exponential_buckets  # noqa: E402
+
+
+def _per_class(field: str):
+    def fn():
+        sc = _default
+        if sc is None:
+            return []
+        return [((c,), float(v)) for c, v in getattr(sc, field).items()]
+    return fn
+
+
+def _per_kernel(field: str):
+    def fn():
+        sc = _default
+        if sc is None:
+            return []
+        return [((k,), float(v)) for k, v in getattr(sc, field).items()]
+    return fn
+
+
+RUNTIME.gauge_func(
+    "tempo_sched_queue_depth",
+    lambda: [] if _default is None else
+    [((CLASS_NAMES[p],), float(_default.depth(p))) for p in (0, 1, 2)],
+    help="Jobs waiting in the device scheduler, by priority class",
+    labels=("class",))
+RUNTIME.gauge_func(
+    "tempo_sched_queue_limit",
+    lambda: [] if _default is None else
+    [((CLASS_NAMES[p],), float(_default._limit(p))) for p in (0, 1, 2)],
+    help="Bounded queue capacity per priority class (saturation "
+         "denominator for alerting)",
+    labels=("class",))
+RUNTIME.counter_func(
+    "tempo_sched_jobs_total", _per_class("jobs_total"),
+    help="Jobs accepted by the device scheduler, by priority class",
+    labels=("class",))
+RUNTIME.counter_func(
+    "tempo_sched_shed_jobs_total", _per_class("shed_total"),
+    help="Jobs shed to inline execution because their class queue was "
+         "full (sustained shedding means the device is the bottleneck)",
+    labels=("class",))
+RUNTIME.counter_func(
+    "tempo_sched_batches_total", _per_kernel("batches_total"),
+    help="Merged batches dispatched, by kernel",
+    labels=("kernel",))
+RUNTIME.counter_func(
+    "tempo_sched_coalesced_jobs_total", _per_kernel("coalesced_total"),
+    help="Row jobs folded into merged batches, by kernel "
+         "(coalesced/batches = jobs amortized per dispatch)",
+    labels=("kernel",))
+RUNTIME.counter_func(
+    "tempo_sched_padding_waste_bytes_total",
+    _per_kernel("padding_waste_bytes"),
+    help="Bytes of pow-2 padding dispatched beyond real rows, by kernel "
+         "(the price of the shape-bucket jit cache)",
+    labels=("kernel",))
+RUNTIME.counter_func(
+    "tempo_sched_bucket_warmups_total", _per_kernel("bucket_warmups"),
+    help="First-time (kernel, shape-bucket) combinations dispatched; "
+         "flat after warmup means zero steady-state re-traces",
+    labels=("kernel",))
+RUNTIME.counter_func(
+    "tempo_sched_dispatch_errors_total",
+    lambda: [] if _default is None else
+    [((), float(_default.dispatch_errors))],
+    help="Scheduler dispatches that raised (fire-and-forget ingest "
+         "batches were DROPPED; also logged on tempo_tpu.sched)")
+_OCCUPANCY = RUNTIME.histogram(
+    "tempo_sched_batch_occupancy_ratio",
+    "Real rows / padded bucket rows per merged batch (the ISSUE floor "
+    "is 0.7 at steady state)",
+    labels=("kernel",),
+    buckets=(0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0))
+_DISPATCH_SECONDS = RUNTIME.histogram(
+    "tempo_sched_dispatch_duration_seconds",
+    "Wall time of one scheduler dispatch (merged batch or fn job), by "
+    "kernel", labels=("kernel",),
+    buckets=exponential_buckets(1e-5, 4.0, 12))
+_QUEUE_WAIT = RUNTIME.histogram(
+    "tempo_sched_queue_wait_seconds",
+    "Time a scheduled job waited between enqueue and completion, by "
+    "priority class", labels=("class",),
+    buckets=exponential_buckets(1e-5, 4.0, 12))
+
+
+__all__ = [
+    "PRIO_INGEST", "PRIO_QUERY", "PRIO_COMPACTION", "CLASS_NAMES",
+    "SchedConfig", "QueryBackpressure", "Job",
+    "DeviceScheduler", "bucket_rows", "configure", "scheduler", "use",
+    "run", "flush", "reset",
+]

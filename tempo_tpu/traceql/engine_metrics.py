@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from functools import partial
 from typing import Iterable, Optional
 
 import numpy as np
@@ -113,31 +112,58 @@ class TimeSeries:
 # device kernels (jit-cached per (capacity, steps) shape bucket)
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, donate_argnums=0)
-def _scatter_add2(grid, slots, steps, w):
+# instrumented (obs/jaxruntime compile counters) so the scheduler's
+# zero-steady-state-recompile guarantee is verifiable per kernel; the
+# pow-2 padding below keeps the shape set bucketed and finite
+from tempo_tpu.obs.jaxruntime import instrumented_jit
+
+
+def _scatter_add2_impl(grid, slots, steps, w):
     return grid.at[slots, steps].add(w, mode="drop")
 
 
-@partial(jax.jit, donate_argnums=0)
-def _scatter_min2(grid, slots, steps, v):
+def _scatter_min2_impl(grid, slots, steps, v):
     return grid.at[slots, steps].min(v, mode="drop")
 
 
-@partial(jax.jit, donate_argnums=0)
-def _scatter_max2(grid, slots, steps, v):
+def _scatter_max2_impl(grid, slots, steps, v):
     return grid.at[slots, steps].max(v, mode="drop")
 
 
-@partial(jax.jit, donate_argnums=0)
-def _scatter_add3(grid, slots, steps, buckets, w):
+def _scatter_add3_impl(grid, slots, steps, buckets, w):
     return grid.at[slots, steps, buckets].add(w, mode="drop")
 
 
+_scatter_add2 = instrumented_jit(_scatter_add2_impl,
+                                 name="engine_scatter_add2",
+                                 donate_argnums=0)
+_scatter_min2 = instrumented_jit(_scatter_min2_impl,
+                                 name="engine_scatter_min2",
+                                 donate_argnums=0)
+_scatter_max2 = instrumented_jit(_scatter_max2_impl,
+                                 name="engine_scatter_max2",
+                                 donate_argnums=0)
+_scatter_add3 = instrumented_jit(_scatter_add3_impl,
+                                 name="engine_scatter_add3",
+                                 donate_argnums=0)
+
+
+def _sched_scatter(fn, *args):
+    """Run one grid-scatter dispatch through the shared device scheduler
+    (query class): ingest batches order ahead, the dispatch is counted,
+    and an idle scheduler adds zero latency (inline fast path). Direct
+    call when no scheduler is configured."""
+    from tempo_tpu import sched
+
+    return sched.run(lambda: fn(*args), kernel="engine_metrics_scatter")
+
+
 def _pad_pow2(n: int, lo: int = 256) -> int:
-    c = lo
-    while c < n:
-        c *= 2
-    return c
+    # the ONE shape-bucket policy, shared with the device scheduler's
+    # coalescer (sched.bucket_rows) so the jit shape cache can't split
+    from tempo_tpu.sched import bucket_rows
+
+    return bucket_rows(n, lo)
 
 
 class _SeriesIndex:
@@ -301,25 +327,25 @@ class MetricsEvaluator:
         k = self.m.kind
         if self._hist:
             b = jnp.asarray(np.pad(log2_bucket_np(vals), (0, pad)))
-            self._grids["hist"] = _scatter_add3(
-                self._grids["hist"], jslots, jsteps, b, ones)
+            self._grids["hist"] = _sched_scatter(
+                _scatter_add3, self._grids["hist"], jslots, jsteps, b, ones)
         elif k in (A.MetricsKind.RATE, A.MetricsKind.COUNT_OVER_TIME):
-            self._grids["count"] = _scatter_add2(
-                self._grids["count"], jslots, jsteps, ones)
+            self._grids["count"] = _sched_scatter(
+                _scatter_add2, self._grids["count"], jslots, jsteps, ones)
         elif k == A.MetricsKind.MIN_OVER_TIME:
-            self._grids["min"] = _scatter_min2(
-                self._grids["min"], jslots, jsteps, jvals)
+            self._grids["min"] = _sched_scatter(
+                _scatter_min2, self._grids["min"], jslots, jsteps, jvals)
         elif k == A.MetricsKind.MAX_OVER_TIME:
-            self._grids["max"] = _scatter_max2(
-                self._grids["max"], jslots, jsteps, jvals)
+            self._grids["max"] = _sched_scatter(
+                _scatter_max2, self._grids["max"], jslots, jsteps, jvals)
         elif k == A.MetricsKind.SUM_OVER_TIME:
-            self._grids["sum"] = _scatter_add2(
-                self._grids["sum"], jslots, jsteps, jvals)
+            self._grids["sum"] = _sched_scatter(
+                _scatter_add2, self._grids["sum"], jslots, jsteps, jvals)
         elif k == A.MetricsKind.AVG_OVER_TIME:
-            self._grids["sum"] = _scatter_add2(
-                self._grids["sum"], jslots, jsteps, jvals)
-            self._grids["count"] = _scatter_add2(
-                self._grids["count"], jslots, jsteps, ones)
+            self._grids["sum"] = _sched_scatter(
+                _scatter_add2, self._grids["sum"], jslots, jsteps, jvals)
+            self._grids["count"] = _sched_scatter(
+                _scatter_add2, self._grids["count"], jslots, jsteps, ones)
         self._note_exemplars(view, rows, slots)
 
     def _matching_rows(self, view: ColumnView) -> np.ndarray:
@@ -390,8 +416,8 @@ class MetricsEvaluator:
             size = _pad_pow2(len(r), 64)
             pad = size - len(r)
             g = "sel" if which == "selection" else "base"
-            self._grids[g] = _scatter_add2(
-                self._grids[g],
+            self._grids[g] = _sched_scatter(
+                _scatter_add2, self._grids[g],
                 jnp.asarray(np.pad(slots, (0, pad), constant_values=self._cap)),
                 jnp.asarray(np.pad(s.astype(np.int32), (0, pad))),
                 jnp.asarray(np.pad(np.ones(len(r), np.float32), (0, pad))))

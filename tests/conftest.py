@@ -25,3 +25,17 @@ jax.config.update("jax_platforms", "cpu")
 # suite; cache them across runs.
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_device_scheduler():
+    """The device scheduler (tempo_tpu.sched) is process-wide state that
+    App construction configures; drop it between tests so standalone
+    processors (which assert on device state right after a push) never
+    inherit async dispatch from an earlier App-based test."""
+    yield
+    from tempo_tpu import sched
+
+    sched.reset()
