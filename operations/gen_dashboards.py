@@ -188,6 +188,20 @@ def dashboards() -> dict[str, dict]:
                 p("Ingester flush p99 by op",
                   _p99("tempo_ingester_flush_duration_seconds", "op"),
                   legend="{{op}}"),
+                # ingest staging pipeline (runbook: "Reading the ingest
+                # pipeline"): decode/update overlap health
+                p("Ingest pipeline in-flight batches",
+                  "tempo_ingest_pipeline_inflight"),
+                p("Ingest decode/dispatch overlap",
+                  "tempo_ingest_pipeline_overlap_ratio",
+                  unit="percentunit"),
+                p("Ingest pipeline stall s/s (device-bound when high)",
+                  "rate(tempo_ingest_pipeline_stall_seconds_total[5m])"),
+                p("Staging buffer reuse ratio",
+                  "rate(tempo_ingest_pipeline_staging_reuse_total[5m]) /"
+                  " (rate(tempo_ingest_pipeline_staging_reuse_total[5m])"
+                  " + rate(tempo_ingest_pipeline_staging_alloc_total[5m]))",
+                  unit="percentunit"),
             ]),
         "tempo-tpu-resources.json": dash(
             "Tempo-TPU / Resources",

@@ -492,7 +492,10 @@ class App:
             )
             self.jaeger_agent = JaegerAgentReceiver(
                 self.distributor, JaegerAgentConfig(
-                    port=self.cfg.distributor.jaeger_agent_port))
+                    host=self.cfg.distributor.jaeger_agent_host,
+                    port=self.cfg.distributor.jaeger_agent_port,
+                    allow_wildcard_bind=self.cfg.distributor
+                        .jaeger_agent_allow_wildcard))
             self.jaeger_agent.start()
         if self.ingester:
             self.ingester.start()

@@ -156,6 +156,17 @@ class Config:
                             "to ingest-visible metrics latency per batch")
         if self.sched.enabled and not (0 < self.sched.occupancy_target <= 1):
             warnings.append("sched.occupancy_target must be in (0, 1]")
+        if self.sched.pipeline_depth < 0:
+            warnings.append("sched.pipeline_depth < 0: use 0 to disable "
+                            "the ingest staging ring")
+        if self.distributor.jaeger_agent_port and \
+                self.distributor.jaeger_agent_host in ("", "0.0.0.0", "::") \
+                and not self.distributor.jaeger_agent_allow_wildcard:
+            warnings.append(
+                "distributor.jaeger_agent_host binds all interfaces "
+                "(unauthenticated UDP ingest) — set "
+                "jaeger_agent_allow_wildcard: true to confirm, or keep "
+                "the 127.0.0.1 default")
         return warnings
 
 

@@ -59,7 +59,11 @@ class _ServerConn:
         self.addr = (host or "127.0.0.1", int(port))
         self.timeout_s = timeout_s
         self._tls = threading.local()
-        self._all: list[socket.socket] = []     # for close()
+        # (socket, owning thread) — the thread handle lets append-time
+        # pruning close sockets whose threads exited (long-lived
+        # processes recreate read pools; without pruning, dead sockets
+        # accumulate until close())
+        self._all: list[tuple[socket.socket, threading.Thread]] = []
         self._all_lock = threading.Lock()
 
     def _connect(self) -> socket.socket:
@@ -70,7 +74,17 @@ class _ServerConn:
             t.sock = s
             t.buf = b""
             with self._all_lock:
-                self._all.append(s)
+                live = []
+                for sk, th in self._all:
+                    if th.is_alive():
+                        live.append((sk, th))
+                    else:
+                        try:
+                            sk.close()
+                        except OSError:
+                            pass
+                live.append((s, threading.current_thread()))
+                self._all = live
         return t.sock
 
     def _reset(self) -> None:
@@ -82,8 +96,7 @@ class _ServerConn:
             except OSError:
                 pass
             with self._all_lock:
-                if s in self._all:
-                    self._all.remove(s)
+                self._all = [(sk, th) for sk, th in self._all if sk is not s]
             t.sock = None
         t.buf = b""
 
@@ -140,7 +153,7 @@ class _ServerConn:
 
     def close(self) -> None:
         with self._all_lock:
-            socks, self._all = list(self._all), []
+            socks, self._all = [sk for sk, _ in self._all], []
         for s in socks:
             try:
                 s.close()
@@ -169,6 +182,7 @@ class MemcachedCache:
         self.stored = 0
         self._q: "queue.Queue[tuple[bytes, bytes] | None]" = queue.Queue(
             maxsize=write_back_buffer)
+        self._closing = threading.Event()
         self._workers = []
         for _ in range(max(write_back_workers, 1)):
             t = threading.Thread(target=self._drain, daemon=True)
@@ -199,7 +213,16 @@ class MemcachedCache:
 
     def _drain(self) -> None:
         while True:
-            item = self._q.get()
+            try:
+                item = self._q.get(timeout=0.25)
+            except queue.Empty:
+                # the stop flag (not only the sentinel) ends the loop: a
+                # FULL queue at close() cannot hand every worker a
+                # sentinel, and a worker left blocked on q.get() would
+                # leak with its socket closed underneath it
+                if self._closing.is_set():
+                    return
+                continue
             try:
                 if item is None:
                     return
@@ -220,11 +243,19 @@ class MemcachedCache:
             time.sleep(0.01)
 
     def close(self) -> None:
+        """Stop workers BEFORE closing their sockets: flag + sentinels
+        (either suffices — the flag covers a full queue, the sentinels
+        skip the poll timeout), then join so no worker still owns a
+        socket when the connections close."""
+        self._closing.set()
         for _ in self._workers:
             try:
                 self._q.put_nowait(None)
             except queue.Full:
                 break
+        for t in self._workers:
+            t.join(timeout=2.0)
+        self._workers = []
         for c in self._conns:
             c.close()
 

@@ -537,17 +537,20 @@ class BlockScanPlane:
         self.last_fallback: "str | None" = None
         self.fallback_causes: dict = {}
 
-    def _bail(self, reason: str):
-        """Record a fused-path refusal cause; always returns None."""
+    def _bail(self, reason: str) -> str:
+        """Record a fused-path refusal cause and return it; `metrics_grid`
+        surfaces the cause in its return value so callers never read it
+        back off shared plane state (a concurrent query on the same
+        cached plane could overwrite it in between)."""
         with self._lock:
             self.last_fallback = reason
             self.fallback_causes[reason] = \
                 self.fallback_causes.get(reason, 0) + 1
-        return None
+        return reason
 
     # -- adoption ----------------------------------------------------------
 
-    def _up(self, arr: np.ndarray):
+    def _up(self, arr: np.ndarray, is_span_dim: bool = True):
         import jax
         import jax.numpy as jnp
 
@@ -556,8 +559,14 @@ class BlockScanPlane:
             from jax.sharding import PartitionSpec as P
 
             # span-dim arrays shard over 'data'; everything else (dict
-            # LUTs, row-group tables) replicates
-            spec = P("data") if (getattr(arr, "ndim", 0) >= 1
+            # LUTs, row-group tables) replicates. The flag is EXPLICIT
+            # from each adoption site (ADVICE r5 #3): a replicated LUT
+            # whose length coincidentally equals the span count must not
+            # be sharded — XLA SPMD would stay correct but pay gathers/
+            # collectives on every kernel using it. The shape check stays
+            # as a belt-and-braces guard for span-dim arrays.
+            spec = P("data") if (is_span_dim
+                                 and getattr(arr, "ndim", 0) >= 1
                                  and arr.shape[0] == self.n) else P()
             d = jax.device_put(np.asarray(arr),
                                NamedSharding(self.mesh, spec))
@@ -872,7 +881,7 @@ class BlockScanPlane:
                 if term is None:
                     return None
                 (kind, _, neg), lut = term
-                lut_dev = self._up(lut)
+                lut_dev = self._up(lut, is_span_dim=False)
                 with self._lock:
                     # re-check under the lock: a racing thread may have
                     # inserted the same key while we uploaded — keep its
@@ -956,7 +965,9 @@ class BlockScanPlane:
             sel = [g for g in row_groups if 0 <= g < len(self.sizes)]
             if sel:
                 lut[np.asarray(sel)] = True
-            got = self._up(lut)               # budget-accounted like all uploads
+            # row-group LUT: replicated, never span-dim (budget-accounted
+            # like all uploads)
+            got = self._up(lut, is_span_dim=False)
             with self._lock:
                 again = self._cols.get(key)
                 if again is not None:         # lost an upload race: refund
@@ -1060,9 +1071,12 @@ class BlockScanPlane:
         log2-bucket histogram axis behind `quantile_over_time` /
         `histogram_over_time` (ref `Log2Bucketize` engine_metrics.go:1392).
 
-        `m` is the A.MetricsAggregate. Returns None when any shape is
-        unsupported (caller falls back to the host engine), else a
-        GridHandle whose fetch() yields
+        `m` is the A.MetricsAggregate. Returns `(handle, cause)`:
+        `(None, cause)` when any shape is unsupported (caller falls back
+        to the host engine; `cause` is the refusal reason, returned here
+        rather than stashed on shared plane state so concurrent queries
+        on one cached plane cannot misattribute each other's fallbacks),
+        else `(handle, None)` — a GridHandle whose fetch() yields
         (group_label_list, main_grid, obs_count_grid, value_count_grid):
           count/rate       main [G, steps] counts
           min/max/sum/avg  main [G, steps]
@@ -1090,32 +1104,32 @@ class BlockScanPlane:
             A.MetricsKind.HISTOGRAM_OVER_TIME: "hist",
         }.get(m.kind)
         if kind_tag is None or step_ns <= 0 or end_ns <= start_ns:
-            return self._bail("shape")
+            return None, self._bail("shape")
         if len(m.by) > 2:
-            return self._bail("group")
+            return None, self._bail("group")
         if not self._ensure_times():
-            return self._bail("times")
+            return None, self._bail("times")
 
         plan = self._plan(list(preds), all_conditions)
         if plan is None:
-            return self._bail("predicate")
+            return None, self._bail("predicate")
         clip_lo = max(start_ns, clip_start_ns or start_ns)
         clip_hi = min(end_ns, clip_end_ns or end_ns)
         extra = self._extra_terms((clip_lo, clip_hi), row_groups)
         if extra is None:
-            return self._bail("times")
+            return None, self._bail("times")
         sig, args, ints = plan
         esig, eargs, eints = extra
 
         if len(m.by) == 2:
             gent = self._ensure_group2(m.by[0], m.by[1])
             if gent is None or len(gent[2]) > max_groups:
-                return self._bail("group")
+                return None, self._bail("group")
             _, gcodes, glabels, gex = gent
         elif m.by:
             gent = self._ensure_group(m.by[0])
             if gent is None or len(gent[2]) > max_groups:
-                return self._bail("group")
+                return None, self._bail("group")
             _, gcodes, glabels, gex = gent
         else:
             gcodes, glabels, gex = None, [None], None
@@ -1124,10 +1138,10 @@ class BlockScanPlane:
         vargs = []
         if needs_value:
             if m.attr is None:
-                return self._bail("value")
+                return None, self._bail("value")
             vent = self._ensure_value(m.attr)
             if vent is None:
-                return self._bail("value")
+                return None, self._bail("value")
             _, vvals, vbuckets, vex = vent
             vargs = [vbuckets if kind_tag == "hist" else vvals]
             if vex is not None:
@@ -1140,12 +1154,12 @@ class BlockScanPlane:
         n_groups = len(glabels)
         if n_groups * n_steps * (64 if kind_tag == "hist" else 1) * 4 \
                 > 1 << 28:
-            return self._bail("grid_size")
+            return None, self._bail("grid_size")
         delta_ns = self.time_base_ns - start_ns
         q_steps = delta_ns // step_ns              # exact whole steps (host)
         frac_ns = delta_ns - q_steps * step_ns     # in [0, step_ns)
         if abs(q_steps) > 1 << 30:
-            return self._bail("window")
+            return None, self._bail("window")
 
         # exact step bucketing is available when the grid is small enough
         # that 16-bit limb products stay in int32 and the f32 estimate is
@@ -1300,7 +1314,8 @@ class BlockScanPlane:
             kernel="plane_query_range_grid")
         main_shape = ((n_groups, n_steps, 64) if kind_tag == "hist"
                       else (n_groups, n_steps))
-        return GridHandle(glabels, packed, main_shape, (n_groups, n_steps))
+        return GridHandle(glabels, packed, main_shape,
+                          (n_groups, n_steps)), None
 
     # -- back-compat wrapper (bench/tests from round 3) ---------------------
 
@@ -1315,8 +1330,8 @@ class BlockScanPlane:
         elif group == "service":
             by = (A.Attribute("service.name", A.Scope.RESOURCE),)
         m = A.MetricsAggregate(kind=A.MetricsKind.COUNT_OVER_TIME, by=by)
-        got = self.metrics_grid(m, preds, all_conditions, start_ns, end_ns,
-                                step_ns)
+        got, _cause = self.metrics_grid(m, preds, all_conditions, start_ns,
+                                        end_ns, step_ns)
         if got is None:
             return None
         labels, main, _cnt, _vcnt = got.fetch()

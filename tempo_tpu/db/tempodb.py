@@ -293,10 +293,10 @@ class TempoDB:
                                                vcnt))
 
         for m in metas:
-            handle = cb = None
+            handle = cb = bail_cause = None
             if fusable:
                 cb = self.planes.get(self.backend_block(m))
-                handle = cb.plane.metrics_grid(
+                handle, bail_cause = cb.plane.metrics_grid(
                     ev.m, preds, ev.fetch_req.all_conditions,
                     req.start_ns, req.end_ns, req.step_ns,
                     clip_start_ns, clip_end_ns, row_groups)
@@ -313,8 +313,11 @@ class TempoDB:
             else:
                 self.plane_stats["host_metric_blocks"] += 1
                 # distinguish WHY (round-4 weak #4: a float-attr workload
-                # silently lost the fused win with no visible cause)
-                cause = (cb.plane.last_fallback or "unknown") if fusable \
+                # silently lost the fused win with no visible cause). The
+                # cause rides metrics_grid's RETURN — never read back off
+                # shared plane state, where a concurrent query bailing on
+                # the same cached plane could overwrite it (ADVICE r5 #2)
+                cause = (bail_cause or "unknown") if fusable \
                     else ("disabled" if self.planes is None
                           else "query_shape")
                 k = f"fallback_{cause}"

@@ -54,8 +54,13 @@ class DistributorConfig:
     forwarders: dict = dataclasses.field(default_factory=dict)
     # jaeger agent UDP receiver (thrift-compact emitBatch, port 6831 —
     # shim.go:165-171 jaeger protocols; deprecated upstream but still
-    # deployed). 0 = disabled.
+    # deployed). 0 = disabled. EXPOSURE: the agent protocol is
+    # unauthenticated single-tenant ingest, so the receiver binds
+    # `jaeger_agent_host` (loopback by default); binding 0.0.0.0
+    # additionally requires `jaeger_agent_allow_wildcard: true`.
     jaeger_agent_port: int = 0
+    jaeger_agent_host: str = "127.0.0.1"
+    jaeger_agent_allow_wildcard: bool = False
 
 
 class RateLimited(RuntimeError):
@@ -213,6 +218,47 @@ class Distributor:
                        or not self.forwarders.empty
                        or set(self.usage.cfg.dimensions) - {"service"})
         if not needs_dicts:
+            # decode-once staged tee: when EVERY ring target can consume
+            # row views over one shared columnar staging, the payload is
+            # decoded exactly once and never re-sliced or re-encoded
+            plan = self._staging_plan(tenant, lim)
+            if plan is not None:
+                from tempo_tpu.model.otlp_batch import stage_otlp
+
+                # admission BEFORE staging: a rejected push must not
+                # intern its strings into the tenant registry's interner
+                # (unbounded growth under sustained 429s) nor pay the
+                # full decode during exactly the stall backpressure
+                # sheds. Rejected span counts come from a lazy cheap
+                # NON-interning scan — only a rejection pays it. (A
+                # payload that then fails staging has already debited
+                # the bucket; malformed input spending the sender's own
+                # rate budget is an acceptable divergence.)
+                def _count_spans() -> int:
+                    try:
+                        got = native.otlp_scan(raw)
+                    except ValueError:
+                        return 0
+                    return len(got) if got is not None else 0
+
+                self._admit(tenant, lim, len(raw), _count_spans)
+                interner, need_span, need_res = plan
+                try:
+                    staged = stage_otlp(raw, interner,
+                                        include_span_attrs=need_span,
+                                        include_res_attrs=need_res)
+                except ValueError as e:
+                    raise MalformedPayload(str(e)) from None
+                if staged is not None:
+                    t0 = time.perf_counter()
+                    try:
+                        with tracing.span_for_tenant(
+                                "distributor.PushSpans", tenant,
+                                n_spans=staged.n):
+                            return self._push_staged(tenant, raw, staged,
+                                                     lim)
+                    finally:
+                        self.push_duration.observe(time.perf_counter() - t0)
             if recs is None:
                 try:
                     recs = native.otlp_scan(raw)
@@ -238,6 +284,29 @@ class Distributor:
         return self.push_spans(tenant, spans, size_bytes=len(raw),
                                raw_otlp=raw, raw_recs=recs2)
 
+    def _admit(self, tenant: str, lim, sz: int, n_spans) -> None:
+        """Admission shared by every push path: process-wide backpressure
+        BEFORE the tenant token bucket — a shed push must not debit the
+        tenant's rate budget, or retries during a device stall would
+        exhaust the bucket and misreport the 429 cause as rate_limited
+        long after the scheduler recovers. `n_spans` may be a lazy
+        callable: the staged route attributes rejected span counts from a
+        cheap non-interning scan only when a rejection actually happens."""
+        retry = self.backpressure.retry_after()
+        if retry is not None:
+            self._discard(REASON_BACKPRESSURE,
+                          n_spans() if callable(n_spans) else n_spans)
+            raise RateLimited(tenant, sz, retry_after_s=retry,
+                              reason=REASON_BACKPRESSURE)
+        rate = effective_rate(lim.ingestion.rate_strategy,
+                              lim.ingestion.rate_limit_bytes,
+                              self.n_distributors())
+        if not self.limiter.allow(tenant, sz, rate,
+                                  lim.ingestion.burst_size_bytes):
+            self._discard(REASON_RATE_LIMITED,
+                          n_spans() if callable(n_spans) else n_spans)
+            raise RateLimited(tenant, sz)
+
     def _service_cached(self, raw: bytes, off: int, ln: int) -> str:
         """Memoized `_resource_service` keyed by the resource BYTES."""
         key = raw[off:off + ln] if ln > 0 else b""
@@ -252,22 +321,7 @@ class Distributor:
                             recs: np.ndarray, lim) -> dict[str, int]:
         n = len(recs)
         sz = len(raw)
-        rate = effective_rate(lim.ingestion.rate_strategy,
-                              lim.ingestion.rate_limit_bytes,
-                              self.n_distributors())
-        # backpressure BEFORE the token bucket: a shed push must not
-        # debit the tenant's rate budget, or retries during a device
-        # stall would exhaust the bucket and misreport the 429 cause as
-        # rate_limited long after the scheduler recovers
-        retry = self.backpressure.retry_after()
-        if retry is not None:
-            self._discard(REASON_BACKPRESSURE, n)
-            raise RateLimited(tenant, sz, retry_after_s=retry,
-                              reason=REASON_BACKPRESSURE)
-        if not self.limiter.allow(tenant, sz, rate,
-                                  lim.ingestion.burst_size_bytes):
-            self._discard(REASON_RATE_LIMITED, n)
-            raise RateLimited(tenant, sz)
+        self._admit(tenant, lim, sz, n)
         self.metrics["spans_received_total"] += n
         self.metrics["bytes_received_total"] += sz
         self.dataquality.observe_start_ns(tenant, recs["start_ns"])
@@ -433,24 +487,201 @@ class Distributor:
                 self.metrics["push_failures_total"] += 1
         return errs
 
+    # -- decode-once staged tee --------------------------------------------
+
+    def _staging_plan(self, tenant: str, lim
+                      ) -> "tuple[object, bool, bool] | None":
+        """(interner, need_span_attrs, need_res_attrs) when the staged tee
+        can serve this push, else None (columnar byte-slice route).
+
+        Eligible only when every generator client is an IN-PROCESS staged
+        consumer (`staging_profile` — staging must share the tenant
+        registry's interner) agreeing on ONE interner, and every ingester
+        client accepts staged views. Remote clients unmarshal at their own
+        process boundary, exactly as before."""
+        if self.generator_ring is None or not self.generator_clients \
+                or not lim.generator.processors:
+            return None
+        # ring-KV deployments hand us a live client POOL, not a dict —
+        # those clients are remote by construction, so the staged tee
+        # (an in-process seam) never applies
+        if not hasattr(self.generator_clients, "values") \
+                or not hasattr(self.ingester_clients, "values"):
+            return None
+        interner = None
+        need_span = need_res = False
+        for client in self.generator_clients.values():
+            if not getattr(client, "accepts_local_trust", False) \
+                    or getattr(client, "push_staged_view", None) is None:
+                return None
+            prof = getattr(client, "staging_profile", None)
+            if prof is None:
+                return None
+            it, ns, nr = prof(tenant)
+            if interner is None:
+                interner = it
+            elif it is not interner:
+                # distinct in-process generators with distinct id spaces:
+                # one shared staging cannot serve both
+                return None
+            need_span |= ns
+            need_res |= nr
+        for client in self.ingester_clients.values():
+            if getattr(client, "push_staged", None) is None:
+                return None
+            if getattr(client, "staged_needs_attrs", True):
+                # persisting ingesters need the attr columns in the
+                # staging (the block schema keeps them)
+                need_span = need_res = True
+        return interner, need_span, need_res
+
+    def _push_staged(self, tenant: str, raw: bytes, staged,
+                     lim) -> dict[str, int]:
+        """The decode-once write path: ONE staging pass produced `staged`;
+        validation, data quality, usage attribution, trace grouping, and
+        token hashing all read the staged columns, and every ring target
+        receives a row-index VIEW over the same arrays — no re-slicing,
+        no re-encoding, no second decode anywhere in the process.
+        Admission (`_admit`) already ran in the caller, BEFORE staging."""
+        recs = staged.spans
+        n = staged.n
+        sz = len(raw)
+        self.metrics["spans_received_total"] += n
+        self.metrics["bytes_received_total"] += sz
+        self.dataquality.observe_start_ns(tenant, recs["start_ns"])
+
+        # usage attribution by service: staged records arrive grouped by
+        # resource, so res_idx changes delimit runs; the staged
+        # service_id column (fixup applied) replaces the resource-bytes
+        # memo parse entirely
+        if n and self.usage.cfg.dimensions == ("service",):
+            ri = recs["res_idx"]
+            change = np.empty(n, bool)
+            change[0] = True
+            np.not_equal(ri[1:], ri[:-1], out=change[1:])
+            first_r = np.flatnonzero(change)
+            run_lens = np.diff(np.append(first_r, n))
+            svc_ids = staged.service_ids()
+            it = staged.interner
+            per_span = sz / max(n, 1)
+            self.usage.observe_grouped(tenant, [
+                ((it.lookup(int(svc_ids[int(ri[i])]))
+                  if len(svc_ids) else "",),
+                 int(c), float(c) * per_span)
+                for i, c in zip(first_r.tolist(), run_lens.tolist())])
+
+        # validation: vectorized trace-id check
+        errs: dict[str, int] = {}
+        valid = (recs["tid_len"] > 0) & (recs["tid_len"] <= 16)
+        n_bad = int(n - valid.sum())
+        if n_bad:
+            errs[REASON_INVALID_TRACE_ID] = n_bad
+            self._discard(REASON_INVALID_TRACE_ID, n_bad)
+        if not valid.any():
+            return errs
+
+        # regroup by trace over the staged id columns (id ‖ wire length,
+        # as the columnar path keys) — straight off the StageRec rows
+        from tempo_tpu import native as _native
+
+        vrows = np.flatnonzero(valid)
+        got = _native.group_keys_strided(recs, valid)
+        if got is not None:
+            first, inverse = got
+        else:
+            tids_all = np.ascontiguousarray(recs["trace_id"])
+            keys = np.concatenate(
+                [tids_all[vrows],
+                 recs["tid_len"][vrows, None].astype(np.uint8)], axis=1)
+            first, inverse = group_keys(keys)
+        uniq_mat = np.ascontiguousarray(recs["trace_id"][vrows[first]])
+        uniq_len = recs["tid_len"][vrows[first]]
+        tokens = token_for(tenant, uniq_mat)
+        n_traces = len(first)
+
+        def rows_for(items: list[int]) -> np.ndarray:
+            if len(items) == n_traces:
+                return vrows
+            pick = np.zeros(n_traces, bool)
+            pick[np.asarray(items, np.int64)] = True
+            return vrows[pick[inverse]]
+
+        ring = self.ingester_ring
+        if lim.ingestion.tenant_shard_size:
+            ring = ring.shuffle_shard(tenant, lim.ingestion.tenant_shard_size)
+        item_reason: dict[int, str] = {}
+        tid_to_item: dict = {}
+
+        def _item_of(tid_hex: str) -> "int | None":
+            if not tid_to_item:
+                tid_to_item.update(
+                    {(uniq_mat[i].tobytes().hex(), int(uniq_len[i])): i
+                     for i in range(n_traces)})
+            return tid_to_item.get((tid_hex.ljust(32, "0"),
+                                    len(tid_hex) // 2))
+
+        def send_ing(inst: InstanceDesc, items: list[int]) -> None:
+            client = self.ingester_clients[inst.id]
+            got = client.push_staged(tenant, staged.view(rows_for(items)))
+            for tid_hex, reason in (got or {}).items():
+                i = _item_of(tid_hex)
+                if i is not None and reason:
+                    item_reason.setdefault(i, reason)
+
+        try:
+            do_batch(ring, tokens, list(range(n_traces)), send_ing,
+                     rf=self.cfg.rf)
+            self.metrics["traces_pushed_total"] += n_traces
+        except RuntimeError:
+            self.metrics["push_failures_total"] += 1
+            nv = int(valid.sum())
+            self._discard(REASON_INTERNAL, nv)
+            errs[REASON_INTERNAL] = errs.get(REASON_INTERNAL, 0) + nv
+        for reason in item_reason.values():
+            errs[reason] = errs.get(reason, 0) + 1
+            self._discard(reason, 1)
+
+        # generator tee (RF1, best-effort, staged views)
+        def send_gen(inst: InstanceDesc, items: list[int]) -> None:
+            client = self.generator_clients[inst.id]
+            view = staged.view(rows_for(items))
+            if client.push_staged_view(tenant, view) is not None:
+                return
+            # declined (e.g. the tenant instance was rebuilt with a fresh
+            # interner between planning and send): compatibility fallback
+            # through the OTLP-bytes surface
+            if view.is_full:
+                client.push_otlp(tenant, raw, trusted=True)
+            elif staged.has_span_attrs:
+                from tempo_tpu.model.otlp import encode_spans_otlp
+                client.push_otlp(tenant,
+                                 encode_spans_otlp(view.to_span_dicts()))
+            else:
+                # staged without span attrs (every ingester opted out):
+                # dict re-encode would silently drop attributes — slice
+                # the raw payload instead (scan rows align with staged
+                # rows: both scans emit in payload order)
+                from tempo_tpu import native
+                from tempo_tpu.model.otlp import slice_otlp_payload
+                recs2 = native.otlp_scan(raw)
+                client.push_otlp(
+                    tenant,
+                    slice_otlp_payload(raw, recs2,
+                                       view.row_indices().tolist()),
+                    trusted=True)
+
+        try:
+            do_batch(self.generator_ring, tokens, list(range(n_traces)),
+                     send_gen, rf=self.cfg.generator_rf)
+        except RuntimeError:
+            self.metrics["push_failures_total"] += 1
+        return errs
+
     def _push_spans(self, tenant, spans, size_bytes, raw_otlp,
                     raw_recs) -> dict[str, int]:
         lim = self.overrides.for_tenant(tenant)
         sz = size_bytes if size_bytes is not None else _approx_bytes(spans)
-        rate = effective_rate(lim.ingestion.rate_strategy,
-                              lim.ingestion.rate_limit_bytes,
-                              self.n_distributors())
-        # backpressure first: same token-bucket-preservation ordering as
-        # the columnar path above
-        retry = self.backpressure.retry_after()
-        if retry is not None:
-            self._discard(REASON_BACKPRESSURE, len(spans))
-            raise RateLimited(tenant, sz, retry_after_s=retry,
-                              reason=REASON_BACKPRESSURE)
-        if not self.limiter.allow(tenant, sz, rate,
-                                  lim.ingestion.burst_size_bytes):
-            self._discard(REASON_RATE_LIMITED, len(spans))
-            raise RateLimited(tenant, sz)
+        self._admit(tenant, lim, sz, len(spans))
 
         self.metrics["spans_received_total"] += len(spans)
         self.metrics["bytes_received_total"] += sz

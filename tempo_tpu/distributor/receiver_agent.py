@@ -19,8 +19,14 @@ from tempo_tpu.model.jaeger import spans_from_jaeger_agent
 
 @dataclasses.dataclass
 class JaegerAgentConfig:
-    host: str = "0.0.0.0"
+    # SECURITY: this receiver is an UNAUTHENTICATED single-tenant UDP
+    # ingest — it binds loopback by default. Exposing it on every
+    # interface requires the explicit opt-in below; set it only on
+    # networks where the agent port is meant to be reachable (the
+    # reference ships the same unauthenticated jaeger agent surface).
+    host: str = "127.0.0.1"
     port: int = 6831             # jaeger thrift-compact agent port
+    allow_wildcard_bind: bool = False   # opt-in for 0.0.0.0 / :: binds
     tenant: str = "single-tenant"
     max_datagram: int = 65_000
 
@@ -42,8 +48,14 @@ class JaegerAgentReceiver:
         return self._sock.getsockname()[1]
 
     def start(self) -> None:
+        host = self.cfg.host
+        if host in ("", "0.0.0.0", "::") and not self.cfg.allow_wildcard_bind:
+            raise ValueError(
+                "jaeger agent wildcard bind requires "
+                "allow_wildcard_bind=True (unauthenticated UDP ingest on "
+                "all interfaces); default to 127.0.0.1 instead")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        self._sock.bind((self.cfg.host, self.cfg.port))
+        self._sock.bind((host, self.cfg.port))
         self._sock.settimeout(0.5)
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()

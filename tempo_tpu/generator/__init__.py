@@ -13,5 +13,6 @@ from tempo_tpu.generator.remote_write import (
 )
 from tempo_tpu.generator.instance import GeneratorInstance, GeneratorConfig
 from tempo_tpu.generator.generator import Generator
+from tempo_tpu.generator import pipeline as _pipeline  # registers obs families
 
 __all__ = [k for k in dir() if not k.startswith("_")]

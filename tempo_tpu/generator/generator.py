@@ -136,6 +136,28 @@ class Generator:
         staging path (caller sends payload bytes instead)."""
         return self.instance(tenant).push_otlp_recs(raw, recs)
 
+    # -- decode-once staged tee (distributor StagedIngest views) -----------
+
+    def staging_interner(self, tenant: str):
+        """The interner the distributor must stage against for this
+        tenant's decode-once tee (id spaces are shared between staging
+        and series labels)."""
+        return self.instance(tenant).registry.interner
+
+    def staging_profile(self, tenant: str):
+        """(interner, need_span_attrs, need_res_attrs) — what a
+        decode-once staging destined for this tenant must include."""
+        inst = self.instance(tenant)
+        need_span, need_res = inst.needs_attr_columns()
+        return inst.registry.interner, need_span, need_res
+
+    def push_staged_view(self, tenant: str, view) -> int | None:
+        """The zero-copy distributor tee: a row-index view over a shared
+        decode-once staging (`model.otlp_batch.StagedView`). Returns the
+        span count, or None when this instance cannot consume the view
+        (foreign interner) — the caller falls back to payload bytes."""
+        return self.instance(tenant).push_staged_view(view)
+
     # -- reads (frontend generator_query_range hook) -----------------------
 
     def query_range(self, tenant: str, req, clip_start_ns: int | None = None):

@@ -136,6 +136,29 @@ class GeneratorInstance:
         self.spans_filtered_slack += got[1]
         return len(recs)
 
+    def push_staged_view(self, view) -> int | None:
+        """Decode-once tee consumption: a row view over the distributor's
+        shared staging. The dedicated-spanmetrics fast route feeds the
+        StageRec rows straight to the fused resolve (no SpanBatch); every
+        other processor mix rides the staged SpanBatch columns
+        (`batch_slice` — a gather for sharded views, the SHARED batch for
+        full ones). None only on interner mismatch (the staging was not
+        built for this tenant's registry)."""
+        st = view.staged
+        if st.interner is not self.registry.interner:
+            return None
+        proc = self._fast_spanmetrics()
+        if proc is not None and not st.needs_service_fixup:
+            spans = view.stage_rows()
+            lo, hi = self._slack_bounds()
+            _n_valid, n_filtered = proc.push_staged(spans, lo, hi)
+            self.spans_received += len(spans)
+            self.spans_filtered_slack += n_filtered
+            return len(spans)
+        sb, sizes = view.batch_slice()
+        self.push_batch(sb, span_sizes=sizes)
+        return view.n
+
     def push_otlp_staged(self, data: bytes, trusted: bool = False
                          ) -> int | None:
         """Dedicated-spanmetrics fast route: OTLP bytes → C++ stage →
@@ -199,9 +222,15 @@ class GeneratorInstance:
         # drain the device scheduler first: updates accepted before this
         # tick must land in the collected state, and a stale-series purge
         # must never zero a slot that still has a queued batch targeting
-        # it (slot reuse would misroute the update to a new series)
+        # it (slot reuse would misroute the update to a new series). The
+        # staging pipeline reaps its buffer ring behind the same barrier,
+        # so collected state is bit-identical to synchronous mode.
         from tempo_tpu import sched
         sched.flush()
+        for proc in list(self.processors.values()):
+            drain = getattr(proc, "drain_pipeline", None)
+            if drain is not None:
+                drain()
         if self.now() - self._last_purge > 60.0:
             self.registry.purge_stale()
             self._last_purge = self.now()

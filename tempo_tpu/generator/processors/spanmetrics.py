@@ -155,6 +155,9 @@ class SpanMetricsProcessor:
         self._kind_lut = self._status_lut = None
         # cap → DEVICE ones-vector (jax array), uploaded once per capacity
         self._ones_cache: dict[int, object] = {}
+        # double-buffered staging ring (generator/pipeline.py), created
+        # lazily when the scheduler route is live
+        self._pipe = None
 
     def name(self) -> str:
         return "span-metrics"
@@ -170,6 +173,27 @@ class SpanMetricsProcessor:
         from tempo_tpu import sched as sched_mod
         sc = sched_mod.scheduler()
         return sc if sc is not None and sc.cfg.enabled else None
+
+    def _pipeline(self, sc):
+        """The staging pipeline riding scheduler `sc`, or None when the
+        decode/update overlap ring is off (no scheduler, or
+        sched.pipeline_depth == 0 — every push then allocates fresh
+        staging, the pre-pipeline behavior)."""
+        if sc is None:
+            return None
+        depth = getattr(sc.cfg, "pipeline_depth", 0)
+        if depth <= 0:
+            return None
+        if self._pipe is None or self._pipe.depth != depth:
+            from tempo_tpu.generator.pipeline import IngestPipeline
+            self._pipe = IngestPipeline(depth)
+        return self._pipe
+
+    def drain_pipeline(self, timeout_s: float = 30.0) -> None:
+        """Reap the staging ring behind the sched.flush() barrier (the
+        collection tick's drain-before-collect)."""
+        if self._pipe is not None:
+            self._pipe.drain(timeout_s)
 
     def _sched_dispatch(self, slots, dur_s, sizes, weights) -> None:
         """One merged-batch device step, on the scheduler worker: the
@@ -195,7 +219,7 @@ class SpanMetricsProcessor:
                 self.dd, packed)
 
     def _submit_rows(self, sc, slots: np.ndarray, dur_s: np.ndarray,
-                     sizes: np.ndarray, weights: np.ndarray) -> None:
+                     sizes: np.ndarray, weights: np.ndarray):
         arrays = (np.asarray(slots, np.float32 if
                              self.calls.table.capacity < (1 << 24)
                              else np.int32),
@@ -206,15 +230,14 @@ class SpanMetricsProcessor:
             # slot ids round-trip f32 exactly below 2^24: ride the packed
             # single-transfer dispatch (same gate as the direct packed
             # push path)
-            sc.submit_rows("spanmetrics_fused_update", self, arrays,
-                           len(slots), self._sched_dispatch_packed,
-                           pads=(-1.0, 0.0, 0.0, 0.0),
-                           tenant=self.registry.tenant, pack=True)
-        else:
-            sc.submit_rows("spanmetrics_fused_update", self, arrays,
-                           len(slots), self._sched_dispatch,
-                           pads=(-1, 0.0, 0.0, 0.0),
-                           tenant=self.registry.tenant)
+            return sc.submit_rows("spanmetrics_fused_update", self, arrays,
+                                  len(slots), self._sched_dispatch_packed,
+                                  pads=(-1.0, 0.0, 0.0, 0.0),
+                                  tenant=self.registry.tenant, pack=True)
+        return sc.submit_rows("spanmetrics_fused_update", self, arrays,
+                              len(slots), self._sched_dispatch,
+                              pads=(-1, 0.0, 0.0, 0.0),
+                              tenant=self.registry.tenant)
 
     def needs_attr_columns(self) -> tuple[bool, bool]:
         """(span_attrs, res_attrs) this processor reads — owned HERE so a
@@ -267,10 +290,15 @@ class SpanMetricsProcessor:
         cap = _pad_rows(max(n, 1))
         dims, klut, slut = self._staged_dims()
         now = self.registry.now()
+        sc = self._sched()
+        pipe = self._pipeline(sc)
+        bufs = pipe.acquire(cap, len(dims)) if pipe is not None else None
         got = native.spanmetrics_resolve(
             self.calls.table._nat, spans, dims, klut, slut,
-            slack_lo, slack_hi, now, self.calls.table.last_seen, cap)
-        return self._push_resolved(got, spans["trace_id"], n, now)
+            slack_lo, slack_hi, now, self.calls.table.last_seen, cap,
+            out=bufs)
+        return self._push_resolved(got, spans["trace_id"], n, now,
+                                   sc=sc, pipe=pipe, bufs=bufs)
 
     def push_from_recs(self, raw: bytes, recs: np.ndarray, slack_lo: int,
                        slack_hi: int) -> "tuple[int, int] | None":
@@ -288,30 +316,51 @@ class SpanMetricsProcessor:
         cap = _pad_rows(max(n, 1))
         dims, klut, slut = self._staged_dims()
         now = self.registry.now()
+        sc = self._sched()
+        pipe = self._pipeline(sc)
+        bufs = pipe.acquire(cap, len(dims)) if pipe is not None else None
         got = native.spanmetrics_from_recs(
             self.calls.table._nat, nat_it._h, raw, recs, dims, klut, slut,
-            slack_lo, slack_hi, now, self.calls.table.last_seen, cap)
+            slack_lo, slack_hi, now, self.calls.table.last_seen, cap,
+            out=bufs)
         if got is None:
+            if pipe is not None:
+                pipe.release(bufs)   # fixup bail: full path re-stages
             return None
-        return self._push_resolved(got, recs["trace_id"], n, now)
+        return self._push_resolved(got, recs["trace_id"], n, now,
+                                   sc=sc, pipe=pipe, bufs=bufs)
 
-    def _push_resolved(self, got, trace_ids, n: int,
-                       now: float) -> tuple[int, int]:
+    def _push_resolved(self, got, trace_ids, n: int, now: float,
+                       sc=None, pipe=None, bufs=None) -> tuple[int, int]:
         slots, packed, rows, valid, miss, n_valid, n_filtered = got
         if miss.size:
             self.calls.table.apply_misses(rows, slots, miss, valid, now)
-        sc = self._sched()
+        if sc is None:
+            sc = self._sched()
         if sc is not None:
             # scheduler route: trim to the real rows (filtered rows carry
             # slot -1 and drop on device; the coalescer re-pads the merged
             # batch to its pow-2 bucket) and enqueue for the next batch
-            # window — the dispatch itself runs on the worker thread.
+            # window — the dispatch itself runs on the worker thread. The
+            # pipeline (when on) adopts the job so the staging buffers
+            # recycle the moment its dispatch lands.
+            job = None
             if n:
-                self._submit_rows(sc, slots[:n], packed[1][:n],
-                                  packed[2][:n], np.ones(n, np.float32))
+                job = self._submit_rows(sc, slots[:n], packed[1][:n],
+                                        packed[2][:n],
+                                        np.ones(n, np.float32))
+            # exemplars read slots/packed BEFORE the buffers are handed
+            # to the pipeline ring: track() makes them reclaimable the
+            # moment the job lands (inline on the shed path), and a
+            # concurrent push's acquire() could overwrite them mid-read
             self.calls.note_exemplars(slots[:n], trace_ids, packed[1],
                                       int(now * 1000))
             self.latency.exemplars = self.calls.exemplars
+            if pipe is not None:
+                if job is not None:
+                    pipe.track(job, bufs)
+                else:
+                    pipe.release(bufs)
             return n_valid, n_filtered
         cap = len(slots)
         ones = self._ones_cache.get(cap)

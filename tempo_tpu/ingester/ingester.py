@@ -148,6 +148,23 @@ class Ingester:
                 out[tid.hex()] = reason
         return out
 
+    def push_staged(self, tenant: str, view) -> dict[str, str]:
+        """Staged-view push (the decode-once distributor tee): this
+        replica's traces arrive as a row-index slice over the shared
+        columnar staging (`model.otlp_batch.StagedView`) — live-trace
+        groups come straight off the trace-id column and span dicts
+        convert from the staged columns, with events/links restored from
+        the staging's one lazy payload pass. No per-replica protobuf
+        re-decode. Same return contract as `push_otlp`:
+        {trace_id_hex: reason} for rejected traces only."""
+        inst = self.instance(tenant)
+        out: dict[str, str] = {}
+        for tid, rows in view.trace_groups():
+            reason = inst.push_trace(tid, view.to_span_dicts(rows))
+            if reason:
+                out[tid.hex()] = reason
+        return out
+
     # -- cut/flush machinery ----------------------------------------------
 
     def sweep_instance(self, tenant: str, immediate: bool = False) -> None:

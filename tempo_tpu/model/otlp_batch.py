@@ -33,6 +33,41 @@ _MAX_SPAN_ATTRS = 64
 _MAX_RES_ATTRS = 32
 
 
+def _staged_service_ids(data: bytes, interner: StringInterner,
+                        rattrs, res) -> np.ndarray:
+    """Per-resource service.name intern ids with the Python fixup applied.
+
+    Dict semantics are last-occurrence-wins regardless of value type (C++
+    recorded the last STRING occurrence only); the fixup runs over the
+    per-RESOURCE attr rows (tiny). Shared by full SpanBatch staging and
+    the decode-once tee's usage attribution."""
+    svc = res["service_id"].astype(np.int32)
+    svc_key = interner.get("service.name")
+    svc_hits = np.flatnonzero(rattrs["key_id"] == svc_key)
+    if svc_hits.size and (rattrs["typ"][svc_hits] != 1).any():
+        from tempo_tpu.model.otlp import _pb_anyvalue
+
+        last: dict[int, int] = {}
+        for idx in svc_hits.tolist():
+            last[int(rattrs["owner"][idx])] = idx
+        for o, idx in last.items():
+            t = int(rattrs["typ"][idx])
+            if t == 1:
+                v = interner.lookup(int(rattrs["sval_id"][idx]))
+            elif t == 2:
+                v = str(bool(rattrs["fval"][idx]))
+            elif t == 3:
+                v = str(int(rattrs["ival"][idx]))
+            elif t == 4:
+                v = str(float(rattrs["fval"][idx]))
+            else:   # non-scalar: stringify from its raw range
+                so = int(rattrs["sval_off"][idx])
+                sl = int(rattrs["sval_len"][idx])
+                v = str(_pb_anyvalue(data[so:so + sl]))
+            svc[o] = interner.intern(v)
+    return svc
+
+
 def batch_from_otlp(data: bytes, interner: StringInterner,
                     return_sizes: bool = False,
                     include_span_attrs: bool = True,
@@ -156,32 +191,7 @@ def _batch_from_staged(data: bytes, interner: StringInterner, staged,
     # -- resources ---------------------------------------------------------
     nres = len(res)
     if nres and n:
-        svc = res["service_id"].astype(np.int32)
-        # service.name: dict semantics are last-occurrence-wins regardless
-        # of value type (C++ recorded the last STRING occurrence only).
-        # This fixup runs over the per-RESOURCE attr rows (tiny) and so is
-        # independent of include_res_attrs.
-        svc_key = interner.get("service.name")
-        svc_hits = np.flatnonzero(rattrs["key_id"] == svc_key)
-        if svc_hits.size and (rattrs["typ"][svc_hits] != 1).any():
-            last: dict[int, int] = {}
-            for idx in svc_hits.tolist():
-                last[int(rattrs["owner"][idx])] = idx
-            for o, idx in last.items():
-                t = int(rattrs["typ"][idx])
-                if t == 1:
-                    v = interner.lookup(int(rattrs["sval_id"][idx]))
-                elif t == 2:
-                    v = str(bool(rattrs["fval"][idx]))
-                elif t == 3:
-                    v = str(int(rattrs["ival"][idx]))
-                elif t == 4:
-                    v = str(float(rattrs["fval"][idx]))
-                else:   # non-scalar: stringify from its raw range
-                    so = int(rattrs["sval_off"][idx])
-                    sl = int(rattrs["sval_len"][idx])
-                    v = str(_pb_anyvalue(data[so:so + sl]))
-                svc[o] = interner.intern(v)
+        svc = _staged_service_ids(data, interner, rattrs, res)
         res_idx = spans["res_idx"].astype(np.int64)
         service_id[:n] = svc[res_idx]
         if include_res_attrs:
@@ -255,3 +265,245 @@ def _batch_from_staged(data: bytes, interner: StringInterner, staged,
             sizes[:n] = spans["span_len"]
         return sb, sizes
     return sb
+
+
+# ---------------------------------------------------------------------------
+# decode-once staging: one OTLP payload, shared by every tee target
+# ---------------------------------------------------------------------------
+
+
+def stage_otlp(data: bytes, interner: StringInterner, *,
+               trusted: bool = False, include_span_attrs: bool = True,
+               include_res_attrs: bool = True) -> "StagedIngest | None":
+    """OTLP wire bytes → a `StagedIngest`: ONE C++ staging pass whose
+    product every ring target shares through row-index views.
+
+    None when the native staging kernel is unavailable (callers keep
+    their byte-slice / span-dict compatibility route). Raises ValueError
+    on a malformed payload — the staging pass IS the validation pass."""
+    from tempo_tpu import native
+
+    nat = interner.native_handle() if hasattr(interner, "native_handle") \
+        else None
+    if nat is None:
+        return None
+    staged = native.otlp_stage(nat, data,
+                               skip_span_attrs=not include_span_attrs,
+                               trust_attrs=trusted)
+    if staged is None:
+        return None
+    interner.sync()
+    return StagedIngest(data, interner, staged,
+                        has_span_attrs=include_span_attrs,
+                        include_res_attrs=include_res_attrs)
+
+
+class StagedIngest:
+    """The decode-once product of one OTLP payload.
+
+    Holds the C++-staged record arrays (fixed columns + intern ids), the
+    interner they were staged against, and the raw payload; materializes
+    the columnar SpanBatch LAZILY (a dedicated-spanmetrics generator
+    consumes the StageRec rows directly and never pays the numpy
+    padding/scatter). `view(rows)` hands out per-target row-index slices
+    over the shared arrays — the distributor's tee unit: no
+    re-serialization, no second staging pass, no per-target decode."""
+
+    __slots__ = ("raw", "interner", "spans", "sattrs", "rattrs", "res",
+                 "has_span_attrs", "include_res_attrs", "_batch", "_sizes",
+                 "_events", "_fixup", "_svc_ids")
+
+    def __init__(self, raw: bytes, interner: StringInterner, staged,
+                 has_span_attrs: bool = True,
+                 include_res_attrs: bool = True) -> None:
+        self.raw = raw
+        self.interner = interner
+        self.spans, self.sattrs, self.rattrs, self.res = staged
+        self.has_span_attrs = has_span_attrs
+        self.include_res_attrs = include_res_attrs
+        self._batch = None
+        self._sizes = None
+        self._events = None
+        self._fixup: "bool | None" = None
+        self._svc_ids: "np.ndarray | None" = None
+
+    @property
+    def n(self) -> int:
+        return len(self.spans)
+
+    @property
+    def needs_service_fixup(self) -> bool:
+        """True when some resource carries a non-string service.name (the
+        staged service_id column then needs the Python stringify fixup —
+        the StageRec fast consumers bail to the SpanBatch route, where
+        `_staged_service_ids` applies it)."""
+        if self._fixup is None:
+            svc_key = self.interner.get("service.name")
+            hits = self.rattrs["key_id"] == svc_key
+            self._fixup = bool(hits.any()
+                               and (self.rattrs["typ"][hits] != 1).any())
+        return self._fixup
+
+    def service_ids(self) -> np.ndarray:
+        """Per-RESOURCE service.name intern ids, fixup applied (usage
+        attribution reads these without materializing the batch)."""
+        if self._svc_ids is None:
+            self._svc_ids = _staged_service_ids(
+                self.raw, self.interner, self.rattrs, self.res)
+        return self._svc_ids
+
+    def batch(self) -> tuple["SpanBatch", np.ndarray]:
+        """The staged columnar SpanBatch + per-span wire sizes, built on
+        first use and shared by every subsequent view."""
+        if self._batch is None:
+            self._batch, self._sizes = _batch_from_staged(
+                self.raw, self.interner,
+                (self.spans, self.sattrs, self.rattrs, self.res),
+                return_sizes=True,
+                include_span_attrs=self.has_span_attrs,
+                include_res_attrs=self.include_res_attrs)
+        return self._batch, self._sizes
+
+    def events_links(self) -> tuple[dict, dict]:
+        """{span_idx: [event dicts]}, {span_idx: [link dicts]} — one lazy
+        native pass over the payload; events/links are persistence-only
+        fields (the metrics plane never columnizes them)."""
+        if self._events is None:
+            from tempo_tpu import native
+
+            ev_by: dict[int, list] = {}
+            ln_by: dict[int, list] = {}
+            got = native.otlp_events(self.raw)
+            if got is not None:
+                evs, links = got
+                raw = self.raw
+                for rec in evs:
+                    off, ln = int(rec["name_off"]), int(rec["name_len"])
+                    ev_by.setdefault(int(rec["span_idx"]), []).append({
+                        "time_unix_nano": int(rec["time_ns"]),
+                        "name": raw[off:off + ln].decode("utf-8", "replace"),
+                    })
+                for rec in links:
+                    ln_by.setdefault(int(rec["span_idx"]), []).append({
+                        "trace_id": bytes(rec["trace_id"])[
+                            :int(rec["tid_len"])],
+                        "span_id": bytes(rec["span_id"])[
+                            :int(rec["sid_len"])],
+                    })
+            self._events = (ev_by, ln_by)
+        return self._events
+
+    def view(self, rows: "np.ndarray | None" = None) -> "StagedView":
+        """A row-index slice over this staging (None = every row)."""
+        return StagedView(self, rows)
+
+
+class StagedView:
+    """One tee target's slice of a `StagedIngest`: row indices over the
+    shared staged arrays. The full-coverage view (the common single-target
+    ring case) is genuinely zero-copy — consumers receive the shared
+    arrays themselves."""
+
+    __slots__ = ("staged", "rows")
+
+    def __init__(self, staged: StagedIngest,
+                 rows: "np.ndarray | None" = None) -> None:
+        self.staged = staged
+        self.rows = None if rows is None else np.asarray(rows, np.int64)
+
+    @property
+    def n(self) -> int:
+        return self.staged.n if self.rows is None else int(len(self.rows))
+
+    @property
+    def is_full(self) -> bool:
+        return self.rows is None or len(self.rows) == self.staged.n
+
+    def row_indices(self) -> np.ndarray:
+        if self.rows is None:
+            return np.arange(self.staged.n, dtype=np.int64)
+        return self.rows
+
+    def stage_rows(self) -> np.ndarray:
+        """This view's StageRec rows — the SHARED array when full (zero
+        copy), an 88B/row gather otherwise."""
+        if self.is_full:
+            return self.staged.spans
+        return self.staged.spans[self.rows]
+
+    def batch_slice(self) -> tuple["SpanBatch", np.ndarray]:
+        """(SpanBatch, sizes) for this view's rows — the shared staged
+        batch when full, a column gather (`SpanBatch.take_rows`)
+        otherwise. Never re-decodes wire bytes."""
+        sb, sizes = self.staged.batch()
+        if self.is_full:
+            return sb, sizes
+        out = sb.take_rows(self.rows)
+        out_sizes = np.zeros(out.capacity, np.float32)
+        out_sizes[:len(self.rows)] = sizes[self.rows]
+        return out, out_sizes
+
+    def trace_groups(self) -> list[tuple[bytes, list[int]]]:
+        """(exact trace-id bytes, row indices) in first-seen order — the
+        ingester's live-trace grouping straight off the columns."""
+        spans = self.staged.spans
+        rows = self.row_indices()
+        tids = spans["trace_id"]
+        tls = spans["tid_len"]
+        groups: dict[bytes, list[int]] = {}
+        for i in rows.tolist():
+            tid = bytes(tids[i])[:int(tls[i])]
+            groups.setdefault(tid, []).append(i)
+        return list(groups.items())
+
+    def to_span_dicts(self, rows: "np.ndarray | list[int] | None" = None
+                      ) -> list[dict]:
+        """Wire-parity span dicts for this view's rows (or a sub-slice):
+        the shape `spans_from_otlp_proto` yields, with exact id byte
+        lengths restored from the staged records and events/links merged
+        from the lazy payload pass."""
+        st = self.staged
+        if not st.has_span_attrs:
+            raise ValueError(
+                "staged without span attrs: dict conversion would drop "
+                "attributes (stage with include_span_attrs=True)")
+        sb, _ = st.batch()
+        spans = st.spans
+        ev_by, ln_by = st.events_links()
+        it = st.interner
+        out = []
+        idx = self.row_indices() if rows is None else np.asarray(rows)
+        k_has = sb.span_attr_key.shape[1] > 0
+        r_has = sb.res_attr_key.shape[1] > 0
+        for i in idx.tolist():
+            rec = spans[i]
+            sm = int(sb.status_message_id[i])
+            s: dict = {
+                "trace_id": bytes(rec["trace_id"])[:int(rec["tid_len"])],
+                "span_id": bytes(rec["span_id"])[:int(rec["sid_len"])],
+                "parent_span_id":
+                    bytes(rec["parent_span_id"])[:int(rec["pid_len"])],
+                "name": it.lookup(int(sb.name_id[i]))
+                    if int(sb.name_id[i]) != INVALID_ID else "",
+                "service": it.lookup(int(sb.service_id[i]))
+                    if int(sb.service_id[i]) != INVALID_ID else "",
+                "kind": int(sb.kind[i]),
+                "status_code": int(sb.status_code[i]),
+                "status_message": it.lookup(sm) if sm != INVALID_ID else "",
+                "start_unix_nano": int(sb.start_unix_nano[i]),
+                "end_unix_nano": int(sb.end_unix_nano[i]),
+                "attrs": sb._decode_attrs(
+                    sb.span_attr_key[i], sb.span_attr_sval[i],
+                    sb.span_attr_fval[i], sb.span_attr_typ[i])
+                    if k_has else {},
+                "res_attrs": sb._decode_attrs(
+                    sb.res_attr_key[i], sb.res_attr_sval[i],
+                    sb.res_attr_fval[i], sb.res_attr_typ[i])
+                    if r_has else {},
+            }
+            if i in ev_by:
+                s["events"] = ev_by[i]
+            if i in ln_by:
+                s["links"] = ln_by[i]
+            out.append(s)
+        return out

@@ -142,17 +142,69 @@ class SpanBatch:
         out[has] = svals[np.arange(self.capacity), idx][has]
         return out
 
-    def to_span_dicts(self) -> list[dict]:
+    def take_rows(self, rows: np.ndarray) -> "SpanBatch":
+        """Row-gathered copy of `rows` (indices into [0, n)), re-padded to
+        the bucket table. The materialization step of a tee VIEW: every
+        column gathers from the shared arrays — no wire re-decode, no
+        re-serialization. Full-coverage callers should skip this entirely
+        and use the shared batch (see `otlp_batch.StagedView`)."""
+        rows = np.asarray(rows, np.int64)
+        n = len(rows)
+        cap = _pad_rows(max(n, 1))
+        pad = cap - n
+
+        def g1(a, fill=0):
+            out = np.full(cap, fill, a.dtype) if pad else np.empty(cap, a.dtype)
+            out[:n] = a[rows]
+            return out
+
+        def g2(a, fill=0):
+            out = (np.full((cap, a.shape[1]), fill, a.dtype) if pad
+                   else np.empty((cap, a.shape[1]), a.dtype))
+            out[:n] = a[rows]
+            return out
+
+        valid = np.zeros(cap, bool)
+        valid[:n] = self.valid[rows]
+        return SpanBatch(
+            n=n,
+            trace_id=g2(self.trace_id), span_id=g2(self.span_id),
+            parent_span_id=g2(self.parent_span_id),
+            name_id=g1(self.name_id, INVALID_ID),
+            service_id=g1(self.service_id, INVALID_ID),
+            kind=g1(self.kind), status_code=g1(self.status_code),
+            status_message_id=g1(self.status_message_id, INVALID_ID),
+            start_unix_nano=g1(self.start_unix_nano),
+            end_unix_nano=g1(self.end_unix_nano),
+            span_attr_key=g2(self.span_attr_key, INVALID_ID),
+            span_attr_sval=g2(self.span_attr_sval, INVALID_ID),
+            span_attr_fval=g2(self.span_attr_fval),
+            span_attr_typ=g2(self.span_attr_typ),
+            res_attr_key=g2(self.res_attr_key, INVALID_ID),
+            res_attr_sval=g2(self.res_attr_sval, INVALID_ID),
+            res_attr_fval=g2(self.res_attr_fval),
+            res_attr_typ=g2(self.res_attr_typ),
+            valid=valid, interner=self.interner,
+        )
+
+    def to_span_dicts(self, rows: "np.ndarray | None" = None) -> list[dict]:
         """Valid rows as flat span dicts (the WAL/storage span form).
 
         The bridge from the device-friendly SoA back to durable storage —
         used by the localblocks processor, whose job is persistence
-        (`modules/generator/processor/localblocks/processor.go:151`)."""
+        (`modules/generator/processor/localblocks/processor.go:151`) and
+        by the ingester's staged-view push. `rows` restricts the
+        conversion to a view's row subset (order preserved)."""
         it = self.interner
         out = []
         k_has = self.span_attr_key.shape[1] > 0
         r_has = self.res_attr_key.shape[1] > 0
-        for i in np.flatnonzero(self.valid[: self.n]):
+        if rows is None:
+            rows = np.flatnonzero(self.valid[: self.n])
+        else:
+            rows = np.asarray(rows, np.int64)
+            rows = rows[self.valid[rows]]
+        for i in rows:
             s: dict = {
                 "trace_id": self.trace_id[i].tobytes(),
                 "span_id": self.span_id[i].tobytes(),

@@ -267,6 +267,10 @@ def _load():
             lib.group_keys_recs.argtypes = [
                 c.c_void_p, c.c_int64, u8p, i32p, i32p]
             lib.group_keys_recs.restype = c.c_int64
+            lib.group_keys_strided.argtypes = [
+                c.c_void_p, c.c_int64, c.c_int64, c.c_int64, c.c_int64,
+                u8p, i32p, i32p]
+            lib.group_keys_strided.restype = c.c_int64
             _LIB = lib
         except Exception:
             _LIB = None
@@ -728,11 +732,53 @@ def spans_from_otlp_proto_native(data: bytes, return_recs: bool = False):
     return (out, recs) if return_recs else out
 
 
+class ResolveBuffers:
+    """One pre-allocated staging-buffer set for the fused spanmetrics
+    resolve: the arrays the C++ pass fills and the (async) device
+    dispatch later reads. The ingest pipeline recycles these once the
+    dispatch that reads them has landed — steady state allocates zero
+    new staging memory per push."""
+
+    __slots__ = ("cap", "n_labels", "slots", "packed", "rows", "valid",
+                 "miss", "counts")
+
+    def __init__(self, cap: int, n_labels: int) -> None:
+        self.cap = cap
+        self.n_labels = n_labels
+        self.slots = np.full(cap, -1, np.int32)
+        self.packed = np.zeros((3, cap), np.float32)
+        self.rows = np.empty((max(cap, 1), n_labels), np.int32)
+        self.valid = np.zeros(cap, np.uint8)
+        self.miss = np.empty(max(cap, 1), np.int64)
+        self.counts = np.zeros(2, np.int64)
+
+    def reset(self) -> None:
+        """Restore the fill values a fresh allocation would carry (the
+        previous push's rows beyond the new n must read as padding)."""
+        self.slots.fill(-1)
+        self.packed.fill(0.0)
+        self.valid.fill(0)
+
+
+def _resolve_arrays(cap: int, n_labels: int, n: int,
+                    out: "ResolveBuffers | None"):
+    """(slots, packed, rows, valid, miss, counts) — from the reusable
+    buffer set when one of the right shape is offered, else fresh."""
+    if out is not None and out.cap == cap and out.n_labels == n_labels:
+        out.reset()
+        return (out.slots, out.packed, out.rows[:max(n, 1)], out.valid,
+                out.miss, out.counts)
+    return (np.full(cap, -1, np.int32), np.zeros((3, cap), np.float32),
+            np.empty((max(n, 1), n_labels), np.int32),
+            np.zeros(cap, np.uint8), np.empty(max(n, 1), np.int64),
+            np.zeros(2, np.int64))
+
+
 def spanmetrics_resolve(table: "NativeRowTable", spans: np.ndarray,
                         dims: np.ndarray, kind_lut: np.ndarray,
                         status_lut: np.ndarray, slack_lo: int, slack_hi: int,
                         now: float, last_seen: "np.ndarray | None",
-                        cap: int):
+                        cap: int, out: "ResolveBuffers | None" = None):
     """Fused staged-records → device-ready arrays (see native.cpp
     `spanmetrics_resolve`). Returns (slots, packed, rows, valid, miss_idx,
     n_valid, n_filtered): `packed` is the [3, cap] f32 single-H2D buffer
@@ -750,17 +796,13 @@ def spanmetrics_resolve(table: "NativeRowTable", spans: np.ndarray,
     dims = np.ascontiguousarray(dims, np.int32)
     kind_lut = np.ascontiguousarray(kind_lut, np.int32)
     status_lut = np.ascontiguousarray(status_lut, np.int32)
-    slots = np.full(cap, -1, np.int32)
     # dur/sizes are rows 1/2 of ONE packed [3, cap] f32 buffer: the fast
     # paths upload slots+dur+sizes as a single H2D transfer (row 0 takes
     # the f32 slot copy after miss resolution)
-    packed = np.zeros((3, cap), np.float32)
+    slots, packed, rows, valid, miss, counts = _resolve_arrays(
+        cap, int(dims.shape[0]), n, out)
     dur = packed[1]
     sizes = packed[2]
-    rows = np.empty((max(n, 1), int(dims.shape[0])), np.int32)
-    valid = np.zeros(cap, np.uint8)
-    miss = np.empty(max(n, 1), np.int64)
-    counts = np.zeros(2, np.int64)
     i32 = ctypes.POINTER(ctypes.c_int32)
     lsp = None
     if last_seen is not None:
@@ -784,7 +826,8 @@ def spanmetrics_from_recs(table: "NativeRowTable", interner_h, data: bytes,
                           recs: np.ndarray, dims: np.ndarray,
                           kind_lut: np.ndarray, status_lut: np.ndarray,
                           slack_lo: int, slack_hi: int, now: float,
-                          last_seen: "np.ndarray | None", cap: int):
+                          last_seen: "np.ndarray | None", cap: int,
+                          out: "ResolveBuffers | None" = None):
     """Distributor scan records → device-ready spanmetrics arrays (see
     native.cpp `spanmetrics_from_recs`): the tee path skips the second
     protobuf walk entirely. Same return shape as `spanmetrics_resolve`;
@@ -801,17 +844,13 @@ def spanmetrics_from_recs(table: "NativeRowTable", interner_h, data: bytes,
     dims = np.ascontiguousarray(dims, np.int32)
     kind_lut = np.ascontiguousarray(kind_lut, np.int32)
     status_lut = np.ascontiguousarray(status_lut, np.int32)
-    slots = np.full(cap, -1, np.int32)
     # dur/sizes are rows 1/2 of ONE packed [3, cap] f32 buffer: the fast
     # paths upload slots+dur+sizes as a single H2D transfer (row 0 takes
     # the f32 slot copy after miss resolution)
-    packed = np.zeros((3, cap), np.float32)
+    slots, packed, rows, valid, miss, counts = _resolve_arrays(
+        cap, int(dims.shape[0]), n, out)
     dur = packed[1]
     sizes = packed[2]
-    rows = np.empty((max(n, 1), int(dims.shape[0])), np.int32)
-    valid = np.zeros(cap, np.uint8)
-    miss = np.empty(max(n, 1), np.int64)
-    counts = np.zeros(2, np.int64)
     i32 = ctypes.POINTER(ctypes.c_int32)
     lsp = None
     if last_seen is not None:
@@ -857,4 +896,33 @@ def group_keys_recs(recs: np.ndarray, valid: "np.ndarray | None"
     ng = lib.group_keys_recs(recs.ctypes.data, n, vp,
                              inverse.ctypes.data_as(i32),
                              first.ctypes.data_as(i32))
+    return first[:ng], inverse[:nv]
+
+
+def group_keys_strided(recs: np.ndarray, valid: "np.ndarray | None"
+                       ) -> "tuple[np.ndarray, np.ndarray] | None":
+    """`group_keys_recs` over ANY structured dtype carrying `trace_id`
+    ([16] u8) and `tid_len` (i32) fields — the staged tee groups StageRec
+    rows with this, no key-matrix materialization. None without the
+    native library (caller builds keys and uses group_keys)."""
+    lib = _load()
+    if lib is None:
+        return None
+    recs = np.ascontiguousarray(recs)
+    fields = recs.dtype.fields
+    tid_off = int(fields["trace_id"][1])
+    tidlen_off = int(fields["tid_len"][1])
+    n = len(recs)
+    nv = n if valid is None else int(valid.sum())
+    inverse = np.empty(max(nv, 1), np.int32)
+    first = np.empty(max(nv, 1), np.int32)
+    vp = None
+    if valid is not None:
+        vbuf = np.ascontiguousarray(valid, np.uint8)
+        vp = vbuf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    i32 = ctypes.POINTER(ctypes.c_int32)
+    ng = lib.group_keys_strided(recs.ctypes.data, n,
+                                recs.dtype.itemsize, tid_off, tidlen_off,
+                                vp, inverse.ctypes.data_as(i32),
+                                first.ctypes.data_as(i32))
     return first[:ng], inverse[:nv]

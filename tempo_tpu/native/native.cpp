@@ -1615,4 +1615,56 @@ int64_t group_keys_recs(const void* recs_p, int64_t n, const uint8_t* valid,
     return n_groups;
 }
 
+// group_keys_recs over an ARBITRARY record layout: trace_id[16] at
+// tid_off, int32 tid_len at tidlen_off, rec_size bytes per row. The
+// decode-once staged tee groups StageRec rows with this (StageRec and
+// SpanRec share field names but not offsets); semantics identical to
+// group_keys_recs.
+int64_t group_keys_strided(const void* recs_p, int64_t n, int64_t rec_size,
+                           int64_t tid_off, int64_t tidlen_off,
+                           const uint8_t* valid,
+                           int32_t* inverse, int32_t* first_idx) {
+    const uint8_t* base = (const uint8_t*)recs_p;
+    if (n <= 0) return 0;
+    uint64_t cap = 64;
+    while (cap < (uint64_t)n * 2) cap <<= 1;
+    std::vector<int32_t> table(cap, -1);
+    std::vector<int64_t> grec;                     // group -> rec row
+    uint64_t mask = cap - 1;
+    int64_t n_groups = 0, vi = 0;
+    uint8_t key[17];
+    for (int64_t r = 0; r < n; r++) {
+        if (valid && !valid[r]) continue;
+        const uint8_t* rec = base + r * rec_size;
+        const uint8_t* tid = rec + tid_off;
+        int32_t tl;
+        memcpy(&tl, rec + tidlen_off, 4);
+        memcpy(key, tid, 16);
+        key[16] = (uint8_t)tl;
+        uint64_t h = fnv1a64(key, 17);
+        uint64_t i = h & mask;
+        while (true) {
+            int32_t g = table[i];
+            if (g == -1) {
+                table[i] = (int32_t)n_groups;
+                first_idx[n_groups] = (int32_t)vi;
+                grec.push_back(r);
+                inverse[vi] = (int32_t)n_groups;
+                n_groups++;
+                break;
+            }
+            const uint8_t* fr = base + grec[g] * rec_size;
+            int32_t ftl;
+            memcpy(&ftl, fr + tidlen_off, 4);
+            if (memcmp(fr + tid_off, tid, 16) == 0 && ftl == tl) {
+                inverse[vi] = g;
+                break;
+            }
+            i = (i + 1) & mask;
+        }
+        vi++;
+    }
+    return n_groups;
+}
+
 }  // extern "C"

@@ -97,6 +97,11 @@ class SchedConfig:
     max_batch_rows: int = 16384          # coalesced rows per dispatch
     min_bucket_rows: int = 64            # smallest pow-2 shape bucket
     retry_after_s: float = 1.0           # advertised on 429/503 rejections
+    # ingest staging pipeline depth: how many decoded-but-undispatched
+    # batches a producer may run AHEAD of the device (the staging-buffer
+    # ring is depth+1 deep). 0 disables the decode/update overlap ring —
+    # submissions still coalesce, but every push allocates fresh staging.
+    pipeline_depth: int = 2
 
 
 def bucket_rows(n: int, lo: int = 64, hi: int | None = None) -> int:

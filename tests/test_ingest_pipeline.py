@@ -1,0 +1,460 @@
+"""Decode-once columnar ingest: staged distributor tee + double-buffered
+host/device staging pipeline (+ the round-5 satellite regressions)."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from tempo_tpu import native, sched
+from tempo_tpu.distributor import Distributor
+from tempo_tpu.generator.generator import Generator
+from tempo_tpu.generator.instance import GeneratorConfig
+from tempo_tpu.model.otlp import encode_spans_otlp, spans_from_otlp_proto
+from tempo_tpu.overrides import Overrides
+from tempo_tpu.ring import ACTIVE, InstanceDesc, Ring
+from tempo_tpu.ring.ring import _instance_tokens
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native staging kernel required")
+
+
+def mkspan(tid: bytes, sid: bytes, name="op", svc="svc", t0=None,
+           dur=1_000_000, **kw):
+    t0 = t0 if t0 is not None else int(time.time() * 1e9)
+    return {"trace_id": tid, "span_id": sid, "name": name, "service": svc,
+            "start_unix_nano": t0, "end_unix_nano": t0 + dur, **kw}
+
+
+def make_payload(n: int, t0: int | None = None) -> tuple[bytes, list[dict]]:
+    t0 = t0 if t0 is not None else int(time.time() * 1e9)
+    src = []
+    for i in range(n):
+        src.append(mkspan((b"%04d" % i).ljust(16, b"\0"), bytes([i % 251 + 1]) * 8,
+                          name=f"op-{i % 5}", t0=t0 + i * 1000,
+                          dur=1_000_000 + i * 10_000,
+                          attrs={"http.status_code": 200 + (i % 100),
+                                 "http.method": "GET"},
+                          res_attrs={"service.name": f"svc-{i % 3}"}))
+    return encode_spans_otlp(src), src
+
+
+def _ring_of(ids, now):
+    r = Ring(replication_factor=1, now=now)
+    for iid in ids:
+        r.register(InstanceDesc(id=iid, state=ACTIVE,
+                                tokens=_instance_tokens(iid, 64),
+                                heartbeat_ts=now()))
+    return r
+
+
+class _NullStagedIng:
+    """Staged-capable null ingester (the bench's tee sink): consumes the
+    view without needing the attr columns."""
+
+    staged_needs_attrs = False
+
+    def __init__(self):
+        self.rows = 0
+
+    def push(self, tenant, traces):
+        return [None] * len(traces)
+
+    def push_otlp(self, tenant, payload):
+        return {}
+
+    def push_staged(self, tenant, view):
+        self.rows += view.n
+        return {}
+
+
+def _tee_rig(gen_clients, ov=None):
+    now = time.time
+    ing = _NullStagedIng()
+    dist = Distributor(_ring_of(["i0"], now), {"i0": ing},
+                       overrides=ov or Overrides(),
+                       generator_ring=_ring_of(list(gen_clients), now),
+                       generator_clients=gen_clients, now=now)
+    return dist, ing
+
+
+def _gen(processors=("span-metrics",)):
+    cfg = GeneratorConfig(processors=processors)
+    cfg.registry.disable_collection = True
+    return Generator(cfg, overrides=Overrides())
+
+
+def _state_of(gen, tenant="t1"):
+    import jax
+    proc = gen.instance(tenant).processors["span-metrics"]
+    sched.flush()
+    if hasattr(proc, "drain_pipeline"):
+        proc.drain_pipeline()
+    jax.block_until_ready(proc.calls.state.values)
+    calls = np.asarray(proc.calls.state.values)
+    lat = np.asarray(proc.latency.state.bucket_counts)
+    dd = np.asarray(proc.dd.counts) if proc.dd is not None else None
+    # label-keyed so intern-id assignment order cannot mask divergence
+    by_label = {proc.calls.labels_of(int(s)): float(calls[int(s)])
+                for s in proc.calls.table.active_slots()}
+    return by_label, calls, lat, dd
+
+
+# -- tentpole: staged tee --------------------------------------------------
+
+
+def test_staged_plan_engages_for_staged_capable_targets():
+    gen = _gen()
+    ov = Overrides()
+    ov.set_tenant_patch("t1", {"generator": {"processors": ["span-metrics"]}})
+    dist, _ = _tee_rig({"g0": gen}, ov)
+    plan = dist._staging_plan("t1", ov.for_tenant("t1"))
+    assert plan is not None
+    interner, _ns, _nr = plan
+    assert interner is gen.instance("t1").registry.interner
+    # a generator client without the staged surface disables the plan
+    class Legacy:
+        def push_otlp(self, tenant, data):
+            return 0
+    dist2, _ = _tee_rig({"g0": Legacy()}, ov)
+    assert dist2._staging_plan("t1", ov.for_tenant("t1")) is None
+
+
+def test_tee_path_vs_dict_path_registry_bitident():
+    """The SAME spans through (a) the staged distributor tee and (b) the
+    per-span-dict push_spans compatibility route must land bit-identical
+    calls/latency/sketch registry state."""
+    raw, src = make_payload(64)
+    ov = Overrides()
+    ov.set_tenant_patch("t1", {"generator": {"processors": ["span-metrics"]}})
+
+    gen_a = _gen()
+    dist, ing = _tee_rig({"g0": gen_a}, ov)
+    assert dist._staging_plan("t1", ov.for_tenant("t1")) is not None
+    errs = dist.push_otlp("t1", raw)
+    assert errs == {}
+    assert ing.rows == 64            # the ingester leg consumed the view
+
+    gen_b = _gen()
+    gen_b.push_spans("t1", list(spans_from_otlp_proto(raw)))
+
+    la, calls_a, lat_a, dd_a = _state_of(gen_a)
+    lb, calls_b, lat_b, dd_b = _state_of(gen_b)
+    assert la == lb
+    assert np.array_equal(calls_a, calls_b)
+    assert np.array_equal(lat_a, lat_b)
+    assert np.array_equal(dd_a, dd_b)
+
+
+def test_staged_tee_ingester_dict_parity_with_events_links():
+    """Ingester content through the staged view must match the dict path
+    byte for byte — exact id lengths, attrs, events, links."""
+    import tempfile
+
+    from tempo_tpu.ingester import Ingester
+
+    now = time.time
+    raw, src = make_payload(12)
+    src[3]["events"] = [{"time_unix_nano": 777, "name": "exception"}]
+    src[5]["links"] = [{"trace_id": b"\x09" * 16, "span_id": b"\x08" * 8}]
+    src.append(mkspan(b"\x07" * 7, b"\x06" * 8, name="short-id"))
+    raw = encode_spans_otlp(src)
+
+    ov = Overrides()
+    ov.set_tenant_patch("t1", {"generator": {"processors": ["span-metrics"]}})
+    gen = _gen()
+    ing = Ingester(tempfile.mkdtemp(), now=now, instance_id="i0")
+    dist = Distributor(_ring_of(["i0"], now), {"i0": ing}, overrides=ov,
+                       generator_ring=_ring_of(["g0"], now),
+                       generator_clients={"g0": gen}, now=now)
+    assert dist.push_otlp("t1", raw) == {}
+    # dict-path reference tenant
+    assert dist.push_spans("t2", list(spans_from_otlp_proto(raw))) == {}
+
+    for s in src:
+        tid = s["trace_id"]
+        a = ing.find_trace_by_id("t1", tid)
+        b = ing.find_trace_by_id("t2", tid)
+        assert a is not None and b is not None, tid
+        sa = sorted(a, key=lambda d: d["span_id"])
+        sb = sorted(b, key=lambda d: d["span_id"])
+        assert sa == sb, tid
+    got = ing.find_trace_by_id("t1", src[3]["trace_id"])
+    assert any(s.get("events") == src[3]["events"] for s in got)
+    got = ing.find_trace_by_id("t1", src[5]["trace_id"])
+    assert any(s.get("links") == src[5]["links"] for s in got)
+
+
+def test_sharded_staged_views_cover_every_span_once():
+    """Two ring targets served by one in-process generator: each send is
+    a row-subset VIEW; together they cover every span exactly once."""
+    raw, _src = make_payload(40)
+    ov = Overrides()
+    ov.set_tenant_patch("t1", {"generator": {"processors": ["span-metrics"]}})
+    gen = _gen()
+    dist, _ = _tee_rig({"g0": gen, "g1": gen}, ov)
+    assert dist._staging_plan("t1", ov.for_tenant("t1")) is not None
+    assert dist.push_otlp("t1", raw) == {}
+    inst = gen.instance("t1")
+    assert inst.spans_received == 40
+    by_label, *_ = _state_of(gen)
+    assert sum(by_label.values()) == 40.0
+
+
+def test_staged_view_slicing_ragged_batch_boundaries():
+    """Views across pad-bucket boundaries: a subset whose padded capacity
+    differs from the parent batch's must gather columns exactly and
+    round-trip dicts identical to a wire decode of the same rows."""
+    from tempo_tpu.model.interner import StringInterner
+    from tempo_tpu.model.otlp_batch import stage_otlp
+
+    raw, _src = make_payload(300)     # parent cap 512
+    it = StringInterner()
+    staged = stage_otlp(raw, it)
+    assert staged is not None and staged.n == 300
+    full_sb, full_sizes = staged.batch()
+    assert full_sb.capacity == 512
+
+    rows = np.arange(250, 300)        # crosses the 256-row pad bucket
+    view = staged.view(rows)
+    sb, sizes = view.batch_slice()
+    assert sb.n == 50 and sb.capacity == 256
+    assert np.array_equal(sb.name_id[:50], full_sb.name_id[rows])
+    assert np.array_equal(sb.trace_id[:50], full_sb.trace_id[rows])
+    assert np.array_equal(sb.span_attr_key[:50], full_sb.span_attr_key[rows])
+    assert np.array_equal(sizes[:50], full_sizes[rows])
+    assert not sb.valid[50:].any()
+
+    decoded = list(spans_from_otlp_proto(raw))
+    got = view.to_span_dicts()
+    assert got == [decoded[i] for i in rows.tolist()]
+
+    # full-coverage views share the parent arrays: genuinely zero-copy
+    fv = staged.view()
+    fsb, fsizes = fv.batch_slice()
+    assert fsb is full_sb and fsizes is full_sizes
+    assert fv.stage_rows() is staged.spans
+
+
+# -- tentpole: staging pipeline --------------------------------------------
+
+
+def _push_n(gen, payload, n=5, tenant="t1"):
+    for _ in range(n):
+        gen.push_otlp(tenant, payload)
+
+
+def test_pipeline_overlap_and_buffer_reuse():
+    raw, _ = make_payload(200)
+    sched.reset()
+    sched.configure(sched.SchedConfig(enabled=True, pipeline_depth=2))
+    gen = _gen()
+    _push_n(gen, raw, n=6)
+    proc = gen.instance("t1").processors["span-metrics"]
+    pipe = proc._pipe
+    assert pipe is not None
+    by_label, *_ = _state_of(gen)
+    assert sum(by_label.values()) == 6 * 200
+    assert pipe.submitted_total == 6
+    assert pipe.reuse_total >= 3          # ring recycles after warmup
+    assert pipe.alloc_total <= 3          # depth+1 bound on fresh allocs
+    assert pipe.in_flight() == 0          # drained
+
+
+def test_pipeline_drain_before_collect():
+    """collect() behind the drain barrier must see EVERY accepted push —
+    samples bit-identical to the synchronous no-scheduler mode."""
+    raw, _ = make_payload(128)
+
+    def run(pipelined: bool):
+        sched.reset()
+        if pipelined:
+            sched.configure(sched.SchedConfig(enabled=True,
+                                              pipeline_depth=2))
+        gen = _gen()
+        _push_n(gen, raw, n=4)
+        inst = gen.instance("t1")
+        # the PRODUCTION collect path: collect_and_push runs the drain
+        # barrier (sched.flush + pipeline reap) before reading state
+        n = inst.collect_and_push(ts_ms=12345)
+        samples = inst.registry.collect(ts_ms=12345)
+        assert n == len(samples)
+        proc = inst.processors["span-metrics"]
+        if pipelined:
+            assert proc._pipe is not None and proc._pipe.in_flight() == 0
+        out = sorted((s.name, s.labels, s.value) for s in samples)
+        sched.reset()
+        return out
+
+    assert run(True) == run(False)
+
+
+def test_pipeline_off_fallback_parity():
+    """pipeline_depth=0 (ring off) and scheduler-off must both match the
+    pipelined state bit for bit."""
+    raw, _ = make_payload(96)
+
+    def run(cfg):
+        sched.reset()
+        if cfg is not None:
+            sched.configure(cfg)
+        gen = _gen()
+        _push_n(gen, raw, n=3)
+        by_label, calls, lat, dd = _state_of(gen)
+        sched.reset()
+        return by_label, calls.copy(), lat.copy(), dd.copy()
+
+    base = run(None)
+    off = run(sched.SchedConfig(enabled=True, pipeline_depth=0))
+    on = run(sched.SchedConfig(enabled=True, pipeline_depth=2))
+    for other in (off, on):
+        assert base[0] == other[0]
+        assert np.array_equal(base[1], other[1])
+        assert np.array_equal(base[2], other[2])
+        assert np.array_equal(base[3], other[3])
+
+
+def test_pipeline_depth_bounds_inflight():
+    from tempo_tpu.generator.pipeline import IngestPipeline
+
+    class _Job:
+        def __init__(self):
+            import threading
+            self.event = threading.Event()
+
+    pipe = IngestPipeline(depth=2)
+    b1 = pipe.acquire(256, 4)
+    j1 = _Job()
+    pipe.track(j1, b1)
+    b2 = pipe.acquire(256, 4)
+    j2 = _Job()
+    pipe.track(j2, b2)
+    assert pipe.in_flight() == 2
+    # third acquire blocks on the OLDEST job; release it from a timer
+    import threading
+    threading.Timer(0.05, j1.event.set).start()
+    t0 = time.perf_counter()
+    b3 = pipe.acquire(256, 4)
+    assert time.perf_counter() - t0 >= 0.04     # actually waited
+    assert pipe.stall_ns > 0
+    assert b3 is b1                             # recycled, not fresh
+    j2.event.set()
+    assert pipe.drain()
+    assert pipe.in_flight() == 0
+
+
+def test_pipeline_obs_families_registered():
+    from tempo_tpu.generator import pipeline  # noqa: F401 — registers
+    from tempo_tpu.obs.jaxruntime import RUNTIME
+
+    text = RUNTIME.render()
+    for fam in ("tempo_ingest_pipeline_inflight",
+                "tempo_ingest_pipeline_staging_reuse_total",
+                "tempo_ingest_pipeline_overlap_ratio",
+                "tempo_ingest_pipeline_stall_seconds_total"):
+        assert fam in text, fam
+
+
+def test_rejected_push_does_not_intern_or_stage():
+    """Admission runs BEFORE staging: a rate-limited push must not grow
+    the tenant registry's interner (unbounded growth under sustained
+    429s) and must still attribute the rejected span count."""
+    from tempo_tpu.distributor.distributor import RateLimited
+
+    raw, _src = make_payload(32)
+    ov = Overrides()
+    ov.set_tenant_patch("t1", {
+        "generator": {"processors": ["span-metrics"]},
+        "ingestion": {"rate_limit_bytes": 1, "burst_size_bytes": 1}})
+    gen = _gen()
+    dist, _ = _tee_rig({"g0": gen}, ov)
+    assert dist._staging_plan("t1", ov.for_tenant("t1")) is not None
+    before = len(gen.instance("t1").registry.interner)
+    with pytest.raises(RateLimited):
+        dist.push_otlp("t1", raw)
+    assert len(gen.instance("t1").registry.interner) == before
+    assert dist.discarded.get("rate_limited") == 32
+
+
+# -- satellites ------------------------------------------------------------
+
+
+def test_memcached_close_releases_workers_on_full_queue():
+    """ADVICE r5 #1: close() with a FULL write-behind queue must still
+    stop every worker (no thread left blocked on q.get with its socket
+    closed underneath)."""
+    from tempo_tpu.backend.memcached import MemcachedCache
+
+    c = MemcachedCache(["127.0.0.1:1"], timeout_s=0.05,
+                       write_back_buffer=4, write_back_workers=2)
+    for i in range(64):              # saturate the queue (dead server)
+        c.put(f"k{i}", b"v")
+    workers = list(c._workers)
+    c.close()
+    for t in workers:
+        t.join(timeout=3.0)
+        assert not t.is_alive()
+
+
+def test_memcached_prunes_dead_thread_sockets():
+    """ADVICE r5 #5: per-thread sockets of exited threads are pruned (and
+    closed) on the next append, not retained until close()."""
+    import socket as socket_mod
+    import threading
+
+    from tempo_tpu.backend.memcached import _ServerConn
+
+    srv = socket_mod.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    conn = _ServerConn(f"127.0.0.1:{srv.getsockname()[1]}", timeout_s=0.5)
+
+    def connect_once():
+        conn._connect()
+
+    threads = [threading.Thread(target=connect_once) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # every connect appended; dead-thread entries were pruned (and their
+    # sockets closed) as later appends observed the exits — after all
+    # four exit, one more append from a live thread leaves exactly ours
+    conn._connect()
+    assert len(conn._all) == 1
+    assert conn._all[0][1] is threading.current_thread()
+    conn.close()
+    srv.close()
+
+
+def test_jaeger_agent_wildcard_bind_requires_opt_in():
+    from tempo_tpu.distributor.receiver_agent import (JaegerAgentConfig,
+                                                      JaegerAgentReceiver)
+
+    rx = JaegerAgentReceiver(None, JaegerAgentConfig(host="0.0.0.0", port=0))
+    with pytest.raises(ValueError, match="allow_wildcard_bind"):
+        rx.start()
+    rx = JaegerAgentReceiver(None, JaegerAgentConfig(
+        host="0.0.0.0", port=0, allow_wildcard_bind=True))
+    rx.start()
+    try:
+        assert rx.port > 0
+    finally:
+        rx.stop()
+    # the default config binds loopback
+    assert JaegerAgentConfig().host == "127.0.0.1"
+
+
+def test_metrics_grid_returns_cause_not_shared_state():
+    """ADVICE r5 #2: the fused-path refusal cause rides the return value
+    (concurrent queries on one cached plane cannot misattribute)."""
+    from tempo_tpu.block.device_scan import BlockScanPlane
+    from tempo_tpu.traceql import ast as A
+
+    plane = BlockScanPlane([])
+    m = A.MetricsAggregate(kind=A.MetricsKind.COUNT_OVER_TIME, by=())
+    handle, cause = plane.metrics_grid(m, [], True, 0, 10, 0)  # step 0
+    assert handle is None and cause == "shape"
+    assert plane.fallback_causes.get("shape", 0) >= 1

@@ -369,10 +369,11 @@ def test_plane_upload_race_refunds_budget():
     key = ("rglut", (0,))
     real_up = plane._up
 
-    def racing_up(arr):
-        out = real_up(arr)                  # our upload (accounted)
+    def racing_up(arr, is_span_dim=True):
+        out = real_up(arr, is_span_dim)     # our upload (accounted)
         if key not in plane._cols:
-            plane._cols[key] = real_up(np.asarray(arr))  # rival's insert
+            # rival's insert
+            plane._cols[key] = real_up(np.asarray(arr), is_span_dim)
         return out
 
     plane._up = racing_up
