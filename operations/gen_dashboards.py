@@ -202,6 +202,17 @@ def dashboards() -> dict[str, dict]:
                   " (rate(tempo_ingest_pipeline_staging_reuse_total[5m])"
                   " + rate(tempo_ingest_pipeline_staging_alloc_total[5m]))",
                   unit="percentunit"),
+                # graceful-overload sampling (runbook: "Surviving
+                # overload"): the pressure -> keep-fraction control loop
+                p("Ingest keep fraction (controller)",
+                  "tempo_sched_ingest_keep_fraction",
+                  unit="percentunit"),
+                p("Ingest keep fraction by tenant",
+                  "tempo_distributor_sampling_keep_fraction",
+                  legend="{{tenant}}", unit="percentunit"),
+                p("Sampled spans dropped /s",
+                  'sum(rate(tempo_discarded_spans_total{'
+                  'reason="sampled"}[5m]))'),
             ]),
         "tempo-tpu-resources.json": dash(
             "Tempo-TPU / Resources",

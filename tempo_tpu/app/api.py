@@ -597,6 +597,10 @@ class Handler(BaseHTTPRequestHandler):
             # backpressure signal, also on /metrics as
             # tempo_sched_queue_depth / tempo_sched_queue_limit
             "sched_pressure": sc.pressure() if sc is not None else None,
+            # overload controller (1.0 = sampling off; see runbook
+            # "Surviving overload")
+            "ingest_keep_fraction": sc.keep_fraction()
+            if sc is not None else None,
         }
         self._reply(200, _json_bytes(body))
 

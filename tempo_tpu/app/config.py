@@ -159,6 +159,15 @@ class Config:
         if self.sched.pipeline_depth < 0:
             warnings.append("sched.pipeline_depth < 0: use 0 to disable "
                             "the ingest staging ring")
+        if self.sched.sampling_enabled:
+            if not (0 <= self.sched.sampling_start_pressure < 1):
+                warnings.append("sched.sampling_start_pressure must be in "
+                                "[0, 1): 1.0 would never sample before the "
+                                "hard 429")
+            if not (0 < self.sched.sampling_min_fraction <= 1):
+                warnings.append("sched.sampling_min_fraction must be in "
+                                "(0, 1]: 0 would drop every non-forced span "
+                                "at saturation")
         if self.distributor.jaeger_agent_port and \
                 self.distributor.jaeger_agent_host in ("", "0.0.0.0", "::") \
                 and not self.distributor.jaeger_agent_allow_wildcard:

@@ -27,6 +27,7 @@ from tempo_tpu.utils.livetraces import _approx_size
 # `modules/distributor/distributor.go` reasonRateLimited etc.)
 REASON_RATE_LIMITED = "rate_limited"
 REASON_BACKPRESSURE = "sched_backpressure"
+REASON_SAMPLED = "sampled"           # graceful-overload sampling (sampler.py)
 REASON_TRACE_TOO_LARGE = "trace_too_large"
 REASON_INVALID_TRACE_ID = "invalid_trace_id"
 REASON_INTERNAL = "internal_error"
@@ -109,6 +110,11 @@ class Distributor:
         self.generator_clients = generator_clients or {}
         self.limiter = RateLimiter(now=now)
         self.backpressure = IngestBackpressure()
+        # graceful-overload sampling stage (runs on the staged decode-once
+        # path BEFORE grouping/replication; see distributor/sampler.py) —
+        # replaceable with one carrying an injected fraction_source
+        from tempo_tpu.distributor.sampler import SpanSampler
+        self.sampler = SpanSampler(now=now)
         self.n_distributors = n_distributors
         from tempo_tpu.distributor.forwarder import (
             Forwarder,
@@ -159,6 +165,12 @@ class Distributor:
             lambda: [((r,), v) for r, v in self.discarded.items()],
             help="Spans discarded by the distributor, by reason",
             labels=("reason",))
+        reg.gauge_func(
+            "tempo_distributor_sampling_keep_fraction",
+            lambda: self.sampler.fractions(),
+            help="Effective overload keep-fraction per tenant (1.0 = "
+                 "sampling off; policy floor clamps the sched controller)",
+            labels=("tenant",))
         reg.counter_func(
             "tempo_warnings_total",
             lambda: [((t, r), v) for (t, r), v in
@@ -580,6 +592,37 @@ class Distributor:
         if not valid.any():
             return errs
 
+        # graceful-overload sampling stage (sampler.py): under rising
+        # sched pressure the keep-fraction drops below 1.0 and spans are
+        # hash-sampled HERE — before grouping, replication, and the tee —
+        # so every target shares one decision through the row views.
+        # Error/latency-tail spans are always kept; kept spans carry
+        # Horvitz-Thompson weights the generator uses to upscale rates.
+        # At fraction 1.0 (no pressure / tenant opt-out) this whole block
+        # is a no-op and the path is bit-identical to pre-sampling.
+        pol = lim.sampling
+        dur_s = None
+        if pol.enabled and pol.tail_quantile > 0:
+            # warm the tail sketch only for tenants whose policy reads
+            # it — an opted-out tenant pays nothing on the hot path;
+            # the durations pass is shared with sample() below
+            dur_s = self.sampler.durations_s(recs)
+            self.sampler.observe(tenant, recs, dur_s=dur_s)
+        frac = self.sampler.effective_fraction(tenant, pol)
+        if frac < 1.0:
+            keep, weights = self.sampler.sample(tenant, recs, valid, frac,
+                                                pol, dur_s=dur_s)
+            n_drop = int((valid & ~keep).sum())
+            if n_drop:
+                self._discard(REASON_SAMPLED, n_drop)
+            valid = valid & keep
+            staged.sample_weight = weights
+            # sampled spans are an intentional degradation, not a client
+            # error: the push succeeds and errs stays clean (a retry
+            # would re-offer bytes the process just chose to shed)
+            if not valid.any():
+                return errs
+
         # regroup by trace over the staged id columns (id ‖ wire length,
         # as the columnar path keys) — straight off the StageRec rows
         from tempo_tpu import native as _native
@@ -649,7 +692,16 @@ class Distributor:
                 return
             # declined (e.g. the tenant instance was rebuilt with a fresh
             # interner between planning and send): compatibility fallback
-            # through the OTLP-bytes surface
+            # through the OTLP-bytes surface. The bytes surface has no
+            # weight channel, so a SAMPLED push falls back un-upscaled —
+            # rare (one race window per instance rebuild), but it must
+            # not be silent: that window's rates read low.
+            if staged.sample_weight is not None:
+                import logging
+                logging.getLogger("tempo_tpu.ingest").warning(
+                    "staged tee declined for tenant %s during sampling: "
+                    "falling back to bytes, sample weights dropped "
+                    "(rates under-reported for this push)", tenant)
             if view.is_full:
                 client.push_otlp(tenant, raw, trusted=True)
             elif staged.has_span_attrs:

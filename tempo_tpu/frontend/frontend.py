@@ -451,12 +451,20 @@ class Frontend:
                 cost.get("inspected_bytes", 0) + sm["inspectedBytes"]
             cost["blocks_scanned"] = \
                 cost.get("blocks_scanned", 0) + sm["blocksScanned"]
+        # overload-sampling exemplar: while the write path is sampling,
+        # every emitted query line says so — rates/quantiles in this
+        # window describe an upscaled sampled stream, and a reader of a
+        # slow line must be able to tell
+        from tempo_tpu import sched
+        keep = sched.ingest_keep_fraction()
         self.qlog.log_query(
             op=op, tenant=tenant, query=query,
             status="error" if error is not None else "ok",
             duration_s=duration_s, stats=st,
             trace_id=tracing.current_trace_id_hex(),
-            error=str(error) if error is not None else None)
+            error=str(error) if error is not None else None,
+            extra=({"ingestKeepFraction": round(keep, 4)}
+                   if keep < 1.0 else None))
 
     def search(self, tenant: str, query: str, *, limit: int = 20,
                start_s: float | None = None, end_s: float | None = None,

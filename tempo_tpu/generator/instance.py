@@ -143,20 +143,26 @@ class GeneratorInstance:
         other processor mix rides the staged SpanBatch columns
         (`batch_slice` — a gather for sharded views, the SHARED batch for
         full ones). None only on interner mismatch (the staging was not
-        built for this tenant's registry)."""
+        built for this tenant's registry).
+
+        Views from an overload-sampled push carry Horvitz-Thompson
+        weights (`view.weights()`): spanmetrics upscales its rates with
+        them so the sampled stream reports true-stream rates and bounded
+        quantiles (span-multiplier semantics compose multiplicatively)."""
         st = view.staged
         if st.interner is not self.registry.interner:
             return None
+        w = view.weights()
         proc = self._fast_spanmetrics()
         if proc is not None and not st.needs_service_fixup:
             spans = view.stage_rows()
             lo, hi = self._slack_bounds()
-            _n_valid, n_filtered = proc.push_staged(spans, lo, hi)
+            _n_valid, n_filtered = proc.push_staged(spans, lo, hi, weights=w)
             self.spans_received += len(spans)
             self.spans_filtered_slack += n_filtered
             return len(spans)
         sb, sizes = view.batch_slice()
-        self.push_batch(sb, span_sizes=sizes)
+        self.push_batch(sb, span_sizes=sizes, sample_weights=w)
         return view.n
 
     def push_otlp_staged(self, data: bytes, trusted: bool = False
@@ -192,12 +198,14 @@ class GeneratorInstance:
         self.spans_filtered_slack += n_filtered
         return len(spans)
 
-    def push_batch(self, sb: SpanBatch, span_sizes: np.ndarray | None = None) -> None:
+    def push_batch(self, sb: SpanBatch, span_sizes: np.ndarray | None = None,
+                   sample_weights: np.ndarray | None = None) -> None:
         self.spans_received += sb.n
         sb = self._apply_slack(sb)
         for proc in self.processors.values():
             if isinstance(proc, SpanMetricsProcessor):
-                proc.push_batch(sb, span_sizes)
+                proc.push_batch(sb, span_sizes,
+                                sample_weights=sample_weights)
             else:
                 proc.push_batch(sb)
 

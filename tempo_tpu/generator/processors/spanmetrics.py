@@ -276,7 +276,8 @@ class SpanMetricsProcessor:
         return self._dims_arr, self._kind_lut, self._status_lut
 
     def push_staged(self, spans: np.ndarray, slack_lo: int,
-                    slack_hi: int) -> tuple[int, int]:
+                    slack_hi: int,
+                    weights: "np.ndarray | None" = None) -> tuple[int, int]:
         """One fused pass: staged StageRec[:n] → slots/durations/sizes in
         C++ (label build + rowtable resolve + slack filter + last_seen
         stamp) → ONE device scatter update. The Python cost per push is
@@ -298,7 +299,8 @@ class SpanMetricsProcessor:
             slack_lo, slack_hi, now, self.calls.table.last_seen, cap,
             out=bufs)
         return self._push_resolved(got, spans["trace_id"], n, now,
-                                   sc=sc, pipe=pipe, bufs=bufs)
+                                   sc=sc, pipe=pipe, bufs=bufs,
+                                   weights=weights)
 
     def push_from_recs(self, raw: bytes, recs: np.ndarray, slack_lo: int,
                        slack_hi: int) -> "tuple[int, int] | None":
@@ -331,7 +333,14 @@ class SpanMetricsProcessor:
                                    sc=sc, pipe=pipe, bufs=bufs)
 
     def _push_resolved(self, got, trace_ids, n: int, now: float,
-                       sc=None, pipe=None, bufs=None) -> tuple[int, int]:
+                       sc=None, pipe=None, bufs=None,
+                       weights=None) -> tuple[int, int]:
+        """`weights` (len n, optional) are per-span Horvitz-Thompson
+        upscale factors from the distributor's overload sampling stage:
+        they multiply calls/size counts and weight the latency
+        histogram+sketch so rates and quantiles describe the TRUE
+        stream. None (the unsampled common case) keeps the cached
+        device ones-vector and the exact pre-sampling dispatch."""
         slots, packed, rows, valid, miss, n_valid, n_filtered = got
         if miss.size:
             self.calls.table.apply_misses(rows, slots, miss, valid, now)
@@ -346,9 +355,10 @@ class SpanMetricsProcessor:
             # recycle the moment its dispatch lands.
             job = None
             if n:
+                w = np.ones(n, np.float32) if weights is None \
+                    else np.asarray(weights[:n], np.float32)
                 job = self._submit_rows(sc, slots[:n], packed[1][:n],
-                                        packed[2][:n],
-                                        np.ones(n, np.float32))
+                                        packed[2][:n], w)
             # exemplars read slots/packed BEFORE the buffers are handed
             # to the pipeline ring: track() makes them reclaimable the
             # moment the job lands (inline on the shed path), and a
@@ -370,6 +380,13 @@ class SpanMetricsProcessor:
             # the weights vector is constant on the fast path: upload it
             # ONCE per capacity and reuse the device copy every push
             ones = self._ones_cache[cap] = jnp.ones(cap, jnp.float32)
+        if weights is not None:
+            # sampled push: per-span upscale weights replace the cached
+            # ones-vector (same shape/dtype — no re-trace, one extra H2D
+            # only while sampling is active)
+            wfull = np.ones(cap, np.float32)
+            wfull[:n] = weights[:n]
+            ones = wfull
         if self.calls.table.capacity < (1 << 24):
             # single packed H2D for (slots, dur, sizes) — f32 holds every
             # possible SLOT ID exactly while the series-table capacity
@@ -427,8 +444,12 @@ class SpanMetricsProcessor:
             cols.append(np.where(col != INVALID_ID, col, empty))
         return np.stack(cols, axis=1).astype(np.int32)
 
-    def push_batch(self, sb: SpanBatch, span_sizes: np.ndarray | None = None) -> None:
-        """Aggregate one batch. `span_sizes` ≈ proto bytes per span (size subproc)."""
+    def push_batch(self, sb: SpanBatch, span_sizes: np.ndarray | None = None,
+                   sample_weights: np.ndarray | None = None) -> None:
+        """Aggregate one batch. `span_sizes` ≈ proto bytes per span (size
+        subproc); `sample_weights` (len ≤ capacity) are overload-sampling
+        upscale factors, composed multiplicatively with the span
+        multiplier (both are per-span observation weights)."""
         if sb.interner is not self.registry.interner:
             raise ValueError(
                 "SpanBatch must be built with the tenant registry's interner "
@@ -447,6 +468,10 @@ class SpanMetricsProcessor:
         if self.cfg.span_multiplier_key:
             mult = _attr_fval(sb, self.cfg.span_multiplier_key)
             weights = np.where(mult > 0, mult, 1.0).astype(np.float32)
+        if sample_weights is not None:
+            sw = np.ones(sb.capacity, np.float32)
+            sw[:len(sample_weights)] = sample_weights
+            weights = weights * sw
         sc = self._sched()
         if sc is not None:
             self._submit_rows(sc, slots, dur_s,

@@ -29,6 +29,8 @@ WriteRequest field numbers (public prometheus/prompb/remote.proto + types.proto)
 from __future__ import annotations
 
 import dataclasses
+import random
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -40,6 +42,19 @@ from tempo_tpu.model import proto_wire as pw
 from tempo_tpu.registry.series import Sample
 
 MAX_LITERAL = (1 << 32) - 1
+
+# process-wide delivery counters across every RemoteWriteClient (one per
+# tenant instance), rendered by the RUNTIME registry families below —
+# retry storms and dead endpoints must be visible on /metrics, not just
+# in per-client attributes nobody scrapes
+_RW_LOCK = threading.Lock()
+_RW_RETRIES: dict[str, int] = {}      # cause -> count
+_RW_STATS = {"sends": 0, "failed": 0}
+
+
+def _note_retry(cause: str) -> None:
+    with _RW_LOCK:
+        _RW_RETRIES[cause] = _RW_RETRIES.get(cause, 0) + 1
 
 
 def snappy_compress(data: bytes) -> bytes:
@@ -154,6 +169,13 @@ class RemoteWriteConfig:
     timeout_s: float = 30.0
     retries: int = 3
     backoff_s: float = 0.5
+    # TOTAL backoff sleep budget per send() call: send runs inline on
+    # the shared collection thread, so the stall one tenant's backend
+    # can inflict per tick must be bounded regardless of how many
+    # retries remain or what Retry-After it advertises (a hostile
+    # header cannot buy more than the remaining budget; once spent,
+    # remaining retries are abandoned and the send fails)
+    max_backoff_total_s: float = 15.0
     send_native_histograms: bool = False  # reference toggle (config_util.go)
 
 
@@ -171,6 +193,36 @@ class RemoteWriteClient:
         self.sent_bytes = 0
         self.sent_samples = 0
         self.failed_sends = 0
+        self.retried_sends = 0
+        # injectable for tests: retry pacing must be assertable without
+        # real sleeps, and jitter without seeding the global RNG
+        self._sleep = time.sleep
+        self._rng = random.Random()
+
+    @staticmethod
+    def _retry_after_s(e: urllib.error.HTTPError) -> "float | None":
+        """Seconds advertised by a 429/503 Retry-After header (delta
+        form only — the HTTP-date form is ignored rather than parsed
+        wrong)."""
+        try:
+            v = e.headers.get("Retry-After") if e.headers else None
+            return float(v) if v is not None else None
+        except (TypeError, ValueError):
+            return None
+
+    def _backoff(self, attempt_delay: float,
+                 retry_after: "float | None") -> float:
+        """Full-jitter exponential backoff (sleep ~ U(0, delay)): a fleet
+        of generators retrying the same dead endpoint never synchronizes
+        into a thundering herd. A server-advertised Retry-After raises
+        the floor — we honor it, plus jitter ON TOP so the fleet doesn't
+        all return at exactly the advertised second. The caller clamps
+        the result to its remaining per-send budget."""
+        sleep_s = self._rng.uniform(0.0, attempt_delay)
+        if retry_after is not None and retry_after > 0:
+            sleep_s = retry_after + self._rng.uniform(
+                0.0, max(retry_after * 0.1, self.cfg.backoff_s))
+        return sleep_s
 
     def send(self, samples: Sequence[Sample], native_histograms: Sequence[tuple] = ()) -> bool:
         if not self.cfg.url or (not samples and not native_histograms):
@@ -184,20 +236,66 @@ class RemoteWriteClient:
         for k, v in self.cfg.headers.items():
             req.add_header(k, v)
         delay = self.cfg.backoff_s
+        budget = self.cfg.max_backoff_total_s   # total sleep per send()
         for attempt in range(self.cfg.retries + 1):
+            retry_after = None
+            cause = None
             try:
                 with urllib.request.urlopen(req, timeout=self.cfg.timeout_s) as resp:
                     if 200 <= resp.status < 300:
                         self.sent_bytes += len(payload)
                         self.sent_samples += len(samples)
+                        with _RW_LOCK:
+                            _RW_STATS["sends"] += 1
                         return True
             except urllib.error.HTTPError as e:
-                if 400 <= e.code < 500 and e.code != 429:
-                    break  # non-retryable, matching prometheus remote-write rules
+                if e.code == 429 or e.code >= 500:
+                    # retryable per prometheus remote-write rules; 429
+                    # and 503 commonly advertise Retry-After
+                    cause = "http_429" if e.code == 429 else "http_5xx"
+                    retry_after = self._retry_after_s(e)
+                else:
+                    break  # other 4xx: non-retryable
             except (urllib.error.URLError, OSError):
-                pass
+                cause = "network"
             if attempt < self.cfg.retries:
-                time.sleep(delay)
+                sleep_s = min(self._backoff(delay, retry_after), budget)
+                if sleep_s <= 0:
+                    break      # budget spent: abandon remaining retries
+                budget -= sleep_s
+                self.retried_sends += 1
+                _note_retry(cause or "unknown")
+                self._sleep(sleep_s)
                 delay *= 2
         self.failed_sends += 1
+        with _RW_LOCK:
+            _RW_STATS["failed"] += 1
         return False
+
+
+# RUNTIME registry families (process-wide, next to the sched/jit ones):
+# the per-client attributes above stay the store, these render them
+from tempo_tpu.obs.jaxruntime import RUNTIME  # noqa: E402
+
+def _retries_family() -> list:
+    # the lock covers the iteration too: a sender inserting a new cause
+    # key mid-scrape would otherwise blow up the /metrics render
+    with _RW_LOCK:
+        return [((c,), float(v)) for c, v in _RW_RETRIES.items()]
+
+
+RUNTIME.counter_func(
+    "tempo_remote_write_retries_total", _retries_family,
+    help="Remote-write attempts retried after a retryable failure, by "
+         "cause (429 vs 5xx vs network) — sustained growth means the "
+         "metrics backend is rejecting or unreachable",
+    labels=("cause",))
+RUNTIME.counter_func(
+    "tempo_remote_write_sends_total",
+    lambda: [((), float(_RW_STATS["sends"]))],
+    help="Remote-write requests delivered (2xx)")
+RUNTIME.counter_func(
+    "tempo_remote_write_failed_sends_total",
+    lambda: [((), float(_RW_STATS["failed"]))],
+    help="Remote-write requests dropped after exhausting retries "
+         "(samples LOST to the metrics backend)")

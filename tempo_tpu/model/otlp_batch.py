@@ -310,8 +310,8 @@ class StagedIngest:
     re-serialization, no second staging pass, no per-target decode."""
 
     __slots__ = ("raw", "interner", "spans", "sattrs", "rattrs", "res",
-                 "has_span_attrs", "include_res_attrs", "_batch", "_sizes",
-                 "_events", "_fixup", "_svc_ids")
+                 "has_span_attrs", "include_res_attrs", "sample_weight",
+                 "_batch", "_sizes", "_events", "_fixup", "_svc_ids")
 
     def __init__(self, raw: bytes, interner: StringInterner, staged,
                  has_span_attrs: bool = True,
@@ -321,6 +321,10 @@ class StagedIngest:
         self.spans, self.sattrs, self.rattrs, self.res = staged
         self.has_span_attrs = has_span_attrs
         self.include_res_attrs = include_res_attrs
+        # per-row Horvitz-Thompson weights set by the distributor's
+        # overload sampling stage (None = unsampled, every weight 1.0);
+        # views slice it so the generator can upscale sampled rates
+        self.sample_weight: "np.ndarray | None" = None
         self._batch = None
         self._sizes = None
         self._events = None
@@ -430,6 +434,14 @@ class StagedView:
         if self.is_full:
             return self.staged.spans
         return self.staged.spans[self.rows]
+
+    def weights(self) -> "np.ndarray | None":
+        """This view's sampling weights (None when the push was not
+        sampled — the common case; consumers then use weight 1.0)."""
+        w = self.staged.sample_weight
+        if w is None or self.is_full:
+            return w
+        return w[self.rows]
 
     def batch_slice(self) -> tuple["SpanBatch", np.ndarray]:
         """(SpanBatch, sizes) for this view's rows — the shared staged

@@ -164,9 +164,14 @@ class QueryLogger:
     def log_query(self, *, op: str, tenant: str, query: str, status: str,
                   duration_s: float, stats: "QueryStats | None" = None,
                   trace_id: "str | None" = None,
-                  error: "str | None" = None) -> "dict | None":
+                  error: "str | None" = None,
+                  extra: "dict | None" = None) -> "dict | None":
         """Emit (or suppress) one "query complete" record; returns the
-        record dict when emitted, None when suppressed."""
+        record dict when emitted, None when suppressed. `extra` merges
+        additional context fields into the record (e.g. the frontend's
+        ingest keep-fraction exemplar while overload sampling is active
+        — a reader of a slow/odd query line needs to know whether its
+        quantiles came from a sampled stream)."""
         reason = self._decide(op, status, duration_s)
         if reason is None:
             return None
@@ -180,6 +185,8 @@ class QueryLogger:
             "durationMs": round(duration_s * 1e3, 3),
             "traceId": trace_id,
         }
+        if extra:
+            record.update(extra)
         if error:
             record["error"] = str(error)[:500]
         if stats is not None:

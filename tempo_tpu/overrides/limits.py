@@ -65,6 +65,26 @@ class GeneratorLimits:
 
 
 @dataclasses.dataclass
+class SamplingLimits:
+    """Per-tenant graceful-overload sampling policy (the `sampling:`
+    group): how this tenant's spans behave when the process-wide
+    overload controller (`sched.keep_fraction`) is below 1.0. The
+    controller decides WHEN to sample and how hard; the policy decides
+    how far this tenant may be sampled and what is never dropped."""
+
+    enabled: bool = True          # False: tenant opts out → old hard-429 cliff
+    floor: float = 0.25           # effective keep-fraction never drops below
+    keep_errors: bool = True      # error-status spans always kept (exact)
+    # latency-tail always-keep: spans whose duration sits above this
+    # quantile of the tenant's own recent duration distribution are
+    # kept at weight 1 (exact tail). 0 disables tail protection.
+    tail_quantile: float = 0.99
+    # observations the host duration sketch needs before the tail
+    # threshold arms (an unwarmed threshold would force-keep everything)
+    tail_min_spans: int = 1024
+
+
+@dataclasses.dataclass
 class Limits:
     """Everything a tenant can override. Defaults mirror the reference's
     (`config.go` RegisterFlagsAndApplyDefaults defaults)."""
@@ -73,6 +93,7 @@ class Limits:
     read: ReadLimits = dataclasses.field(default_factory=ReadLimits)
     compaction: CompactionLimits = dataclasses.field(default_factory=CompactionLimits)
     generator: GeneratorLimits = dataclasses.field(default_factory=GeneratorLimits)
+    sampling: SamplingLimits = dataclasses.field(default_factory=SamplingLimits)
 
     def merged_with(self, patch: dict) -> "Limits":
         """New Limits with `patch` (nested dict) applied over self."""

@@ -20,6 +20,8 @@ from tempo_tpu.sched.scheduler import (
     bucket_rows,
     configure,
     flush,
+    fraction_for_pressure,
+    ingest_keep_fraction,
     reset,
     run,
     scheduler,
@@ -29,6 +31,6 @@ from tempo_tpu.sched.scheduler import (
 __all__ = [
     "CLASS_NAMES", "DeviceScheduler", "Job", "PRIO_COMPACTION",
     "PRIO_INGEST", "PRIO_QUERY", "QueryBackpressure", "SchedConfig",
-    "bucket_rows", "configure", "flush", "reset",
-    "run", "scheduler", "use",
+    "bucket_rows", "configure", "flush", "fraction_for_pressure",
+    "ingest_keep_fraction", "reset", "run", "scheduler", "use",
 ]

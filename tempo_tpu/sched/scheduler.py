@@ -55,6 +55,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import logging
+import math
 import threading
 import time
 from collections import OrderedDict, deque
@@ -102,6 +103,38 @@ class SchedConfig:
     # ring is depth+1 deep). 0 disables the decode/update overlap ring —
     # submissions still coalesce, but every push allocates fresh staging.
     pipeline_depth: int = 2
+    # graceful-overload sampling (the pressure → keep-fraction
+    # controller): when the live-ingest queue fills past
+    # `sampling_start_pressure` of its bound, the distributor's span
+    # sampler shrinks the per-push keep fraction linearly from 1.0 down
+    # to `sampling_min_fraction` at full saturation — overload degrades
+    # to a representative sampled stream FIRST; the hard 429 (which
+    # still fires at depth == limit) becomes the escalation of last
+    # resort. Below the start pressure the fraction is exactly 1.0 and
+    # the sampling stage is bypassed entirely (bit-identical path).
+    # Per-tenant policy/floors live in overrides (`sampling:` limits).
+    sampling_enabled: bool = True
+    sampling_start_pressure: float = 0.5
+    sampling_min_fraction: float = 0.05
+    # EWMA time constant for the published fraction: pressure is spiky
+    # push to push; the controller must ramp, not flap. 0 = unsmoothed.
+    sampling_smoothing_s: float = 2.0
+
+
+def fraction_for_pressure(pressure: float, start: float,
+                          floor: float) -> float:
+    """Pure pressure → keep-fraction control law (the testable core of
+    the overload controller): 1.0 at or below `start`, then a linear
+    ramp down to `floor` at full saturation (pressure 1.0). Exactly 1.0
+    below the threshold — the distributor bypasses its sampling stage
+    entirely there, keeping the unpressured path bit-identical."""
+    if pressure <= start:
+        return 1.0
+    if start >= 1.0:
+        return 1.0
+    span = 1.0 - start
+    frac = 1.0 - (min(pressure, 1.0) - start) / span * (1.0 - floor)
+    return max(min(frac, 1.0), floor)
 
 
 def bucket_rows(n: int, lo: int = 64, hi: int | None = None) -> int:
@@ -211,6 +244,16 @@ class DeviceScheduler:
         self.dispatch_errors = 0
         self.occupancy_sum: dict[str, float] = {}
         self._warm_buckets: set[tuple] = set()
+        # pressure → keep-fraction controller state (EWMA-smoothed; see
+        # keep_fraction below). Guarded by _frac_lock: the distributor
+        # reads per push from any receiver thread.
+        self._frac_lock = threading.Lock()
+        self._frac = 1.0
+        self._frac_t: "float | None" = None
+        # ingest jobs currently being dispatched (popped off the queues
+        # but not yet landed) — the controller's pressure must include
+        # them or it collapses to zero mid-drain (see control_pressure)
+        self._inflight_ingest = 0
         if start_worker and self.cfg.enabled:
             self.start()
 
@@ -279,6 +322,59 @@ class DeviceScheduler:
         """Seconds a rejected producer should back off, or None to
         admit — the `IngestBackpressure` hook contract."""
         return self.cfg.retry_after_s if self.ingest_saturated() else None
+
+    def control_pressure(self) -> float:
+        """Live-ingest pressure for the sampling controller: queued PLUS
+        in-flight jobs over the bound (may exceed 1.0 while the device
+        chews a popped backlog). The hard-429 signal stays queue-only —
+        the bound protects queue memory — but the controller must keep
+        sampling through a batchy drain, or the fraction sawtooths to
+        1.0 every time the worker pops the backlog and re-saturates the
+        moment full-row pushes resume."""
+        with self._cond:
+            inflight = self._inflight_ingest
+        return (self.depth(PRIO_INGEST) + inflight) \
+            / max(self._limit(PRIO_INGEST), 1)
+
+    def keep_fraction(self) -> float:
+        """The overload controller's current span keep-fraction in
+        (0, 1]: 1.0 means sampling is off (the distributor bypasses its
+        sampling stage entirely), anything lower tells the distributor
+        to hash-sample non-forced spans at that rate. Driven by the SAME
+        live-ingest queue that feeds `IngestBackpressure` (plus its
+        in-flight tail, see control_pressure), so the escalation order
+        is: full stream → sampled stream → 429.
+
+        The published value is EWMA-smoothed (`sampling_smoothing_s`)
+        because queue fill is spiky push to push; it snaps back to
+        exactly 1.0 once the raw control law has fully recovered so the
+        below-threshold path stays bit-identical."""
+        cfg = self.cfg
+        if not cfg.enabled or not cfg.sampling_enabled:
+            return 1.0
+        raw = fraction_for_pressure(self.control_pressure(),
+                                    cfg.sampling_start_pressure,
+                                    cfg.sampling_min_fraction)
+        tau = cfg.sampling_smoothing_s
+        if tau <= 0:
+            return raw
+        now = self.now()
+        with self._frac_lock:
+            if self._frac_t is None:
+                self._frac = raw
+            else:
+                dt = max(now - self._frac_t, 0.0)
+                # asymmetric: shed fast (tau/4), recover slowly (tau) —
+                # a batchy drain makes raw pressure sawtooth, and a
+                # controller that snaps back to 1.0 between drain cycles
+                # re-saturates the queue with full-row pushes every cycle
+                tau_eff = tau if raw > self._frac else tau / 4.0
+                alpha = 1.0 - math.exp(-dt / tau_eff)
+                self._frac += alpha * (raw - self._frac)
+                if raw >= 1.0 and self._frac >= 0.99:
+                    self._frac = 1.0   # recovered: exact off, not 0.99…
+            self._frac_t = now
+            return max(self._frac, cfg.sampling_min_fraction)
 
     def mean_occupancy(self, kernel: "str | None" = None) -> float:
         if kernel is not None:
@@ -472,7 +568,9 @@ class DeviceScheduler:
                 self._queues[PRIO_COMPACTION].clear()
             n = (len(ready) + len(ingest_fns) + len(query_fns)
                  + len(comp_fns))
+            n_ing = sum(len(g.jobs) for g in ready) + len(ingest_fns)
             self._inflight += n
+            self._inflight_ingest += n_ing
         if n == 0:
             return False
         try:
@@ -483,6 +581,7 @@ class DeviceScheduler:
         finally:
             with self._cond:
                 self._inflight -= n
+                self._inflight_ingest -= n_ing
                 self._cond.notify_all()
         return True
 
@@ -696,6 +795,16 @@ def flush() -> None:
         sc.flush()
 
 
+def ingest_keep_fraction() -> float:
+    """The process-wide overload keep-fraction (1.0 = sampling off):
+    the distributor's span sampler and the frontend's query-log
+    annotation both read this one signal."""
+    sc = _default
+    if sc is None:
+        return 1.0
+    return sc.keep_fraction()
+
+
 # ---------------------------------------------------------------------------
 # obs: scheduler families in the process-wide runtime registry
 # ---------------------------------------------------------------------------
@@ -764,6 +873,13 @@ RUNTIME.counter_func(
     help="First-time (kernel, shape-bucket) combinations dispatched; "
          "flat after warmup means zero steady-state re-traces",
     labels=("kernel",))
+RUNTIME.gauge_func(
+    "tempo_sched_ingest_keep_fraction",
+    lambda: [] if _default is None else
+    [((), float(_default.keep_fraction()))],
+    help="Overload controller's current span keep-fraction (1.0 = "
+         "sampling off; below 1.0 the distributor hash-samples "
+         "non-forced spans before hard 429)")
 RUNTIME.counter_func(
     "tempo_sched_dispatch_errors_total",
     lambda: [] if _default is None else
@@ -792,5 +908,6 @@ __all__ = [
     "PRIO_INGEST", "PRIO_QUERY", "PRIO_COMPACTION", "CLASS_NAMES",
     "SchedConfig", "QueryBackpressure", "Job",
     "DeviceScheduler", "bucket_rows", "configure", "scheduler", "use",
-    "run", "flush", "reset",
+    "run", "flush", "reset", "fraction_for_pressure",
+    "ingest_keep_fraction",
 ]

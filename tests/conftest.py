@@ -39,3 +39,97 @@ def _reset_device_scheduler():
     from tempo_tpu import sched
 
     sched.reset()
+
+
+# ---------------------------------------------------------------------------
+# fault injection — shared overload / retry-storm test helpers
+# ---------------------------------------------------------------------------
+
+
+def make_pressure_scheduler(pressure: float = 0.0, cfg=None):
+    """A real DeviceScheduler whose live-ingest queue FILL is forced to
+    `pressure` (0..1+): the keep-fraction controller, IngestBackpressure,
+    and /status all read the injected value through the normal depth()
+    surface, so overload tests exercise the genuine escalation path
+    (full stream → sampled → 429) without racing a worker thread.
+    Mutate `.forced_pressure` to ramp. Worker is NOT started."""
+    from tempo_tpu.sched import DeviceScheduler, PRIO_INGEST, SchedConfig
+
+    class _PressureScheduler(DeviceScheduler):
+        def __init__(self):
+            # pipeline_depth=0: the decode-ahead ring bounds in-flight
+            # jobs and there is NO worker here to land them — a third
+            # push would block in pipeline.acquire for its full timeout.
+            # smoothing 0: tests assert on the raw control law.
+            super().__init__(
+                cfg or SchedConfig(sampling_smoothing_s=0.0,
+                                   pipeline_depth=0),
+                start_worker=False)
+            self.forced_pressure = pressure
+
+        def depth(self, prio):
+            if prio == PRIO_INGEST:
+                return int(round(self.forced_pressure * self._limit(prio)))
+            return super().depth(prio)
+
+    return _PressureScheduler()
+
+
+@pytest.fixture
+def forced_sched_saturation():
+    """Factory fixture: install a forced-pressure scheduler as THE
+    process scheduler for the test. `arm(pressure)` returns it; ramp by
+    assigning `.forced_pressure`. Uninstalled on teardown."""
+    from tempo_tpu import sched
+
+    cms = []
+
+    def arm(pressure: float = 1.0, cfg=None):
+        sc = make_pressure_scheduler(pressure, cfg)
+        cm = sched.use(sc)
+        cm.__enter__()
+        cms.append(cm)
+        return sc
+
+    yield arm
+    for cm in reversed(cms):
+        cm.__exit__(None, None, None)
+
+
+@pytest.fixture
+def faulty_remote_write():
+    """A loopback HTTP endpoint with a scripted response sequence —
+    the failing / Retry-After-emitting remote-write backend. Append
+    `(status, headers)` tuples to `.script` (empty script → 200);
+    received requests accumulate in `.requests`."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            srv = self.server
+            n = int(self.headers.get("Content-Length", 0) or 0)
+            body = self.rfile.read(n)
+            srv.requests.append({"path": self.path, "n_bytes": len(body),
+                                 "headers": dict(self.headers)})
+            status, headers = (srv.script.pop(0) if srv.script
+                               else (200, {}))
+            self.send_response(status)
+            for k, v in headers.items():
+                self.send_header(k, str(v))
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):     # keep pytest output clean
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), _Handler)
+    srv.script = []
+    srv.requests = []
+    srv.url = f"http://127.0.0.1:{srv.server_address[1]}/api/v1/push"
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+    t.join(timeout=2)

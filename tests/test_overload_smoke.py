@@ -1,0 +1,220 @@
+"""Overload escalation smoke: full stream → device-scored sampling →
+hard 429, through the REAL staged distributor path (tier-1-safe: forced
+pressure, no worker races, small payloads)."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from tempo_tpu import native, sched
+from tempo_tpu.distributor import Distributor
+from tempo_tpu.distributor.distributor import (REASON_BACKPRESSURE,
+                                               REASON_SAMPLED, RateLimited)
+from tempo_tpu.generator.generator import Generator
+from tempo_tpu.generator.instance import GeneratorConfig
+from tempo_tpu.model.otlp import encode_spans_otlp
+from tempo_tpu.overrides import Overrides
+from tempo_tpu.ring import ACTIVE, InstanceDesc, Ring
+from tempo_tpu.ring.ring import _instance_tokens
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native staging kernel required")
+
+def make_payload(n: int, err_every: int = 0) -> bytes:
+    # timestamps stamped at CALL time: the generator's ingestion slack
+    # (tenant-limits default 30s) silently filters a payload built at
+    # module import once the suite has been running that long
+    t0 = int(time.time() * 1e9)
+    src = []
+    for i in range(n):
+        s = {"trace_id": (b"%05d" % i).ljust(16, b"\0"),
+             "span_id": bytes([i % 251 + 1]) * 8,
+             "name": f"op-{i % 4}", "service": "svc",
+             "start_unix_nano": t0 + i * 1000,
+             "end_unix_nano": t0 + i * 1000 + 1_000_000,
+             "res_attrs": {"service.name": "svc"}}
+        if err_every and i % err_every == 0:
+            s["status_code"] = 2
+        src.append(s)
+    return encode_spans_otlp(src)
+
+
+class _CaptureStagedIng:
+    """Staged-capable ingester sink that records which rows it saw."""
+
+    staged_needs_attrs = False
+
+    def __init__(self):
+        self.rows: list[np.ndarray] = []
+        self.status: list[np.ndarray] = []
+
+    def push(self, tenant, traces):
+        return [None] * len(traces)
+
+    def push_otlp(self, tenant, payload):
+        return {}
+
+    def push_staged(self, tenant, view):
+        self.rows.append(view.row_indices().copy())
+        self.status.append(view.stage_rows()["status_code"].copy())
+        return {}
+
+
+def _ring_of(ids, now):
+    r = Ring(replication_factor=1, now=now)
+    for iid in ids:
+        r.register(InstanceDesc(id=iid, state=ACTIVE,
+                                tokens=_instance_tokens(iid, 64),
+                                heartbeat_ts=now()))
+    return r
+
+
+def _rig(patch: dict | None = None):
+    now = time.time
+    cfg = GeneratorConfig(processors=("span-metrics",))
+    cfg.registry.disable_collection = True
+    ov = Overrides()
+    gen = Generator(cfg, overrides=ov)
+    ing = _CaptureStagedIng()
+    p = {"generator": {"processors": ["span-metrics"]},
+         "ingestion": {"rate_limit_bytes": 1 << 40,
+                       "burst_size_bytes": 1 << 40}}
+    p.update(patch or {})
+    ov.set_tenant_patch("t1", p)
+    dist = Distributor(_ring_of(["i0"], now), {"i0": ing}, overrides=ov,
+                       generator_ring=_ring_of(["g0"], now),
+                       generator_clients={"g0": gen}, now=now)
+    return dist, ing, gen
+
+
+def _gen_rows(gen, tenant="t1"):
+    proc = gen.instance(tenant).processors["span-metrics"]
+    return proc
+
+
+def test_escalation_full_stream_then_sampling_then_429(
+        forced_sched_saturation):
+    sc = forced_sched_saturation(0.0)
+    dist, ing, gen = _rig()
+    payload = make_payload(256, err_every=16)
+
+    # stage 1 — no pressure: everything admitted, sampling off
+    assert dist.push_otlp("t1", payload) == {}
+    assert dist.discarded.get(REASON_SAMPLED, 0) == 0
+    assert len(ing.rows[-1]) == 256
+
+    # stage 2 — pressure in the sampling band: push SUCCEEDS (no 429),
+    # spans are hash-sampled, errors retained at 100%
+    sc.forced_pressure = 0.95
+    assert dist.push_otlp("t1", payload) == {}     # sampled ≠ client error
+    n_dropped = dist.discarded.get(REASON_SAMPLED, 0)
+    assert 0 < n_dropped < 256
+    assert len(ing.rows[-1]) == 256 - n_dropped
+    n_err_in = sum(1 for i in range(256) if i % 16 == 0)
+    assert int((ing.status[-1] == 2).sum()) == n_err_in
+
+    # stage 3 — saturation: the hard 429 fires, with the backpressure
+    # reason and a Retry-After the client can obey
+    sc.forced_pressure = 1.0
+    with pytest.raises(RateLimited) as ei:
+        dist.push_otlp("t1", payload)
+    assert ei.value.reason == REASON_BACKPRESSURE
+    assert ei.value.retry_after_s > 0
+
+    # stage 4 — recovery: back to the bit-identical unsampled path
+    sc.forced_pressure = 0.0
+    before = dist.discarded.get(REASON_SAMPLED, 0)
+    assert dist.push_otlp("t1", payload) == {}
+    assert dist.discarded.get(REASON_SAMPLED, 0) == before
+    assert len(ing.rows[-1]) == 256
+
+
+def test_ingester_and_generator_tee_agree_on_every_span(
+        forced_sched_saturation):
+    """One decision, shared by both tee targets through the row views:
+    the generator instance consumes exactly the rows the ingester saw."""
+    forced_sched_saturation(0.9)
+    dist, ing, gen = _rig()
+    payload = make_payload(512)
+    assert dist.push_otlp("t1", payload) == {}
+    kept = len(ing.rows[-1])
+    assert 0 < kept < 512
+    inst = gen.instance("t1")
+    assert inst.spans_received == kept
+
+
+def test_sampled_push_upscales_spanmetrics_rates(forced_sched_saturation):
+    """Horvitz-Thompson weights ride the staged view: calls_total on the
+    sampled stream estimates the true span count."""
+    import jax
+
+    sc = forced_sched_saturation(0.0)
+    dist, ing, gen = _rig({"sampling": {"floor": 0.25,
+                                        "tail_quantile": 0.0}})
+    payload = make_payload(4096)
+    sc.forced_pressure = 0.95          # deep in the band → floor applies
+    assert dist.push_otlp("t1", payload) == {}
+    n_dropped = dist.discarded.get(REASON_SAMPLED, 0)
+    assert n_dropped > 0
+    proc = _gen_rows(gen)
+    sched.flush()
+    jax.block_until_ready(proc.calls.state.values)
+    calls = np.asarray(proc.calls.state.values)
+    total = sum(float(calls[int(s)])
+                for s in proc.calls.table.active_slots())
+    assert abs(total - 4096) / 4096 < 0.05
+
+
+def test_sampling_off_is_bit_identical(forced_sched_saturation):
+    """Below the pressure threshold the sampling stage must not perturb
+    ANY output: registry state matches a distributor with the tenant
+    opted out entirely."""
+    import jax
+
+    forced_sched_saturation(0.0)
+    payload = make_payload(128)
+
+    def run(opt_out: bool):
+        dist, ing, gen = _rig({"sampling": {"enabled": False}}
+                              if opt_out else None)
+        assert dist.push_otlp("t1", payload) == {}
+        proc = _gen_rows(gen)
+        sched.flush()
+        jax.block_until_ready(proc.calls.state.values)
+        calls = np.asarray(proc.calls.state.values)
+        state = {proc.calls.labels_of(int(s)): float(calls[int(s)])
+                 for s in proc.calls.table.active_slots()}
+        return state, ing.rows[-1]
+
+    s_on, rows_on = run(opt_out=False)
+    s_off, rows_off = run(opt_out=True)
+    assert s_on == s_off
+    assert np.array_equal(rows_on, rows_off)
+
+
+def test_tenant_optout_keeps_hard_cliff(forced_sched_saturation):
+    """A tenant with sampling disabled keeps the old binary behavior:
+    full stream right up to the 429."""
+    sc = forced_sched_saturation(0.9)
+    dist, ing, gen = _rig({"sampling": {"enabled": False}})
+    payload = make_payload(64)
+    assert dist.push_otlp("t1", payload) == {}
+    assert dist.discarded.get(REASON_SAMPLED, 0) == 0
+    assert len(ing.rows[-1]) == 64
+    sc.forced_pressure = 1.0
+    with pytest.raises(RateLimited):
+        dist.push_otlp("t1", payload)
+
+
+def test_keep_fraction_gauge_renders(forced_sched_saturation):
+    forced_sched_saturation(0.9)
+    dist, ing, gen = _rig()
+    dist.push_otlp("t1", make_payload(64))
+    text = dist.obs.render()
+    assert "tempo_distributor_sampling_keep_fraction" in text
+    assert 'tenant="t1"' in text
+    from tempo_tpu.obs.jaxruntime import RUNTIME
+    assert "tempo_sched_ingest_keep_fraction" in RUNTIME.render()
