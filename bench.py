@@ -1098,11 +1098,248 @@ def bench_saturation() -> dict:
     }
 
 
+def _multichip_run() -> dict:
+    """Body of the multichip stage, executed where >= 4 devices exist
+    (real chips, or the forced virtual CPU mesh the stage wrapper
+    re-execs into).
+
+    Three measurements, all on PRODUCT objects:
+
+    - e2e OTLP-bytes→device-state ingest (`Generator.push_otlp`, sched
+      coalescer on — the production path) single-device vs mesh-resident
+      (series_shards = N): the headline scaling ratio.
+    - device-update-only scaling (pre-staged arrays through the fused
+      update): the device-state leg in isolation — on a CPU host the e2e
+      ratio is bounded by the Python staging share and by PHYSICAL
+      cores, so both numbers plus the core count are recorded and the
+      accept gate scales its target to min(N, cores) off-TPU (the raw
+      0.75*N ISSUE target applies on a real N-chip mesh).
+    - bit-identity: collect() across series_shards {1,2,4} must be
+      byte-equal (the serving-mesh guarantee), mesh-vs-single calls
+      counts exactly equal, zero steady-state recompiles in the mesh arm.
+    """
+    import statistics
+
+    import jax
+
+    from tempo_tpu import sched
+    from tempo_tpu.generator.generator import Generator
+    from tempo_tpu.generator.instance import GeneratorConfig
+    from tempo_tpu.obs.jaxruntime import JIT_COMPILES
+    from tempo_tpu.overrides import Overrides
+    from tempo_tpu.parallel import serving
+
+    n_dev = len(jax.devices())
+    n_spans = 8192
+    payload = _make_otlp_payload(n_spans)
+    iters = 10
+
+    def fresh_gen() -> Generator:
+        cfg = GeneratorConfig(processors=("span-metrics",))
+        cfg.registry.disable_collection = True
+        # the payload is built ONCE but the arms run minutes apart: the
+        # generator's ±30s ingestion slack would filter a drifting
+        # subset of spans per arm and break every cross-arm bit-identity
+        # comparison (flaked exactly that way under CPU contention)
+        cfg.ingestion_time_range_slack_s = 0
+        return Generator(cfg, overrides=Overrides())
+
+    def snap_calls(gen) -> dict:
+        proc = gen.instance("bench").processors["span-metrics"]
+        calls = np.asarray(proc.calls.state.values)
+        return {proc.calls.labels_of(int(s)): float(calls[int(s)])
+                for s in proc.calls.table.active_slots()}
+
+    def e2e_arm(mesh_cfg):
+        serving.reset()
+        sched.reset()
+        if mesh_cfg is not None:
+            serving.configure(mesh_cfg)
+        sc = sched.configure(sched.SchedConfig(pipeline_depth=2,
+                                               max_batch_rows=2 * n_spans))
+        gen = fresh_gen()
+        gen.push_otlp("bench", payload)      # warm: compile + interning
+        sched.flush()
+        proc = gen.instance("bench").processors["span-metrics"]
+
+        def compile_count():
+            return (JIT_COMPILES.value(("spanmetrics_fused_update",))
+                    + JIT_COMPILES.value(("spanmetrics_fused_update_mesh",)))
+
+        # deterministic warmup of both merge shapes (single push and the
+        # two-push chunk) — all-padding batches are no-op updates, so
+        # tracing through the real dispatch closures leaves state intact
+        for b in (n_spans, 2 * n_spans):
+            mat = np.zeros((4, b), np.float32)
+            mat[0] = -1.0
+            if proc._mesh is not None:
+                proc._sched_dispatch_sharded_packed(mat)
+            else:
+                proc._sched_dispatch_packed(mat)
+        compiles0 = compile_count()
+        t0 = time.time()
+        for _ in range(iters):
+            gen.push_otlp("bench", payload)
+        sched.flush()
+        proc.drain_pipeline()
+        jax.block_until_ready(proc.calls.state.values)
+        dt = time.time() - t0
+        compiles = compile_count() - compiles0
+        derrs = sc.dispatch_errors
+        state = snap_calls(gen)
+        sched.reset()
+        serving.reset()
+        return iters * n_spans / dt, state, compiles, derrs
+
+    def update_arm(mesh_cfg):
+        """Device leg only: one pre-staged batch through the fused
+        update, donated, no host staging in the clock."""
+        from tempo_tpu.generator.processors.spanmetrics import (
+            SpanMetricsConfig, SpanMetricsProcessor)
+        from tempo_tpu.registry import ManagedRegistry, RegistryOverrides
+
+        serving.reset()
+        if mesh_cfg is not None:
+            serving.configure(mesh_cfg)
+        reg = ManagedRegistry("b", RegistryOverrides(max_active_series=4096),
+                              now=lambda: 1000.0)
+        proc = SpanMetricsProcessor(reg, SpanMetricsConfig())
+        rng = np.random.default_rng(0)
+        rows = 16384
+        slots = rng.integers(0, 4096, rows).astype(np.int32)
+        dur = rng.lognormal(-3, 1.0, rows).astype(np.float32)
+        sizes = rng.integers(100, 1000, rows).astype(np.float32)
+        ones = np.ones(rows, np.float32)
+        sm = proc._serving_mesh()
+
+        def one():
+            if sm is not None:
+                proc._mesh_update(sm, slots, dur, sizes, ones)
+            else:
+                from tempo_tpu.generator.processors.spanmetrics import (
+                    _fused_update_donated)
+                with reg.state_lock:
+                    (proc.calls.state, proc.latency.state, proc.sizes.state,
+                     proc.dd) = _fused_update_donated(
+                        proc.calls.state, proc.latency.state,
+                        proc.sizes.state, proc.dd, slots, dur, sizes, ones)
+
+        one()
+        jax.block_until_ready(proc.calls.state.values)
+        reps = 30
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            one()
+        jax.block_until_ready(proc.calls.state.values)
+        dt = time.perf_counter() - t0
+        serving.reset()
+        return reps * rows / dt
+
+    mesh_cfg = serving.MeshConfig(enabled=True, devices=n_dev,
+                                  series_shards=n_dev)
+    e2e_1, e2e_m, upd_1, upd_m = [], [], [], []
+    state_1 = state_m = None
+    steady = 0
+    dispatch_errors = 0
+    for _ in range(3):
+        sps, state_1, _, derrs = e2e_arm(None)
+        e2e_1.append(sps)
+        dispatch_errors += derrs
+        sps, state_m, compiles, derrs = e2e_arm(mesh_cfg)
+        e2e_m.append(sps)
+        steady += compiles
+        dispatch_errors += derrs
+        upd_1.append(update_arm(None))
+        upd_m.append(update_arm(mesh_cfg))
+    e2e_single = statistics.median(e2e_1)
+    e2e_mesh = statistics.median(e2e_m)
+    upd_single = statistics.median(upd_1)
+    upd_mesh = statistics.median(upd_m)
+
+    # collect bit-identity across shard counts (small real pushes)
+    def collect_at(shards):
+        serving.reset()
+        serving.configure(serving.MeshConfig(enabled=True, devices=shards,
+                                             series_shards=shards))
+        gen = fresh_gen()
+        gen.push_otlp("bench", payload)
+        proc = gen.instance("bench").processors["span-metrics"]
+        if proc._mesh is None:
+            raise RuntimeError(
+                f"mesh did not engage at series_shards={shards} — "
+                "bit-identity comparison would be vacuous")
+        sched.flush()
+        out = sorted((smp.name, smp.labels, smp.value) for smp in
+                     gen.instance("bench").registry.collect(2000))
+        serving.reset()
+        return out
+
+    shard_set = [s for s in (1, 2, 4) if s <= n_dev]
+    collects = [collect_at(s) for s in shard_set]
+    collect_bitident = all(c == collects[0] for c in collects[1:])
+
+    cores = os.cpu_count() or 1
+    e2e_speedup = e2e_mesh / e2e_single if e2e_single else 0.0
+    upd_speedup = upd_mesh / upd_single if upd_single else 0.0
+    # the ISSUE target is 0.75*N on an N-device mesh, and that is the
+    # gate whenever the devices are REAL accelerators; only a virtual
+    # CPU mesh — which cannot exceed its physical core count — caps the
+    # effective target at min(N, cores)
+    on_cpu = jax.devices()[0].platform == "cpu"
+    effective_target = 0.75 * (min(n_dev, cores) if on_cpu else n_dev)
+    return {
+        "multichip_devices": n_dev,
+        "multichip_host_cores": cores,
+        "multichip_e2e_spans_per_sec_single": round(e2e_single, 1),
+        "multichip_e2e_spans_per_sec_mesh": round(e2e_mesh, 1),
+        "multichip_e2e_speedup_x": round(e2e_speedup, 3),
+        "multichip_update_spans_per_sec_single": round(upd_single, 1),
+        "multichip_update_spans_per_sec_mesh": round(upd_mesh, 1),
+        "multichip_update_speedup_x": round(upd_speedup, 3),
+        "multichip_target_x": round(0.75 * n_dev, 2),
+        "multichip_effective_target_x": round(effective_target, 2),
+        "multichip_steady_state_compiles": steady,
+        "multichip_dispatch_errors": dispatch_errors,
+        "multichip_counts_bitident": bool(state_1 == state_m),
+        "multichip_collect_bitident_shards": bool(collect_bitident),
+        # the gate is the ISSUE's E2E criterion — the update-only leg is
+        # a diagnostic (it isolates the device side when e2e misses: a
+        # scaling update leg + flat e2e means host staging is the wall)
+        "multichip_accept_ok": bool(
+            e2e_speedup >= effective_target
+            and steady == 0 and dispatch_errors == 0
+            and state_1 == state_m and collect_bitident),
+    }
+
+
+def bench_multichip() -> dict:
+    """Mesh-resident serving scaling (ISSUE 7). The stage needs >= 4
+    devices: uses the real accelerators when the child landed on a
+    >=4-chip host, otherwise re-execs into a forced 4-virtual-device CPU
+    mesh (jax is already initialized single-device in this child, so the
+    flag cannot be applied in-process)."""
+    import jax
+
+    n_want = 4
+    devs = jax.devices()
+    if len(devs) >= n_want and devs[0].platform != "cpu":
+        return _multichip_run()
+    env = _cpu_env(dict(os.environ))
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(
+        flags + [f"--xla_force_host_platform_device_count={n_want}"]).strip()
+    out, err = _run_child(["--multichip-run"], env, STAGE_TIMEOUT_S * 0.9)
+    if out is None:
+        raise RuntimeError(f"multichip child failed: {err}")
+    return out
+
+
 # --- orchestrator ----------------------------------------------------------
 
 STAGES = {"e2e": bench_e2e_ingest, "kernel": bench_kernel,
           "query": bench_query, "obs": bench_obs, "sched": bench_sched,
-          "saturation": bench_saturation}
+          "saturation": bench_saturation, "multichip": bench_multichip}
 
 
 def _cpu_env(env: dict) -> dict:
@@ -1174,6 +1411,11 @@ def main() -> int:
         assert float(x[0, 0]) == 4.0
         print(json.dumps({"platform": d.platform,
                           "device": str(d)}))
+        return 0
+    if "--multichip-run" in sys.argv:
+        # grandchild of the multichip stage: jax comes up HERE with the
+        # forced virtual-device flags already in the environment
+        print(json.dumps(_multichip_run()))
         return 0
     for name, fn in STAGES.items():
         if f"--stage={name}" in sys.argv:
@@ -1439,6 +1681,27 @@ def main() -> int:
             "saturation_p99_rel_err_pct"),
         "saturation_off_bitident": results.get("saturation_off_bitident"),
         "saturation_accept_ok": results.get("saturation_accept_ok"),
+        # mesh-resident serving (ISSUE 7): e2e + device-update scaling
+        # on an N-device mesh, shard-count bit-identity, recompiles
+        "multichip_devices": results.get("multichip_devices"),
+        "multichip_host_cores": results.get("multichip_host_cores"),
+        "multichip_e2e_spans_per_sec_single": results.get(
+            "multichip_e2e_spans_per_sec_single"),
+        "multichip_e2e_spans_per_sec_mesh": results.get(
+            "multichip_e2e_spans_per_sec_mesh"),
+        "multichip_e2e_speedup_x": results.get("multichip_e2e_speedup_x"),
+        "multichip_update_speedup_x": results.get(
+            "multichip_update_speedup_x"),
+        "multichip_target_x": results.get("multichip_target_x"),
+        "multichip_effective_target_x": results.get(
+            "multichip_effective_target_x"),
+        "multichip_steady_state_compiles": results.get(
+            "multichip_steady_state_compiles"),
+        "multichip_counts_bitident": results.get(
+            "multichip_counts_bitident"),
+        "multichip_collect_bitident_shards": results.get(
+            "multichip_collect_bitident_shards"),
+        "multichip_accept_ok": results.get("multichip_accept_ok"),
     }
     if errors:
         extra["errors"] = errors

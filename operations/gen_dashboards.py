@@ -213,6 +213,15 @@ def dashboards() -> dict[str, dict]:
                 p("Sampled spans dropped /s",
                   'sum(rate(tempo_discarded_spans_total{'
                   'reason="sampled"}[5m]))'),
+                # serving mesh (runbook "Serving on a mesh"): per-shard
+                # window fill of the mesh-coalesced fused dispatch — a
+                # persistently cold tail shard means batch windows close
+                # under-full for this mesh width
+                p("Mesh shard occupancy p50 (write path)",
+                  "histogram_quantile(0.5, sum(rate("
+                  "tempo_sched_batch_occupancy_ratio_bucket"
+                  '{shard!=""}[5m])) by (le, shard))',
+                  unit="percentunit", legend="shard {{shard}}"),
             ]),
         "tempo-tpu-resources.json": dash(
             "Tempo-TPU / Resources",

@@ -601,8 +601,19 @@ class Handler(BaseHTTPRequestHandler):
             # "Surviving overload")
             "ingest_keep_fraction": sc.keep_fraction()
             if sc is not None else None,
+            # serving mesh (runbook "Serving on a mesh"): None =
+            # single-device serving
+            "mesh": self._mesh_status(),
         }
         self._reply(200, _json_bytes(body))
+
+    def _mesh_status(self) -> "dict | None":
+        from tempo_tpu.parallel import serving
+        sm = serving.active()
+        if sm is None:
+            return None
+        return {"devices": sm.n_devices, "data_shards": sm.data_shards,
+                "series_shards": sm.series_shards}
 
     def _debug_threads(self) -> None:
         """All thread stacks — the pprof goroutine-dump analog (the

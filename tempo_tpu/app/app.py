@@ -190,6 +190,12 @@ class App:
         # that dispatches kernels is constructed
         from tempo_tpu import sched
         self.sched = sched.configure(self.cfg.sched)
+        # the serving mesh is process-wide for the same reason: every
+        # target's kernels (generator registry updates, tempodb read
+        # plane) consult it; None when `mesh.enabled` is off or the
+        # shape doesn't fit the visible devices (warned, never fatal)
+        from tempo_tpu.parallel import serving
+        self.mesh = serving.configure(self.cfg.mesh)
         self._init_backend()
         self._init_bus()
         if OVERRIDES in mods:
@@ -293,7 +299,13 @@ class App:
             reader = CachingReader(reader, self.cache_provider)
         self.db = TempoDB(reader, self.backend, TempoDBConfig(
             compactor=self.cfg.compactor,
-            pool_workers=self.cfg.storage.pool_workers),
+            pool_workers=self.cfg.storage.pool_workers,
+            # mesh mode: the read plane adopts the serving mesh
+            # data-major — BlockScanPlane kernels run SPMD over 'data'
+            # with XLA-inserted grid reduces (the in-mesh combine of the
+            # backend-job leg)
+            plane_mesh=self.mesh.plane_mesh
+            if getattr(self, "mesh", None) is not None else None),
             registry=self.obs)
 
     def _iid(self, kind: str) -> str:

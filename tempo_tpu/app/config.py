@@ -23,6 +23,7 @@ from tempo_tpu.generator.processors.localblocks import LocalBlocksConfig
 from tempo_tpu.ingester.ingester import IngesterConfig
 from tempo_tpu.ingester.instance import InstanceConfig
 from tempo_tpu.overrides.limits import Limits
+from tempo_tpu.parallel.serving import MeshConfig
 from tempo_tpu.querier.querier import QuerierConfig
 from tempo_tpu.sched import SchedConfig
 
@@ -123,6 +124,12 @@ class Config:
     # micro-batching of kernel dispatch across the write and read paths,
     # default on; `sched.enabled: false` restores direct dispatch
     sched: SchedConfig = dataclasses.field(default_factory=SchedConfig)
+    # serving mesh (tempo_tpu.parallel.serving): registry/sketch state
+    # sharded over 'series' as donated device buffers, coalesced batch
+    # windows dispatched once per mesh via shard_map, read plane sharded
+    # data-major. Default off (single device) — enable on multi-chip
+    # hosts; see runbook "Serving on a mesh"
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     overrides_defaults: Limits = dataclasses.field(default_factory=Limits)
     per_tenant_override_config: str = ""   # runtime-config file path
     compaction_interval_s: float = 30.0
@@ -168,6 +175,7 @@ class Config:
                 warnings.append("sched.sampling_min_fraction must be in "
                                 "(0, 1]: 0 would drop every non-forced span "
                                 "at saturation")
+        warnings.extend(self.mesh.check())
         if self.distributor.jaeger_agent_port and \
                 self.distributor.jaeger_agent_host in ("", "0.0.0.0", "::") \
                 and not self.distributor.jaeger_agent_allow_wildcard:

@@ -684,7 +684,12 @@ class Frontend:
                     "clip_end_ns": cutoff_ns},
                 cache=(qr_key, _encode_series, _decode_series))
         self._record_op("metrics", tenant, self.now() - t0, nbytes)
-        return comb.final(req)
+        # the cross-shard/cross-job fold happens here (lazily): on the
+        # serving mesh, count-exact kinds collapse into one in-mesh
+        # reduce (see SeriesCombiner) — stage-timed so qlog shows where
+        # combine cost went
+        with querystats.stage("combine"):
+            return comb.final(req)
 
     def decode_job_result(self, spec: dict, result):
         """Decode a remote worker's JSON job result back into the objects

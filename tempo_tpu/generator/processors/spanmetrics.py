@@ -158,6 +158,12 @@ class SpanMetricsProcessor:
         # double-buffered staging ring (generator/pipeline.py), created
         # lazily when the scheduler route is live
         self._pipe = None
+        # serving mesh (tempo_tpu.parallel.serving): resolved once at
+        # first push; when active, this processor's state lives sharded
+        # over 'series' as donated device buffers and fused updates go
+        # through the single shard_map dispatch
+        self._mesh = None
+        self._mesh_checked = False
 
     def name(self) -> str:
         return "span-metrics"
@@ -173,6 +179,126 @@ class SpanMetricsProcessor:
         from tempo_tpu import sched as sched_mod
         sc = sched_mod.scheduler()
         return sc if sc is not None and sc.cfg.enabled else None
+
+    # -- serving-mesh route (tempo_tpu.parallel.serving) -------------------
+
+    def _serving_mesh(self):
+        """The process serving mesh this processor's state lives on, or
+        None (single-device dispatch). Resolved ONCE at first use: the
+        placement rebinds live state onto 'series'-sharded buffers under
+        the state_lock, and the processor stays on that mesh for its
+        lifetime (reconfiguring the process mesh does not migrate
+        already-placed tenants)."""
+        if self._mesh_checked:
+            return self._mesh
+        from tempo_tpu.parallel import serving
+        sm = serving.active()
+        if sm is not None:
+            with self.registry.state_lock:
+                if not serving.place_spanmetrics_state(self, sm):
+                    sm = None
+        self._mesh = sm
+        self._mesh_checked = True
+        return sm
+
+    def _mesh_fused_step(self, sm, packed: bool = False):
+        dd = self.dd
+        return sm.serving_step(
+            tuple(self.latency.state.edges),
+            dd.gamma if dd is not None else sketches.dd_params(0.01)[0],
+            dd.min_value if dd is not None else 1e-9,
+            self.calls.table.capacity,
+            dd.counts.shape[0] if dd is not None else 0,
+            packed=packed)
+
+    def _mesh_step_rebind(self, sm, step, batch) -> None:
+        """Run one sharded donating step over the live state and rebind
+        — the mesh twin of the single-device state_lock discipline:
+        donation deletes the old shards at dispatch for any concurrent
+        reader, so the whole call+rebind sits under the lock."""
+        with self.registry.state_lock:
+            cs, hs, zs, dd = (self.calls.state, self.latency.state,
+                              self.sizes.state, self.dd)
+            if getattr(cs.values, "sharding", None) != sm.series_1d:
+                # a stale-series purge's eager zero_slots may have moved
+                # the state off its mesh placement; re-place before the
+                # donating sharded dispatch (rare — eviction cadence)
+                from tempo_tpu.parallel import serving
+                serving.place_spanmetrics_state(self, sm)
+                cs, hs, zs, dd = (self.calls.state, self.latency.state,
+                                  self.sizes.state, self.dd)
+            if dd is not None:
+                out = step(cs.values, hs.bucket_counts, hs.sums, hs.counts,
+                           zs.values, dd.counts, dd.zeros, *batch)
+                self.dd = sketches.DDSketch(out[5], out[6], dd.gamma,
+                                            dd.min_value)
+            else:
+                out = step(cs.values, hs.bucket_counts, hs.sums, hs.counts,
+                           zs.values, *batch)
+            self.calls.state = rm.CounterState(out[0])
+            self.latency.state = rm.HistogramState(out[1], out[2], out[3],
+                                                   hs.edges)
+            self.sizes.state = rm.CounterState(out[4])
+
+    def _mesh_update(self, sm, slots, dur_s, sizes, weights) -> None:
+        """One fused update on the serving mesh: the whole padded batch
+        rides ONE `shard_map` dispatch — span rows split over 'data',
+        each 'series' shard scatter-updates only the slots it owns, and
+        the state buffers (sharded, device-resident) are DONATED exactly
+        like the single-device fast paths. Below the 2^24 capacity gate
+        the batch ships as one packed [4, n] f32 matrix (single H2D,
+        like the packed push paths); above it, per-role vectors."""
+        n = len(slots)
+        if self.calls.table.capacity < (1 << 24):
+            mat = np.empty((4, n), np.float32)
+            mat[0] = slots
+            mat[1] = dur_s
+            mat[2] = sizes
+            mat[3] = weights
+            self._mesh_dispatch_packed(sm, mat)
+            return
+        d = sm.data_shards
+        if n % d:
+            # batch must split evenly over 'data' (the sched coalescer
+            # aligns its buckets; direct pushes are pow-2 padded already,
+            # this covers odd hand-built batches)
+            pad = d - n % d
+            slots = np.concatenate([slots, np.full(pad, -1, np.int32)])
+            dur_s = np.concatenate([dur_s, np.zeros(pad, np.float32)])
+            sizes = np.concatenate([sizes, np.zeros(pad, np.float32)])
+            weights = np.concatenate([weights, np.zeros(pad, np.float32)])
+        step = self._mesh_fused_step(sm)
+        batch = sm.put_batch(
+            np.ascontiguousarray(slots, np.int32),
+            np.asarray(dur_s, np.float32), np.asarray(sizes, np.float32),
+            np.asarray(weights, np.float32))
+        self._mesh_step_rebind(sm, step, batch)
+
+    def _mesh_dispatch_packed(self, sm, mat: np.ndarray) -> None:
+        """Packed mesh dispatch: ONE [4, bucket] f32 H2D (columns
+        sharded over 'data'), one shard_map launch. Slot ids ride f32
+        exactly under the capacity < 2^24 gate the callers hold."""
+        d = sm.data_shards
+        if mat.shape[1] % d:
+            pad = d - mat.shape[1] % d
+            ext = np.zeros((4, pad), np.float32)
+            ext[0] = -1.0
+            mat = np.concatenate([mat, ext], axis=1)
+        step = self._mesh_fused_step(sm, packed=True)
+        self._mesh_step_rebind(sm, step, (sm.put_packed(mat),))
+
+    def _sched_dispatch_sharded(self, slots, dur_s, sizes, weights) -> None:
+        """Merged-batch dispatch on the scheduler worker, serving-mesh
+        form (capacity >= 2^24 — per-role vectors): the coalescer
+        aligned the bucket to the 'data' shard count, so the whole
+        window lands in one shard_map launch."""
+        self._mesh_update(self._mesh, slots, dur_s, sizes, weights)
+
+    def _sched_dispatch_sharded_packed(self, mat) -> None:
+        """Packed-coalescer mesh dispatch: the merged window arrives as
+        the coalescer's ONE [4, bucket] f32 matrix — a single H2D feeds
+        every shard via one shard_map launch."""
+        self._mesh_dispatch_packed(self._mesh, mat)
 
     def _pipeline(self, sc):
         """The staging pipeline riding scheduler `sc`, or None when the
@@ -220,24 +346,30 @@ class SpanMetricsProcessor:
 
     def _submit_rows(self, sc, slots: np.ndarray, dur_s: np.ndarray,
                      sizes: np.ndarray, weights: np.ndarray):
-        arrays = (np.asarray(slots, np.float32 if
-                             self.calls.table.capacity < (1 << 24)
-                             else np.int32),
+        # slot ids round-trip f32 exactly below 2^24: ride the packed
+        # single-transfer dispatch (one [4, bucket] H2D per merged
+        # window — same gate as the direct packed push path). On the
+        # serving mesh the coalescer additionally aligns the bucket to
+        # the 'data' shard count so ONE shard_map launch feeds every
+        # device.
+        sm = self._serving_mesh()
+        packed = self.calls.table.capacity < (1 << 24)
+        if sm is not None:
+            dispatch = self._sched_dispatch_sharded_packed if packed \
+                else self._sched_dispatch_sharded
+        else:
+            dispatch = self._sched_dispatch_packed if packed \
+                else self._sched_dispatch
+        arrays = (np.asarray(slots, np.float32 if packed else np.int32),
                   np.asarray(dur_s, np.float32),
                   np.asarray(sizes, np.float32),
                   np.asarray(weights, np.float32))
-        if self.calls.table.capacity < (1 << 24):
-            # slot ids round-trip f32 exactly below 2^24: ride the packed
-            # single-transfer dispatch (same gate as the direct packed
-            # push path)
-            return sc.submit_rows("spanmetrics_fused_update", self, arrays,
-                                  len(slots), self._sched_dispatch_packed,
-                                  pads=(-1.0, 0.0, 0.0, 0.0),
-                                  tenant=self.registry.tenant, pack=True)
-        return sc.submit_rows("spanmetrics_fused_update", self, arrays,
-                              len(slots), self._sched_dispatch,
-                              pads=(-1, 0.0, 0.0, 0.0),
-                              tenant=self.registry.tenant)
+        return sc.submit_rows(
+            "spanmetrics_fused_update", self, arrays, len(slots), dispatch,
+            pads=(-1.0, 0.0, 0.0, 0.0) if packed else (-1, 0.0, 0.0, 0.0),
+            tenant=self.registry.tenant, pack=packed,
+            align=sm.data_shards if sm is not None else 1,
+            shards=sm.data_shards if sm is not None else 0)
 
     def needs_attr_columns(self) -> tuple[bool, bool]:
         """(span_attrs, res_attrs) this processor reads — owned HERE so a
@@ -372,6 +504,19 @@ class SpanMetricsProcessor:
                 else:
                     pipe.release(bufs)
             return n_valid, n_filtered
+        sm = self._serving_mesh()
+        if sm is not None:
+            # mesh-resident direct path (no scheduler): the padded
+            # staging arrays ride one shard_map dispatch; weights default
+            # to host ones (the batch upload is sharded per push anyway)
+            wfull = np.ones(len(slots), np.float32)
+            if weights is not None:
+                wfull[:n] = weights[:n]
+            self._mesh_update(sm, slots, packed[1], packed[2], wfull)
+            self.calls.note_exemplars(slots[:n], trace_ids, packed[1],
+                                      int(now * 1000))
+            self.latency.exemplars = self.calls.exemplars
+            return n_valid, n_filtered
         cap = len(slots)
         ones = self._ones_cache.get(cap)
         if ones is None:
@@ -477,12 +622,17 @@ class SpanMetricsProcessor:
             self._submit_rows(sc, slots, dur_s,
                               span_sizes.astype(np.float32), weights)
         else:
-            with self.registry.state_lock:
-                (self.calls.state, self.latency.state, self.sizes.state,
-                 self.dd) = _fused_update_donated(
-                    self.calls.state, self.latency.state, self.sizes.state,
-                    self.dd, slots, dur_s, span_sizes.astype(np.float32),
-                    weights)
+            sm = self._serving_mesh()
+            if sm is not None:
+                self._mesh_update(sm, slots, dur_s,
+                                  span_sizes.astype(np.float32), weights)
+            else:
+                with self.registry.state_lock:
+                    (self.calls.state, self.latency.state, self.sizes.state,
+                     self.dd) = _fused_update_donated(
+                        self.calls.state, self.latency.state,
+                        self.sizes.state, self.dd, slots, dur_s,
+                        span_sizes.astype(np.float32), weights)
         ts_ms = int(self.registry.now() * 1000)
         self.calls.note_exemplars(slots, sb.trace_id, dur_s, ts_ms)
         self.latency.exemplars = self.calls.exemplars

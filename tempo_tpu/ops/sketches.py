@@ -190,6 +190,17 @@ def dd_update(state: DDSketch, series_ids: jax.Array, values: jax.Array,
     return dataclasses.replace(state, counts=counts, zeros=zeros)
 
 
+def dd_place(state: DDSketch, sharding_1d, sharding_2d) -> DDSketch:
+    """Re-place the sketch plane's device arrays (serving-mesh mode: the
+    series dim sharded over 'series'). The plane is the largest state a
+    processor owns (~85MB/tenant at default capacity), so this is the
+    split that actually moves the per-device HBM needle. Idempotent."""
+    return dataclasses.replace(
+        state,
+        counts=jax.device_put(state.counts, sharding_2d),
+        zeros=jax.device_put(state.zeros, sharding_1d))
+
+
 def dd_merge(a: DDSketch, b: DDSketch) -> DDSketch:
     return dataclasses.replace(a, counts=a.counts + b.counts, zeros=a.zeros + b.zeros)
 

@@ -11,9 +11,13 @@ from tempo_tpu.parallel.mesh import (
     make_mesh,
     make_multihost_mesh,
     merge_sketch_states,
+    mesh_fingerprint,
     sharded_query_range_step,
+    sharded_serving_step,
     sharded_spanmetrics_step,
     shard_batch_arrays,
+    validate_mesh_shape,
 )
+from tempo_tpu.parallel.serving import MeshConfig, ServingMesh
 
 __all__ = [k for k in dir() if not k.startswith("_")]

@@ -32,12 +32,32 @@ from tempo_tpu.ops import sketches
 from tempo_tpu.registry import metrics as rm
 
 
+def validate_mesh_shape(n_devices: int, series_shards: int) -> list[str]:
+    """Config-style problem list for a proposed mesh shape (empty = ok).
+    Shared by `config.check()` (the `mesh:` block warnings) and the mesh
+    constructors, so a bad shard count surfaces as a standard config
+    warning at load time instead of an AssertionError at serve time."""
+    problems = []
+    if series_shards < 1:
+        problems.append(f"mesh series_shards must be >= 1 "
+                        f"(got {series_shards})")
+    elif series_shards > n_devices:
+        problems.append(f"mesh series_shards ({series_shards}) exceeds the "
+                        f"device count ({n_devices}): shards <= devices")
+    elif n_devices % series_shards:
+        problems.append(f"mesh series_shards ({series_shards}) must divide "
+                        f"the device count ({n_devices})")
+    return problems
+
+
 def make_mesh(n_devices: int | None = None, series_shards: int = 1) -> Mesh:
     """2D mesh ('data', 'series'). series_shards must divide device count."""
     devs = jax.devices()
     n = len(devs) if n_devices is None else n_devices
     devs = np.array(devs[:n])
-    assert n % series_shards == 0, (n, series_shards)
+    problems = validate_mesh_shape(n, series_shards)
+    if problems:
+        raise ValueError("; ".join(problems))
     return Mesh(devs.reshape(n // series_shards, series_shards), ("data", "series"))
 
 
@@ -54,11 +74,22 @@ def make_multihost_mesh(series_shards: int = 1) -> Mesh:
     from jax.experimental import mesh_utils
 
     per_host = jax.local_device_count()
-    assert per_host % series_shards == 0, (per_host, series_shards)
+    problems = validate_mesh_shape(per_host, series_shards)
+    if problems:
+        raise ValueError("; ".join(problems))
     devs = mesh_utils.create_hybrid_device_mesh(
         mesh_shape=(per_host // series_shards, series_shards),
         dcn_mesh_shape=(jax.process_count(), 1))
     return Mesh(devs, ("data", "series"))
+
+
+def mesh_fingerprint(mesh: Mesh) -> tuple:
+    """Value identity for a mesh, safe to key caches on. `id(mesh)` is NOT:
+    ids are reused after garbage collection, so a cache keyed on it can
+    alias a dead mesh's jitted step onto a brand-new mesh with a
+    different device layout."""
+    return (tuple(mesh.shape.items()),
+            tuple(d.id for d in mesh.devices.flat))
 
 
 def shard_batch_arrays(mesh: Mesh, arrays: dict) -> dict:
@@ -126,6 +157,135 @@ def sharded_spanmetrics_step(mesh: Mesh, edges: tuple, gamma: float,
                     in_specs=state_specs + batch_specs,
                     out_specs=state_specs)
     return jax.jit(fn)
+
+
+def sharded_serving_step(mesh: Mesh, edges: tuple, gamma: float,
+                         min_value: float, capacity: int, dd_rows: int,
+                         packed: bool = False):
+    """The MESH-RESIDENT serving twin of `sharded_spanmetrics_step`:
+    the fused spanmetrics update a `SpanMetricsProcessor` dispatches when
+    the process serving mesh is on (`tempo_tpu.parallel.serving`).
+
+    Differences from the dryrun step above:
+
+    - **Donated**: the state arrays (the ~90MB fused plane at default
+      capacity) are donated like the single-device fast paths — one
+      live copy per shard, no per-push state copy. Callers hold the
+      registry `state_lock` across dispatch + rebind, same discipline as
+      `_fused_update_donated`.
+    - **Sketch plane capacity**: the DDSketch plane may be SMALLER than
+      the series table (`sketch_max_series < max_active_series`), so its
+      slot→shard mapping uses its own shard capacity; slots beyond the
+      plane are masked, matching `_fused_update_impl`. `dd_rows=0`
+      builds a sketchless step (no dd arguments at all).
+    - **Bit-stability across series shard counts**: each series shard
+      scatters the SAME batch rows in the same order into the slots it
+      owns (others drop), so per-slot float accumulation order is
+      independent of `series_shards` — collect() is bit-identical at
+      every shard count as long as the data axis stays fixed. (Changing
+      DATA shards changes psum association: close, not bit-equal.)
+    - **Packed form** (`packed=True`): the batch arrives as ONE
+      [4, bucket] f32 matrix (slots, dur_s, sizes, weights) sharded
+      over 'data' on its column axis — a single H2D per dispatch, the
+      mesh twin of `_fused_update_packed4`. Slot ids ride f32 exactly
+      under the caller's capacity < 2^24 gate.
+
+    Returns jit(fn(states..., slots, dur_s, sizes, weights) -> states)
+    — or jit(fn(states..., packed_matrix) -> states) when `packed`.
+    """
+    n_series_shards = mesh.shape["series"]
+    data_shards = mesh.shape["data"]
+    if capacity % n_series_shards or (dd_rows and dd_rows % n_series_shards):
+        raise ValueError(
+            f"serving mesh: state capacities ({capacity}, dd {dd_rows}) "
+            f"must divide by series_shards ({n_series_shards})")
+    shard_cap = capacity // n_series_shards
+    dd_shard = dd_rows // n_series_shards if dd_rows else 0
+
+    def step(calls_v, h_buckets, h_sums, h_counts, size_v, *rest):
+        if packed:
+            dd_counts, dd_zeros = rest[:2] if dd_shard else (None, None)
+            mat = rest[-1]
+            slots = mat[0].astype(jnp.int32)
+            dur_s, sizes, weights = mat[1], mat[2], mat[3]
+        elif dd_shard:
+            dd_counts, dd_zeros, slots, dur_s, sizes, weights = rest
+        else:
+            slots, dur_s, sizes, weights = rest
+        my_shard = jax.lax.axis_index("series")
+        owner = jnp.where(slots >= 0, slots // shard_cap, -1)
+        local = jnp.where(owner == my_shard, slots - my_shard * shard_cap, -1)
+        if dd_shard:
+            # the sketch plane's OWN slot→shard mapping (it may be a
+            # strict prefix of the series table)
+            dd_keep = (slots >= 0) & (slots < dd_rows) & \
+                (slots // dd_shard == my_shard)
+            local_dd = jnp.where(dd_keep, slots - my_shard * dd_shard, 0)
+        if data_shards == 1:
+            # series-only layout (the serving default): each shard owns
+            # its slots OUTRIGHT, so the scatter lands straight in the
+            # donated base state — no zero-delta staging, no full-state
+            # add, no collective at all. This is also what keeps the
+            # update cost per dispatch O(batch + touched rows) instead
+            # of O(state): the delta+psum form below walks the whole
+            # ~90MB fused plane every dispatch.
+            calls = rm.counter_update(rm.CounterState(calls_v), local,
+                                      weights)
+            hist = rm.histogram_update(
+                rm.HistogramState(h_buckets, h_sums, h_counts, edges),
+                local, dur_s, weights)
+            size_c = rm.counter_update(rm.CounterState(size_v), local,
+                                       sizes * weights)
+            out = (calls.values, hist.bucket_counts, hist.sums, hist.counts,
+                   size_c.values)
+            if dd_shard:
+                dd = sketches.dd_update(
+                    sketches.DDSketch(dd_counts, dd_zeros, gamma, min_value),
+                    local_dd, dur_s, mask=dd_keep, weights=weights)
+                out += (dd.counts, dd.zeros)
+            return out
+        # data-parallel layout: deltas from ZERO state so only the delta
+        # psums over 'data' (the base state is replicated across data
+        # shards; summing it would multiply prior state every step)
+        z = jnp.zeros_like
+        calls_d = rm.counter_update(rm.CounterState(z(calls_v)), local,
+                                    weights)
+        hist_d = rm.histogram_update(
+            rm.HistogramState(z(h_buckets), z(h_sums), z(h_counts), edges),
+            local, dur_s, weights)
+        size_d = rm.counter_update(rm.CounterState(z(size_v)), local,
+                                   sizes * weights)
+        deltas = [calls_d.values, hist_d.bucket_counts, hist_d.sums,
+                  hist_d.counts, size_d.values]
+        base = [calls_v, h_buckets, h_sums, h_counts, size_v]
+        if dd_shard:
+            dd_d = sketches.dd_update(
+                sketches.DDSketch(z(dd_counts), z(dd_zeros), gamma,
+                                  min_value),
+                local_dd, dur_s, mask=dd_keep, weights=weights)
+            deltas += [dd_d.counts, dd_d.zeros]
+            base += [dd_counts, dd_zeros]
+        return tuple(b + jax.lax.psum(d, "data")
+                     for b, d in zip(base, deltas))
+
+    n_states = 7 if dd_shard else 5
+    state_specs = (P("series"), P("series", None), P("series"), P("series"),
+                   P("series"))
+    if dd_shard:
+        state_specs += (P("series", None), P("series"))
+    batch_specs = (P(None, "data"),) if packed else (P("data"),) * 4
+    # check_rep=False: the base-scatter branch's outputs ARE replicated
+    # over 'data' (the axis has size 1 there), but without a psum the
+    # static replication checker can't infer it
+    fn = _shard_map(step, mesh=mesh,
+                    in_specs=state_specs + batch_specs,
+                    out_specs=state_specs, check_rep=False)
+    # instrumented: the serving path's zero-steady-state-recompile gate
+    # (bench multichip stage) reads the per-fn compile counters
+    from tempo_tpu.obs.jaxruntime import instrumented_jit
+
+    return instrumented_jit(fn, name="spanmetrics_fused_update_mesh",
+                            donate_argnums=tuple(range(n_states)))
 
 
 def sharded_query_range_step(mesh: Mesh, n_buckets: int = 0):

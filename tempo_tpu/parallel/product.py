@@ -31,8 +31,13 @@ _STEP_CACHE: dict = {}
 
 def _cached_step(mesh, edges, gamma, min_value):
     """Jitted sharded step memoized per (mesh, hyperparams) — a fresh
-    shard_map per push would recompile every call."""
-    key = (id(mesh), edges, float(gamma), float(min_value))
+    shard_map per push would recompile every call. Keyed by the mesh's
+    VALUE identity (shape + device ids), never `id(mesh)`: ids are
+    reused after garbage collection, and an aliased entry would hand a
+    new mesh a jitted step compiled for a dead mesh's device layout."""
+    from tempo_tpu.parallel.mesh import mesh_fingerprint
+
+    key = (mesh_fingerprint(mesh), edges, float(gamma), float(min_value))
     fn = _STEP_CACHE.get(key)
     if fn is None:
         from tempo_tpu.parallel.mesh import sharded_spanmetrics_step

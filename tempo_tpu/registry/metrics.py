@@ -179,6 +179,23 @@ def native_histogram_update(state: NativeHistogramState, slots: jax.Array,
     )
 
 
+# -- placement ---------------------------------------------------------------
+
+def place_state(state, sharding_1d, sharding_2d):
+    """Re-place a metric state pytree's device arrays (serving-mesh mode:
+    slot dims sharded over 'series'). [S] leaves take `sharding_1d`,
+    [S, ...] leaves `sharding_2d`; static meta (histogram edges) rides
+    along untouched. Idempotent — device_put to the current sharding is
+    a no-op."""
+    import jax
+
+    def place(leaf):
+        sh = sharding_1d if getattr(leaf, "ndim", 0) == 1 else sharding_2d
+        return jax.device_put(leaf, sh)
+
+    return jax.tree.map(place, state)
+
+
 # -- eviction ----------------------------------------------------------------
 
 def zero_slots(state, slots: jax.Array):

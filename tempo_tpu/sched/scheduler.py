@@ -193,10 +193,11 @@ class _MergeGroup:
     one dispatch closure, one eventual padded tensor."""
 
     __slots__ = ("kernel", "pads", "dispatch", "jobs", "rows", "first_t",
-                 "pack")
+                 "pack", "align", "shards")
 
     def __init__(self, kernel: str, pads: tuple, dispatch: Callable,
-                 first_t: float, pack: bool = False) -> None:
+                 first_t: float, pack: bool = False, align: int = 1,
+                 shards: int = 0) -> None:
         self.kernel = kernel
         self.pads = pads
         self.dispatch = dispatch
@@ -204,6 +205,8 @@ class _MergeGroup:
         self.rows = 0
         self.first_t = first_t
         self.pack = pack
+        self.align = align
+        self.shards = shards
 
 
 class DeviceScheduler:
@@ -240,6 +243,11 @@ class DeviceScheduler:
         self.batches_total: dict[str, int] = {}
         self.coalesced_total: dict[str, int] = {}
         self.padding_waste_bytes: dict[str, int] = {}
+        # serving-mesh split of the padding waste, keyed (kernel, shard):
+        # only mesh dispatches (submits with shards set) populate it; the
+        # exposition renders it as `shard` label rows next to the
+        # non-mesh aggregate (shard="") without double counting
+        self.padding_waste_shard: dict[tuple[str, str], int] = {}
         self.bucket_warmups: dict[str, int] = {}
         self.dispatch_errors = 0
         self.occupancy_sum: dict[str, float] = {}
@@ -388,7 +396,8 @@ class DeviceScheduler:
     def submit_rows(self, kernel: str, merge_key, arrays: Sequence,
                     n_rows: int, dispatch: Callable,
                     pads: "Sequence | None" = None,
-                    tenant: str = "", pack: bool = False) -> Job:
+                    tenant: str = "", pack: bool = False,
+                    align: int = 1, shards: int = 0) -> Job:
         """Enqueue a coalescible row batch (live-ingest class).
 
         `arrays` are row-aligned host vectors (one per kernel argument
@@ -406,6 +415,15 @@ class DeviceScheduler:
         round trip (slot ids do while the series capacity is < 2^24; the
         caller owns that gate).
 
+        `align` (serving-mesh mode) rounds the merged pow-2 bucket UP to
+        a multiple of it, so the single padded window splits evenly
+        across the mesh's 'data' shards under `shard_map` — ONE dispatch
+        feeds every device instead of per-device launches. `shards` is
+        the mesh dispatch's data-shard count for observability (0 =
+        non-mesh): mesh dispatches emit one occupancy sample per shard
+        under the `shard` label, non-mesh batches keep the aggregate
+        under shard="".
+
         Never blocks and never drops data: on a saturated queue the job
         executes inline on the caller (shed, counted) — ADMISSION control
         lives at the distributor boundary, which consults
@@ -417,7 +435,7 @@ class DeviceScheduler:
                   arrays=tuple(arrays), pads=pads, n_rows=int(n_rows),
                   dispatch=dispatch, tenant=tenant)
         if not self.cfg.enabled:
-            self._run_group(_group_of(job, pack))
+            self._run_group(_group_of(job, pack, align, shards))
             return job
         with self._cond:
             depth = len(self._queues[PRIO_INGEST]) + sum(
@@ -429,7 +447,8 @@ class DeviceScheduler:
                 g = self._groups.get(merge_key)
                 if g is None:
                     g = self._groups[merge_key] = _MergeGroup(
-                        kernel, pads, dispatch, job.enqueue_t, pack=pack)
+                        kernel, pads, dispatch, job.enqueue_t, pack=pack,
+                        align=align, shards=shards)
                 g.jobs.append(job)
                 g.rows += job.n_rows
                 self.jobs_total["ingest"] += 1
@@ -444,7 +463,7 @@ class DeviceScheduler:
                     self._cond.notify_all()
                 return job
         # shed path: dispatch inline, outside the lock
-        self._run_group(_group_of(job, pack))
+        self._run_group(_group_of(job, pack, align, shards))
         return job
 
     def run(self, fn: Callable, kernel: str = "fn",
@@ -633,6 +652,10 @@ class DeviceScheduler:
             # anywhere (allocation, a bad job array, the kernel itself)
             # must land on the jobs, never escape to kill the worker
             bucket = bucket_rows(max(rows, 1), self.cfg.min_bucket_rows)
+            if g.align > 1 and bucket % g.align:
+                # serving mesh: the padded window must split evenly over
+                # the 'data' shards for the single shard_map dispatch
+                bucket = -(-bucket // g.align) * g.align
             waste = 0
             if g.pack:
                 # one row-major f32 matrix = ONE H2D for the whole batch
@@ -673,7 +696,19 @@ class DeviceScheduler:
                     self.coalesced_total.get(g.kernel, 0) + len(chunk)
                 self.padding_waste_bytes[g.kernel] = \
                     self.padding_waste_bytes.get(g.kernel, 0) + waste
-            _OCCUPANCY.observe(occ, (g.kernel,))
+                if g.shards:
+                    self._note_shard_stats(g, bucket, rows, waste)
+            if g.shards:
+                # mesh mode: one occupancy sample PER 'data' shard — rows
+                # pack contiguously, so the tail shard carries the
+                # padding; a persistently cold last shard means the batch
+                # window is closing under-full for this mesh width
+                per = bucket // g.shards
+                for i in range(g.shards):
+                    real = min(max(rows - i * per, 0), per)
+                    _OCCUPANCY.observe(real / per, (g.kernel, str(i)))
+            else:
+                _OCCUPANCY.observe(occ, (g.kernel, ""))
             g.dispatch(*padded)
         except BaseException as e:           # noqa: BLE001 — propagated
             err = e
@@ -682,6 +717,23 @@ class DeviceScheduler:
         for j in chunk:
             j.error = err
             j.event.set()
+
+    def _note_shard_stats(self, g: _MergeGroup, bucket: int, rows: int,
+                          waste: int) -> None:
+        """Per-'data'-shard padding split of a mesh dispatch (caller
+        holds _stats_lock). Rows pack contiguously across the shards, so
+        padding concentrates on the tail shard."""
+        pad_rows = bucket - rows
+        if pad_rows <= 0:
+            return
+        per = bucket // g.shards
+        for i in range(g.shards):
+            shard_pad = per - min(max(rows - i * per, 0), per)
+            if shard_pad:
+                key = (g.kernel, str(i))
+                self.padding_waste_shard[key] = \
+                    self.padding_waste_shard.get(key, 0) \
+                    + waste * shard_pad // pad_rows
 
     def _note_dispatch_error(self, kernel: str, e: BaseException) -> None:
         """Dispatch failures must never be silent: ingest-route jobs are
@@ -717,9 +769,10 @@ class DeviceScheduler:
         job.event.set()
 
 
-def _group_of(job: Job, pack: bool = False) -> _MergeGroup:
+def _group_of(job: Job, pack: bool = False, align: int = 1,
+              shards: int = 0) -> _MergeGroup:
     g = _MergeGroup(job.kernel, job.pads, job.dispatch, job.enqueue_t,
-                    pack=pack)
+                    pack=pack, align=align, shards=shards)
     g.jobs.append(job)
     g.rows = job.n_rows
     return g
@@ -862,12 +915,39 @@ RUNTIME.counter_func(
     help="Row jobs folded into merged batches, by kernel "
          "(coalesced/batches = jobs amortized per dispatch)",
     labels=("kernel",))
+def _padding_waste_rows():
+    """Padding waste with the serving-mesh `shard` split: per-shard rows
+    for mesh dispatches, the remaining (non-mesh) waste under shard="" —
+    the label values sum to the true per-kernel total, no double count."""
+    sc = _default
+    if sc is None:
+        return []
+    # snapshot under the stats lock: padding_waste_shard grows at
+    # dispatch time and a concurrent scrape iterating a resizing dict
+    # would raise and 500 the whole /metrics render
+    with sc._stats_lock:
+        shard_items = list(sc.padding_waste_shard.items())
+        kernel_items = list(sc.padding_waste_bytes.items())
+    out = []
+    sharded_by_kernel: dict[str, int] = {}
+    for (k, s), v in shard_items:
+        out.append(((k, s), float(v)))
+        sharded_by_kernel[k] = sharded_by_kernel.get(k, 0) + v
+    for k, v in kernel_items:
+        rest = v - sharded_by_kernel.get(k, 0)
+        if rest or k not in sharded_by_kernel:
+            out.append(((k, ""), float(max(rest, 0))))
+    return out
+
+
 RUNTIME.counter_func(
     "tempo_sched_padding_waste_bytes_total",
-    _per_kernel("padding_waste_bytes"),
+    _padding_waste_rows,
     help="Bytes of pow-2 padding dispatched beyond real rows, by kernel "
-         "(the price of the shape-bucket jit cache)",
-    labels=("kernel",))
+         "(the price of the shape-bucket jit cache); serving-mesh "
+         "dispatches additionally split by 'data' shard (non-mesh waste "
+         "keeps shard=\"\")",
+    labels=("kernel", "shard"))
 RUNTIME.counter_func(
     "tempo_sched_bucket_warmups_total", _per_kernel("bucket_warmups"),
     help="First-time (kernel, shape-bucket) combinations dispatched; "
@@ -889,8 +969,9 @@ RUNTIME.counter_func(
 _OCCUPANCY = RUNTIME.histogram(
     "tempo_sched_batch_occupancy_ratio",
     "Real rows / padded bucket rows per merged batch (the ISSUE floor "
-    "is 0.7 at steady state)",
-    labels=("kernel",),
+    "is 0.7 at steady state); serving-mesh dispatches observe one "
+    "sample per 'data' shard (non-mesh batches keep shard=\"\")",
+    labels=("kernel", "shard"),
     buckets=(0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0))
 _DISPATCH_SECONDS = RUNTIME.histogram(
     "tempo_sched_dispatch_duration_seconds",

@@ -1,0 +1,34 @@
+"""Tier-1 multichip gate: the full `dryrun_multichip` parity path runs
+on every PR via a forced virtual CPU mesh — mesh regressions surface
+here instead of only at MULTICHIP bench time (when a TPU may or may not
+be reachable)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dryrun_multichip_on_virtual_cpu_mesh():
+    """Run the dryrun CHILD directly (skip the parent's device probe —
+    this test pins the backend itself): 8 virtual CPU devices, the
+    sharded kernel steps + PRODUCT registry/tempodb parity asserts."""
+    env = dict(os.environ)
+    env["_TEMPO_TPU_DRYRUN_CHILD"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    # the axon sitecustomize hook would re-register the TPU plugin and
+    # override JAX_PLATFORMS; drop its trigger like __graft_entry__ does
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8)"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, (proc.stdout[-1000:], proc.stderr[-3000:])
+    assert "dryrun_multichip ok" in proc.stdout, proc.stdout[-1000:]
