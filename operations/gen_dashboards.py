@@ -2,7 +2,7 @@
 """Single-source dashboard generator (tempo-mixin `dashboards.libsonnet`
 analog — the reference generates its Grafana dashboards from jsonnet so
 panels and recording rules cannot drift; here one Python spec generates
-the four dashboards under operations/dashboards/, and a CI test
+the dashboards under operations/dashboards/, and a CI test
 regenerates them and fails on drift, the same guarantee without a jsonnet
 toolchain).
 
@@ -308,6 +308,66 @@ def dashboards() -> dict[str, dict]:
                 p("Frontend query sheds /s (503s) by op",
                   _rate("tempo_query_frontend_shed_total", "op"),
                   legend="{{op}}"),
+            ]),
+        "tempo-tpu-devtime.json": dash(
+            "Tempo-TPU / Device time",
+            "Device-time ledger + online dispatch cost model"
+            " (tempo_tpu/obs/devtime.py): where every device-nanosecond"
+            " goes, per-tenant attribution, and the cost-model fit that"
+            " drives scheduler auto-tuning (runbook: 'Reading the"
+            " device-time ledger' / 'Scheduler auto-tuning')",
+            [
+                p("Device seconds /s by kernel",
+                  _rate("tempo_devtime_device_seconds_total", "kernel"),
+                  legend="{{kernel}}"),
+                p("Device seconds /s by shape bucket",
+                  _rate("tempo_devtime_device_seconds_total", "bucket"),
+                  legend="bucket {{bucket}}"),
+                p("Device seconds /s by priority class",
+                  _rate("tempo_devtime_device_seconds_total", "class"),
+                  legend="{{class}}"),
+                p("Device seconds /s by tenant (top costs)",
+                  "topk(10, sum(rate("
+                  "tempo_devtime_tenant_device_seconds_total[5m]))"
+                  " by (tenant))", legend="{{tenant}}"),
+                p("Queue-wait share of device latency",
+                  "sum(rate(tempo_devtime_queue_wait_seconds_total[5m]))"
+                  " / (sum(rate(tempo_devtime_queue_wait_seconds_total"
+                  "[5m])) + sum(rate("
+                  "tempo_devtime_device_seconds_total[5m])))",
+                  unit="percentunit"),
+                p("Padding overhead (padded / submitted rows)",
+                  "sum(rate(tempo_devtime_padded_rows_total[5m]))"
+                  " by (kernel) / sum(rate("
+                  "tempo_devtime_submitted_rows_total[5m])) by (kernel)",
+                  legend="{{kernel}}"),
+                p("H2D MB/s by kernel",
+                  "sum(rate(tempo_devtime_h2d_bytes_total[5m]))"
+                  " by (kernel) / 1e6", legend="{{kernel}}"),
+                p("Cost model: fixed cost a (µs) by pair",
+                  "tempo_sched_cost_model_coeff_a_seconds * 1e6",
+                  legend="{{kernel}}/{{bucket}}"),
+                p("Cost model: per-row cost b (ns) by pair",
+                  "tempo_sched_cost_model_coeff_b_seconds_per_row * 1e9",
+                  legend="{{kernel}}/{{bucket}}"),
+                p("Cost model typical-cost error (soak gate <= 0.25)",
+                  "tempo_sched_cost_model_typical_error",
+                  unit="percentunit", legend="{{kernel}}/{{bucket}}"),
+                p("Per-sample rel error: median (jitter) + mean (stalls)",
+                  "tempo_sched_cost_model_rel_error_median",
+                  "tempo_sched_cost_model_rel_error",
+                  unit="percentunit", legend="{{kernel}}/{{bucket}}"),
+                p("Cost model staleness (s since last observation)",
+                  "tempo_sched_cost_model_age_seconds",
+                  legend="{{kernel}}/{{bucket}}"),
+                p("Ingest-visible latency p99 by kernel (tuner target)",
+                  _p99("tempo_devtime_ingest_visible_latency_seconds",
+                       "kernel"), legend="{{kernel}}"),
+                p("Auto-tuned batch window (ms) vs static",
+                  "tempo_sched_tuned_window_ms",
+                  legend="{{kernel}}"),
+                p("Tuning active (1 = cost model driving windows)",
+                  "tempo_sched_tuning_active", kind="stat"),
             ]),
     }
 

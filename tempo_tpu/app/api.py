@@ -604,8 +604,30 @@ class Handler(BaseHTTPRequestHandler):
             # serving mesh (runbook "Serving on a mesh"): None =
             # single-device serving
             "mesh": self._mesh_status(),
+            # device-time ledger totals + costliest tenants (runbook
+            # "Reading the device-time ledger"); full detail on /metrics
+            "devtime": self._devtime_status(),
+            # online dispatch cost model + tuner state (runbook
+            # "Scheduler auto-tuning")
+            "cost_model": self._cost_model_status(sc),
         }
         self._reply(200, _json_bytes(body))
+
+    def _devtime_status(self) -> dict:
+        from tempo_tpu.obs import devtime
+        return devtime.LEDGER.status()
+
+    def _cost_model_status(self, sc) -> dict:
+        from tempo_tpu.obs import devtime
+        out = {
+            "tuning": sc.cfg.tuning if sc is not None else None,
+            "tuning_active": sc.tuning_active() if sc is not None else False,
+            "pairs": devtime.COST_MODEL.status(),
+        }
+        if sc is not None and sc.cfg.tuning == "auto":
+            out["tuned_window_ms"] = {
+                k: round(ms, 3) for k, ms in sc._tuner.windows_ms()}
+        return out
 
     def _mesh_status(self) -> "dict | None":
         from tempo_tpu.parallel import serving

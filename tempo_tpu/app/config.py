@@ -166,6 +166,26 @@ class Config:
         if self.sched.pipeline_depth < 0:
             warnings.append("sched.pipeline_depth < 0: use 0 to disable "
                             "the ingest staging ring")
+        if self.sched.tuning not in ("static", "auto"):
+            warnings.append(f"sched.tuning {self.sched.tuning!r} unknown: "
+                            "use 'static' (fixed batch_window_ms) or "
+                            "'auto' (cost-model-driven windows)")
+        if self.sched.tuning == "auto":
+            if self.sched.tuning_window_min_ms <= 0 or \
+                    self.sched.tuning_window_max_ms < \
+                    self.sched.tuning_window_min_ms:
+                warnings.append("sched.tuning_window_{min,max}_ms must "
+                                "satisfy 0 < min <= max: the tuner's "
+                                "window search is clamped to this range")
+            if self.sched.tuning_window_max_ms > 100:
+                warnings.append("sched.tuning_window_max_ms > 100ms lets "
+                                "auto-tuning add that much ingest-visible "
+                                "metrics latency per batch")
+            if self.sched.tuning_interval_s <= 0:
+                warnings.append("sched.tuning_interval_s must be > 0: a "
+                                "non-positive interval refits the window "
+                                "tuner on every submit and measures "
+                                "arrival rates over microsecond windows")
         if self.sched.sampling_enabled:
             if not (0 <= self.sched.sampling_start_pressure < 1):
                 warnings.append("sched.sampling_start_pressure must be in "

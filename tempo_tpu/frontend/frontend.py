@@ -231,6 +231,15 @@ class Frontend:
             read_cost("blocks_scanned"),
             help="Backend block slices scanned by queries, per tenant",
             labels=("tenant",))
+        reg.counter_func(
+            "tempo_tpu_query_device_seconds_total",
+            lambda: [(labels, ns / 1e9) for labels, ns in
+                     read_cost("device_ns")()],
+            help="Device-dispatch wall seconds consumed by queries, per "
+                 "tenant (device-time-ledger attribution via "
+                 "QueryStats.device_ns — the read-side twin of "
+                 "tempo_devtime_tenant_device_seconds_total)",
+            labels=("tenant",))
 
         def shed():
             with self._tenant_read_lock:
@@ -451,6 +460,8 @@ class Frontend:
                 cost.get("inspected_bytes", 0) + sm["inspectedBytes"]
             cost["blocks_scanned"] = \
                 cost.get("blocks_scanned", 0) + sm["blocksScanned"]
+            cost["device_ns"] = \
+                cost.get("device_ns", 0) + sm["deviceNanos"]
         # overload-sampling exemplar: while the write path is sampling,
         # every emitted query line says so — rates/quantiles in this
         # window describe an upscaled sampled stream, and a reader of a

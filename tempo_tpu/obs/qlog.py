@@ -191,6 +191,16 @@ class QueryLogger:
             record["error"] = str(error)[:500]
         if stats is not None:
             record.update(stats.search_metrics())
+            # tenant read-cost investigation fields, pre-derived so a
+            # reader never joins against /metrics: the request's device
+            # wall (device-time ledger attribution, obs/devtime.py) and
+            # the share of its duration spent waiting on the device
+            # scheduler (high share = the chip, not the query, is slow)
+            record["deviceSeconds"] = round(record["deviceNanos"] / 1e9, 6)
+            if duration_s > 0:
+                wait_ns = record["stageDurationNanos"].get("sched_wait", 0)
+                record["schedWaitShare"] = round(
+                    min(wait_ns / 1e9 / duration_s, 1.0), 4)
         level = (logging.ERROR if reason == "error"
                  else logging.WARNING if reason == "slow" else logging.INFO)
         with self._lock:

@@ -53,6 +53,9 @@ COUNTER_FIELDS = (
     "device_scan_bytes",     # bytes uploaded to the device read plane
     "kernel_wall_ns",        # wall nanos blocked on device kernel results
     "sched_jobs",            # device-scheduler jobs this request queued
+    "device_ns",             # device-dispatch wall attributed by the
+    #                          device-time ledger (obs/devtime.py): the
+    #                          request's share of scheduler dispatches
 )
 
 # canonical per-stage wall-time breakdown keys (free-form keys are
@@ -81,6 +84,7 @@ class QueryStats:
     device_scan_bytes: int = 0
     kernel_wall_ns: int = 0
     sched_jobs: int = 0
+    device_ns: int = 0
     stage_ns: dict = dataclasses.field(default_factory=dict)
 
     def __post_init__(self) -> None:
@@ -159,6 +163,7 @@ class QueryStats:
                 "deviceScanBytes": self.device_scan_bytes,
                 "kernelWallNanos": self.kernel_wall_ns,
                 "schedJobs": self.sched_jobs,
+                "deviceNanos": self.device_ns,
                 "stageDurationNanos": dict(self.stage_ns),
             }
 

@@ -17,6 +17,7 @@ from tempo_tpu.sched.scheduler import (
     PRIO_QUERY,
     QueryBackpressure,
     SchedConfig,
+    WindowTuner,
     bucket_rows,
     configure,
     flush,
@@ -31,6 +32,7 @@ from tempo_tpu.sched.scheduler import (
 __all__ = [
     "CLASS_NAMES", "DeviceScheduler", "Job", "PRIO_COMPACTION",
     "PRIO_INGEST", "PRIO_QUERY", "QueryBackpressure", "SchedConfig",
-    "bucket_rows", "configure", "flush", "fraction_for_pressure",
+    "WindowTuner", "bucket_rows", "configure", "flush",
+    "fraction_for_pressure",
     "ingest_keep_fraction", "reset", "run", "scheduler", "use",
 ]

@@ -42,7 +42,13 @@ shed counters, per-kernel batch/occupancy/padding-waste/dispatch-wall
 families (registered in the process-wide `obs.jaxruntime.RUNTIME`
 registry, rendered on /metrics next to the jit-compile counters), and
 read-path jobs thread their scheduler wait + job count into the ambient
-per-request `QueryStats`.
+per-request `QueryStats`. Every dispatch additionally records into the
+**device-time ledger** (`obs/devtime.py`: per-(kernel, bucket, class,
+shard) wall/rows/queue-wait/H2D with per-tenant attribution, plus each
+request's `device_ns`) and feeds the online affine dispatch **cost
+model** — which `tuning: auto` consults (`WindowTuner`) to pick batch
+windows and bucket close targets that minimize predicted ingest
+latency, hard-clamped so backpressure/flush semantics never change.
 
 The scheduler is config-gated (`SchedConfig.enabled`, default on via
 `app.config.Config.sched`); every caller preserves its original
@@ -62,6 +68,9 @@ from collections import OrderedDict, deque
 from typing import Callable, Sequence
 
 import numpy as np
+
+from tempo_tpu.obs import devtime
+from tempo_tpu.utils import tracing
 
 _LOG = logging.getLogger("tempo_tpu.sched")
 
@@ -119,6 +128,21 @@ class SchedConfig:
     # EWMA time constant for the published fraction: pressure is spiky
     # push to push; the controller must ramp, not flap. 0 = unsmoothed.
     sampling_smoothing_s: float = 2.0
+    # scheduler tuning mode: "static" keeps the fixed batch_window_ms /
+    # occupancy close; "auto" lets the scheduler pick per-kernel batch
+    # windows (and pow-2 bucket close targets) that minimize PREDICTED
+    # ingest latency using the online dispatch cost model fit from the
+    # device-time ledger (obs/devtime.py). Auto falls back to the static
+    # window per kernel until the model is warm, and is HARD-BOUNDED:
+    # the tuned window stays inside [tuning_window_min_ms,
+    # tuning_window_max_ms], the tuned close target never exceeds the
+    # static occupancy close, and flush()/backpressure semantics are
+    # untouched (force-drain ignores windows; queue bounds are not
+    # tuned).
+    tuning: str = "static"
+    tuning_window_min_ms: float = 0.25
+    tuning_window_max_ms: float = 8.0
+    tuning_interval_s: float = 0.5      # how often a kernel's choice refits
 
 
 def fraction_for_pressure(pressure: float, start: float,
@@ -147,6 +171,106 @@ def bucket_rows(n: int, lo: int = 64, hi: int | None = None) -> int:
     if hi is not None:
         b = min(b, hi)
     return b
+
+
+class WindowTuner:
+    """`tuning: auto`: per-kernel batch-window deadlines and bucket
+    close targets chosen to minimize PREDICTED ingest latency.
+
+    Model (the testable core): rows arrive at a measured rate λ (EWMA of
+    the kernel's submit stream). A window of length w accumulates ≈ λ·w
+    rows, pads to the pow-2 bucket B(λ·w), and pays the cost model's
+    predicted dispatch wall c(B, λ·w). The first row of the window
+    observes ≈ w + c latency — the window-driven ingest tail — so the
+    tuner picks, over a geometric candidate grid inside the configured
+    bounds, the w minimizing w + c subject to FEASIBILITY c ≤ w (the
+    device must drain one window's batch within the window, or the
+    queue grows without bound and backpressure fires). If no candidate
+    is feasible the device is saturated regardless of windowing: the
+    largest window wins (maximum amortization). While the cost model is
+    cold for a kernel the answer is None and the scheduler keeps its
+    static window — warm-up is observable as
+    tempo_sched_tuning_active=0.
+
+    The hard guard lives in the CALLER (`_group_close_params`): tuned
+    windows are clamped to the configured bounds and the tuned close
+    target can only LOWER the static occupancy close, so backpressure
+    and flush semantics are exactly the static mode's.
+    """
+
+    N_CANDIDATES = 9
+
+    def __init__(self, now: Callable[[], float] = time.monotonic) -> None:
+        self.now = now
+        self._lock = threading.Lock()
+        # kernel -> [rows accumulated since last refit, refit wall t,
+        #            EWMA rows/s, (window_s, target_rows) | None]
+        self._state: dict[str, list] = {}
+
+    def note_rows(self, kernel: str, rows: int) -> None:
+        """Per-submit arrival accounting (called under no other lock)."""
+        with self._lock:
+            st = self._state.get(kernel)
+            if st is None:
+                self._state[kernel] = [rows, self.now(), 0.0, None]
+            else:
+                st[0] += rows
+
+    def choice(self, kernel: str, cfg: SchedConfig
+               ) -> "tuple[float, int] | None":
+        """(window_seconds, bucket_target_rows) for a kernel, or None
+        while the cost model is cold (static fallback). Cached; refits
+        at most every cfg.tuning_interval_s."""
+        now = self.now()
+        with self._lock:
+            st = self._state.get(kernel)
+            if st is None:
+                st = self._state[kernel] = [0, now, 0.0, None]
+            dt = now - st[1]
+            if dt < cfg.tuning_interval_s:
+                # cache None picks too: a cold model must not turn
+                # every submit into a full grid refit under _cond, nor
+                # reset the arrival accumulator before it has seen a
+                # full interval of traffic
+                return st[3]
+            if dt > 0:
+                rate = st[0] / dt
+                # EWMA over refit intervals: the arrival rate swings
+                # push to push, the window choice should not
+                st[2] = rate if st[2] == 0.0 else st[2] + 0.3 * (rate - st[2])
+            st[0], st[1] = 0, now
+            rate = st[2]
+        lo = max(cfg.tuning_window_min_ms, 1e-3) / 1e3
+        hi = max(cfg.tuning_window_max_ms, cfg.tuning_window_min_ms) / 1e3
+        best = None          # (latency, window, target)
+        fallback = None      # largest window with any prediction
+        step = (hi / lo) ** (1.0 / max(self.N_CANDIDATES - 1, 1))
+        w = lo
+        for _ in range(self.N_CANDIDATES):
+            exp_rows = max(rate * w, 1.0)
+            bucket = bucket_rows(int(math.ceil(exp_rows)),
+                                 cfg.min_bucket_rows, cfg.max_batch_rows)
+            cost = devtime.COST_MODEL.predict(kernel, bucket,
+                                              min(exp_rows, bucket))
+            if cost is not None:
+                latency = w + cost
+                fallback = (latency, w, bucket)
+                if cost <= w and (best is None or latency < best[0]):
+                    best = (latency, w, bucket)
+            w *= step
+        pick = best or fallback
+        out = (pick[1], pick[2]) if pick is not None else None
+        with self._lock:
+            st = self._state.get(kernel)
+            if st is not None:
+                st[3] = out
+        return out
+
+    def windows_ms(self) -> list:
+        """[(kernel, tuned window ms), ...] for the exposition gauge."""
+        with self._lock:
+            return [(k, st[3][0] * 1e3) for k, st in self._state.items()
+                    if st[3] is not None]
 
 
 class Job:
@@ -262,6 +386,10 @@ class DeviceScheduler:
         # but not yet landed) — the controller's pressure must include
         # them or it collapses to zero mid-drain (see control_pressure)
         self._inflight_ingest = 0
+        # `tuning: auto` window/bucket chooser (always constructed —
+        # only consulted when the mode says so, and the mode can change
+        # via reconfigure())
+        self._tuner = WindowTuner(now=now)
         if start_worker and self.cfg.enabled:
             self.start()
 
@@ -437,6 +565,10 @@ class DeviceScheduler:
         if not self.cfg.enabled:
             self._run_group(_group_of(job, pack, align, shards))
             return job
+        if self.cfg.tuning == "auto":
+            # arrival-rate accounting for the window tuner (outside
+            # _cond: the tuner has its own lock)
+            self._tuner.note_rows(kernel, job.n_rows)
         with self._cond:
             depth = len(self._queues[PRIO_INGEST]) + sum(
                 len(g.jobs) for g in self._groups.values())
@@ -457,7 +589,7 @@ class DeviceScheduler:
                 # occupancy-threshold crossing (close now). Waking per
                 # submit costs a context switch per push and was measured
                 # to eat the whole coalescing win on the CPU backend.
-                target = self.cfg.occupancy_target * self.cfg.max_batch_rows
+                target = self._group_close_params(kernel)[1]
                 if len(g.jobs) == 1 or (g.rows >= target
                                         and g.rows - job.n_rows < target):
                     self._cond.notify_all()
@@ -495,7 +627,7 @@ class DeviceScheduler:
                 self.jobs_total[cls] += 1
                 self._cond.notify_all()
         if idle:
-            return fn()
+            return self._run_inline(fn, kernel, priority, tenant)
         job.wait()
         # pure QUEUE wait (enqueue → execution start, stamped by the
         # worker): the kernel's own wall time is already attributed by
@@ -506,6 +638,28 @@ class DeviceScheduler:
             job.stats.add(sched_jobs=1)
         _QUEUE_WAIT.observe(wait_ns / 1e9, (cls,))
         return job.result
+
+    def _run_inline(self, fn: Callable, kernel: str, priority: int,
+                    tenant: str):
+        """Idle/shed fast path of run(): execute on the caller, but
+        still feed the device-time ledger and the ambient QueryStats —
+        device-seconds attribution must not have a light-load blind
+        spot (most query-class dispatches take exactly this path)."""
+        from tempo_tpu.obs import querystats
+
+        t0 = time.perf_counter()
+        try:
+            return fn()
+        finally:
+            wall_ns = int((time.perf_counter() - t0) * 1e9)
+            devtime.LEDGER.record_batch(
+                kernel=kernel, bucket=0, prio=priority, shards=0,
+                wall_ns=wall_ns, rows=0, padded_rows=0, queue_wait_ns=0,
+                h2d_bytes=0,
+                tenant_rows={tenant: 0} if tenant else None)
+            st = querystats.current()
+            if st is not None:
+                st.add(device_ns=wall_ns)
 
     def _queued_count(self) -> int:
         with self._cond:
@@ -538,9 +692,43 @@ class DeviceScheduler:
 
     # -- draining ----------------------------------------------------------
 
+    def _group_close_params(self, kernel: str) -> tuple[float, float]:
+        """(window_seconds, close_target_rows) for a merge group — the
+        static config, or the tuner's pick in `tuning: auto` once the
+        cost model is warm for the kernel. HARD GUARD: the tuned window
+        is clamped to the configured bounds and the tuned close target
+        can only be ≤ the static occupancy close — auto mode can close
+        batches earlier or stretch the window within bounds, but can
+        never queue more rows per batch than static mode would, so the
+        backpressure and flush semantics PR 5–6 rely on are untouched."""
+        cfg = self.cfg
+        window_s = cfg.batch_window_ms / 1000.0
+        target = cfg.occupancy_target * cfg.max_batch_rows
+        if cfg.tuning == "auto":
+            choice = self._tuner.choice(kernel, cfg)
+            if choice is not None:
+                lo = max(cfg.tuning_window_min_ms, 1e-3) / 1e3
+                hi = max(cfg.tuning_window_max_ms,
+                         cfg.tuning_window_min_ms) / 1e3
+                window_s = min(max(choice[0], lo), hi)
+                target = min(float(choice[1]), target)
+        return window_s, target
+
     def _group_ready(self, g: _MergeGroup, now: float) -> bool:
-        return (g.rows >= self.cfg.occupancy_target * self.cfg.max_batch_rows
-                or (now - g.first_t) * 1000.0 >= self.cfg.batch_window_ms)
+        window_s, target = self._group_close_params(g.kernel)
+        return g.rows >= target or (now - g.first_t) >= window_s
+
+    def tuned_window_ms(self, kernel: str) -> float:
+        """The window currently in force for a kernel, milliseconds
+        (the static config until auto mode is warm) — /status surface."""
+        return self._group_close_params(kernel)[0] * 1e3
+
+    def tuning_active(self) -> bool:
+        """True when auto mode is live AND at least one kernel is being
+        tuned from a warm cost model (the gauge behind
+        TempoSchedCostModelStale's gating)."""
+        return (self.cfg.enabled and self.cfg.tuning == "auto"
+                and bool(self._tuner.windows_ms()))
 
     def _wait_budget_locked(self) -> "float | None":
         """How long the worker may sleep (caller holds _cond): 0 when
@@ -554,7 +742,7 @@ class DeviceScheduler:
         if any(self._group_ready(g, now) for g in self._groups.values()):
             return 0.0
         return max(0.0, min(
-            g.first_t + self.cfg.batch_window_ms / 1000.0 - now
+            g.first_t + self._group_close_params(g.kernel)[0] - now
             for g in self._groups.values()))
 
     def drain_once(self, force: bool = False) -> bool:
@@ -645,7 +833,18 @@ class DeviceScheduler:
 
     def _dispatch_chunk(self, g: _MergeGroup, chunk: list[Job],
                         rows: int) -> None:
+        # queue wait stamps at execution start (enqueue → now), summed
+        # into the ledger so wait vs device-wall shares are separable
+        t_start = self.now()
+        queue_wait_ns = 0
+        tenant_rows: dict[str, int] = {}
+        for j in chunk:
+            if j.enqueue_t:
+                j.wait_s = max(t_start - j.enqueue_t, 0.0)
+                queue_wait_ns += int(j.wait_s * 1e9)
+            tenant_rows[j.tenant] = tenant_rows.get(j.tenant, 0) + j.n_rows
         t0 = time.perf_counter()
+        bucket = h2d_bytes = 0
         err: "BaseException | None" = None
         try:
             # the WHOLE build+dispatch sits under the guard: a failure
@@ -709,12 +908,40 @@ class DeviceScheduler:
                     _OCCUPANCY.observe(real / per, (g.kernel, str(i)))
             else:
                 _OCCUPANCY.observe(occ, (g.kernel, ""))
-            g.dispatch(*padded)
+            h2d_bytes = sum(int(a.nbytes) for a in padded)
+            # slow dispatches are findable by trace: same span surface
+            # as distributor.push / frontend.Search (NoopTracer default
+            # costs one dict build per MERGED batch)
+            with tracing.span("sched.dispatch", kernel=g.kernel,
+                              bucket=bucket, rows=rows,
+                              shard=str(g.shards) if g.shards else ""):
+                g.dispatch(*padded)
         except BaseException as e:           # noqa: BLE001 — propagated
             err = e
             self._note_dispatch_error(g.kernel, e)
-        _DISPATCH_SECONDS.observe(time.perf_counter() - t0, (g.kernel,))
+        wall_s = time.perf_counter() - t0
+        _DISPATCH_SECONDS.observe(wall_s, (g.kernel,))
+        # the device-time ledger sees every dispatch (failed ones too —
+        # their wall was still spent); the cost model learns only from
+        # clean, really-bucketed dispatches so an exploding kernel or a
+        # build failure cannot poison the fit
+        devtime.LEDGER.record_batch(
+            kernel=g.kernel, bucket=bucket, prio=PRIO_INGEST,
+            shards=g.shards, wall_ns=int(wall_s * 1e9), rows=rows,
+            padded_rows=max(bucket - rows, 0),
+            queue_wait_ns=queue_wait_ns, h2d_bytes=h2d_bytes,
+            tenant_rows=tenant_rows)
+        if err is None and bucket:
+            devtime.COST_MODEL.observe(g.kernel, bucket, rows, wall_s)
+        t_end = self.now()
         for j in chunk:
+            if j.enqueue_t and err is None:
+                # ingest-VISIBLE latency per job (window + queue wait +
+                # dispatch): the quantity `tuning: auto` minimizes. A
+                # failed dispatch dropped its rows — they never became
+                # visible, so they must not count as fast ones here
+                devtime.INGEST_LATENCY.observe(
+                    max(t_end - j.enqueue_t, 0.0), (g.kernel,))
             j.error = err
             j.event.set()
 
@@ -752,20 +979,34 @@ class DeviceScheduler:
             job.wait_s = max(self.now() - job.enqueue_t, 0.0)
         t0 = time.perf_counter()
         try:
-            if job.stats is not None:
-                # adopt the caller's per-request QueryStats on this
-                # thread so the kernel's own recording (device_scan
-                # bytes, kernel wall) lands in the right request scope
-                with querystats.scope(job.stats):
+            with tracing.span("sched.dispatch", kernel=job.kernel,
+                              bucket=0, rows=0, shard=""):
+                if job.stats is not None:
+                    # adopt the caller's per-request QueryStats on this
+                    # thread so the kernel's own recording (device_scan
+                    # bytes, kernel wall) lands in the right request scope
+                    with querystats.scope(job.stats):
+                        job.result = job.fn()
+                else:
                     job.result = job.fn()
-            else:
-                job.result = job.fn()
         except BaseException as e:           # noqa: BLE001 — propagated
             # fn jobs have a waiting caller who re-raises and owns the
             # error surface; dispatch_errors stays a dropped-ingest-batch
             # signal (its family help + dashboard panel say so)
             job.error = e
-        _DISPATCH_SECONDS.observe(time.perf_counter() - t0, (job.kernel,))
+        wall_s = time.perf_counter() - t0
+        _DISPATCH_SECONDS.observe(wall_s, (job.kernel,))
+        wall_ns = int(wall_s * 1e9)
+        # fn jobs ledger under bucket 0 (no coalesced shape); their wall
+        # is attributed to the query via QueryStats.device_ns so the
+        # qlog line carries the request's device-seconds directly
+        devtime.LEDGER.record_batch(
+            kernel=job.kernel, bucket=0, prio=job.priority, shards=0,
+            wall_ns=wall_ns, rows=0, padded_rows=0,
+            queue_wait_ns=int(job.wait_s * 1e9), h2d_bytes=0,
+            tenant_rows={job.tenant: 0} if job.tenant else None)
+        if job.stats is not None:
+            job.stats.add(device_ns=wall_ns)
         job.event.set()
 
 
@@ -809,12 +1050,14 @@ def scheduler() -> "DeviceScheduler | None":
 def reset() -> None:
     """Flush + drop the process scheduler (test isolation: a test that
     booted an App must not leave later standalone tests' dispatches
-    riding a scheduler they never asked for)."""
+    riding a scheduler they never asked for). The device-time ledger
+    and cost model reset with it — they are the scheduler's memory."""
     global _default
     with _default_lock:
         sc, _default = _default, None
     if sc is not None:
         sc.stop(flush=True)
+    devtime.reset()
 
 
 @contextlib.contextmanager
@@ -966,6 +1209,21 @@ RUNTIME.counter_func(
     [((), float(_default.dispatch_errors))],
     help="Scheduler dispatches that raised (fire-and-forget ingest "
          "batches were DROPPED; also logged on tempo_tpu.sched)")
+RUNTIME.gauge_func(
+    "tempo_sched_tuned_window_ms",
+    lambda: [] if _default is None else
+    [((k,), float(ms)) for k, ms in _default._tuner.windows_ms()],
+    help="Batch window currently chosen by `tuning: auto` per kernel, "
+         "milliseconds (absent until the cost model is warm; compare "
+         "against the static sched.batch_window_ms)",
+    labels=("kernel",))
+RUNTIME.gauge_func(
+    "tempo_sched_tuning_active",
+    lambda: [] if _default is None else
+    [((), 1.0 if _default.tuning_active() else 0.0)],
+    help="1 while `tuning: auto` is driving batch windows from a warm "
+         "cost model, 0 in static mode or during model warm-up "
+         "(TempoSchedCostModelStale only fires while this is 1)")
 _OCCUPANCY = RUNTIME.histogram(
     "tempo_sched_batch_occupancy_ratio",
     "Real rows / padded bucket rows per merged batch (the ISSUE floor "
@@ -987,7 +1245,7 @@ _QUEUE_WAIT = RUNTIME.histogram(
 
 __all__ = [
     "PRIO_INGEST", "PRIO_QUERY", "PRIO_COMPACTION", "CLASS_NAMES",
-    "SchedConfig", "QueryBackpressure", "Job",
+    "SchedConfig", "QueryBackpressure", "Job", "WindowTuner",
     "DeviceScheduler", "bucket_rows", "configure", "scheduler", "use",
     "run", "flush", "reset", "fraction_for_pressure",
     "ingest_keep_fraction",

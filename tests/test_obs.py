@@ -406,3 +406,69 @@ def test_dogfood_spans_queryable_by_trace_id(tmp_path):
     finally:
         srv.shutdown()
         app.shutdown()
+
+
+# -- concurrent record + scrape (the device-time ledger adds a
+#    high-frequency writer; a render racing a resizing series dict must
+#    neither crash nor emit non-conformant text) --------------------------
+
+def test_concurrent_record_and_scrape_conformant():
+    import threading
+
+    from tempo_tpu.obs import devtime
+
+    reg = Registry()
+    c = reg.counter("tempo_t_race_total", "r", labels=("k",))
+    g = reg.gauge("tempo_t_race_depth", "r", labels=("k",))
+    h = reg.histogram("tempo_t_race_seconds", "r", labels=("k",),
+                      buckets=(0.1, 1.0, 10.0))
+    led = devtime.DeviceTimeLedger()
+
+    def by_ledger_key():
+        return [(k, v / 1e9) for k, v in led._rows("wall_ns")]
+
+    reg.counter_func(
+        "tempo_t_race_ledger_seconds_total", by_ledger_key,
+        labels=("kernel", "bucket", "class", "shard"))
+    stop = threading.Event()
+    errors: list = []
+
+    def writer(i: int) -> None:
+        n = 0
+        while not stop.is_set():
+            n += 1
+            label = (f"k{n % 17}",)
+            try:
+                c.inc(1, label)
+                g.set(n, label)
+                h.observe(n % 13 / 3.0, label)
+                led.record_batch(kernel=f"k{n % 17}", bucket=64 << (n % 3),
+                                 prio=n % 3, shards=n % 2, wall_ns=1000,
+                                 rows=10, padded_rows=3, queue_wait_ns=5,
+                                 h2d_bytes=80,
+                                 tenant_rows={f"t{i}": 7, "s": 3})
+            except Exception as e:       # noqa: BLE001 — recorded
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.time() + 1.0
+        renders = 0
+        while time.time() < deadline:
+            parse_exposition(reg.render())      # raises on nonconformance
+            renders += 1
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+    assert not errors
+    assert renders > 10
+    # the ledger's tenant attribution stays consistent under the race
+    total = led.total_device_ns()
+    assert total > 0
+    assert abs(total - sum(led.tenant_device_ns().values())) \
+        <= total * 0.05
