@@ -1788,12 +1788,188 @@ def bench_multichip() -> dict:
     return out
 
 
+def bench_pages() -> dict:
+    """Paged ragged device state (ISSUE 9 acceptance): the page-table
+    registry/sketch layout vs the dense fixed-capacity planes.
+
+    Arms:
+    - tenant ramp 1 → 2048 SPARSE tenants (16 active series each, the
+      thousands-of-tenants shape the dense layout cannot reach): real
+      paged tenants pushing through the production fused route, state
+      bytes read off the pool. The dense comparison instantiates ONE
+      real dense tenant (same config) and scales by tenant count —
+      dense planes are pre-sized, so per-tenant bytes are exact by
+      construction. Gate: >= 4x lower device state bytes per active
+      series at 2048 tenants, ZERO steady-state recompiles across the
+      whole ramp (every tenant hits the same trace: page tables are
+      operands).
+    - fused-update hot path: the merged-batch packed dispatch (the
+      sched coalescer shape) on one warm tenant, paged vs dense,
+      median-of-3 interleaved. Gate: paged >= 0.9x dense spans/s.
+    - allocation storm: per-push wall during first-touch page
+      allocation across fresh tenants, and again re-touching after a
+      full purge (eviction-then-reuse churn) — p50/p99 recorded.
+    - bit-identity spot check: the paged ramp tenant's collect() equals
+      a dense tenant driven identically.
+    """
+    import statistics
+
+    import jax
+
+    from tempo_tpu.generator.processors.spanmetrics import (
+        SpanMetricsConfig, SpanMetricsProcessor)
+    from tempo_tpu.model.span_batch import SpanBatchBuilder
+    from tempo_tpu.obs.jaxruntime import JIT_COMPILES
+    from tempo_tpu.registry import pages as device_pages
+    from tempo_tpu.registry.registry import ManagedRegistry, RegistryOverrides
+
+    n_tenants = 2048
+    series_per_tenant = 16
+    cap, sketch_max, page_rows = 4096, 1024, 16
+    # sketch sized so the dd arena stays <100MB at 2048 tenants on this
+    # host (2% rel err, 1us..1e5s) — both layouts use the SAME config,
+    # so the ratio is apples to apples
+    sm_cfg = dict(use_scheduler=False, sketch_max_series=sketch_max,
+                  sketch_rel_err=0.02)
+    pool_cfg = device_pages.PagePoolConfig(
+        enabled=True, page_rows=page_rows,
+        arena_slots=n_tenants * series_per_tenant + page_rows * 8)
+
+    def mk_tenant(i: int, pool):
+        with device_pages.use(pool):
+            reg = ManagedRegistry(
+                f"t{i}", RegistryOverrides(max_active_series=cap),
+                now=lambda: 1000.0)
+            proc = SpanMetricsProcessor(reg, SpanMetricsConfig(**sm_cfg))
+        return reg, proc
+
+    def small_batch(reg, seed: int):
+        b = SpanBatchBuilder(reg.interner)
+        rng = np.random.default_rng(seed)
+        for j in range(64):
+            b.append(trace_id=rng.bytes(16), span_id=rng.bytes(8),
+                     name=f"op-{j % series_per_tenant}", service="svc",
+                     kind=2, status_code=0, start_unix_nano=10**18,
+                     end_unix_nano=10**18 + int(rng.lognormal(16, 1.0)))
+        return b.build()
+
+    # -- tenant ramp (paged, real) ----------------------------------------
+    pool = device_pages.PagePool(pool_cfg)
+    tenants = []
+    ramp_points = {}
+    alloc_lat = []
+    t_ramp0 = time.time()
+    compiles_before = None
+    for i in range(n_tenants):
+        reg, proc = mk_tenant(i, pool)
+        t0 = time.perf_counter()
+        proc.push_batch(small_batch(reg, i))
+        alloc_lat.append(time.perf_counter() - t0)
+        tenants.append((reg, proc))
+        if i == 0:
+            compiles_before = JIT_COMPILES.value(
+                ("spanmetrics_fused_update",))
+        if i + 1 in (1, 8, 64, 512, n_tenants):
+            per_series = sum(b for b in pool.tenant_bytes().values()) \
+                / ((i + 1) * series_per_tenant)
+            ramp_points[str(i + 1)] = round(per_series, 1)
+    ramp_wall = time.time() - t_ramp0
+    steady_compiles = JIT_COMPILES.value(("spanmetrics_fused_update",)) \
+        - compiles_before
+    paged_bytes_per_series = ramp_points[str(n_tenants)]
+
+    # -- dense comparison (one real tenant, exact by pre-sizing) ----------
+    dense_reg, dense_proc = mk_tenant(0, None)
+    dense_proc.push_batch(small_batch(dense_reg, 0))
+    dense_tenant_bytes = dense_reg.device_state_bytes() \
+        + dense_proc.device_state_bytes()
+    dense_bytes_per_series = dense_tenant_bytes / series_per_tenant
+    bytes_ratio = dense_bytes_per_series / max(paged_bytes_per_series, 1e-9)
+
+    # bit-identity spot check: tenant 7's paged state vs a dense twin
+    twin_reg, twin_proc = mk_tenant(7, None)
+    twin_proc.push_batch(small_batch(twin_reg, 7))
+    ident = sorted((s.name, s.labels, s.value)
+                   for s in tenants[7][0].collect(5)) == \
+        sorted((s.name, s.labels, s.value) for s in twin_reg.collect(5))
+    ident = bool(ident and tenants[7][1].quantile(0.99)
+                 == twin_proc.quantile(0.99))
+
+    # -- fused-update hot path: paged vs dense packed dispatch ------------
+    batch_rows = 1024
+    rng = np.random.default_rng(3)
+    mats = []
+    for _ in range(64):
+        m = np.empty((4, batch_rows), np.float32)
+        m[0] = rng.integers(0, series_per_tenant, batch_rows)
+        m[1] = rng.lognormal(-3, 1.5, batch_rows)
+        m[2] = rng.integers(100, 5000, batch_rows)
+        m[3] = 1.0
+        mats.append(m)
+    hot_paged = tenants[0][1]
+    hot_paged._paged_dispatch_packed4(mats[0])          # warm
+    dense_proc._sched_dispatch_packed(mats[0].copy())   # warm
+    t_paged, t_dense = [], []
+    for _ in range(3):
+        t0 = time.time()
+        for m in mats:
+            hot_paged._paged_dispatch_packed4(m)
+        jax.block_until_ready(hot_paged.calls.values.data)
+        t_paged.append(time.time() - t0)
+        t0 = time.time()
+        for m in mats:
+            dense_proc._sched_dispatch_packed(m.copy())
+        jax.block_until_ready(dense_proc.calls.state.values)
+        t_dense.append(time.time() - t0)
+    dt_paged = statistics.median(t_paged)
+    dt_dense = statistics.median(t_dense)
+    throughput_ratio = dt_dense / dt_paged if dt_paged > 0 else 0.0
+
+    # -- allocation storm under churn: purge everything, re-touch ---------
+    churn_lat = []
+    for reg, proc in tenants[:256]:
+        reg.now = lambda: 10000.0
+        reg.purge_stale()
+    for i, (reg, proc) in enumerate(tenants[:256]):
+        t0 = time.perf_counter()
+        proc.push_batch(small_batch(reg, 10_000 + i))
+        churn_lat.append(time.perf_counter() - t0)
+
+    def pct(xs, q):
+        return float(np.percentile(np.asarray(xs), q) * 1000)
+
+    accept = bool(bytes_ratio >= 4.0 and throughput_ratio >= 0.9
+                  and steady_compiles == 0 and ident)
+    return {
+        "pages_tenants": n_tenants,
+        "pages_state_bytes_per_series_paged": paged_bytes_per_series,
+        "pages_state_bytes_per_series_dense": round(
+            dense_bytes_per_series, 1),
+        "pages_state_bytes_ratio_x": round(bytes_ratio, 1),
+        "pages_ramp_bytes_per_series": ramp_points,
+        "pages_ramp_wall_s": round(ramp_wall, 2),
+        "pages_update_throughput_ratio": round(throughput_ratio, 3),
+        "pages_update_paged_spans_per_sec": round(
+            batch_rows * len(mats) / dt_paged, 1),
+        "pages_update_dense_spans_per_sec": round(
+            batch_rows * len(mats) / dt_dense, 1),
+        "pages_alloc_p50_ms": round(pct(alloc_lat, 50), 3),
+        "pages_alloc_p99_ms": round(pct(alloc_lat, 99), 3),
+        "pages_churn_p50_ms": round(pct(churn_lat, 50), 3),
+        "pages_churn_p99_ms": round(pct(churn_lat, 99), 3),
+        "pages_steady_state_compiles": steady_compiles,
+        "pages_collect_bitident": ident,
+        "pages_pool_alloc_failures": pool.alloc_failures,
+        "pages_accept_ok": accept,
+    }
+
+
 # --- orchestrator ----------------------------------------------------------
 
 STAGES = {"e2e": bench_e2e_ingest, "kernel": bench_kernel,
           "query": bench_query, "obs": bench_obs, "sched": bench_sched,
           "saturation": bench_saturation, "multichip": bench_multichip,
-          "soak": bench_soak}
+          "pages": bench_pages, "soak": bench_soak}
 
 
 def _cpu_env(env: dict) -> dict:
@@ -2156,6 +2332,15 @@ def main() -> int:
         "multichip_collect_bitident_shards": results.get(
             "multichip_collect_bitident_shards"),
         "multichip_accept_ok": results.get("multichip_accept_ok"),
+        # paged device state (ISSUE 9): bytes/active-series win at 2048
+        # sparse tenants + the hot-path throughput hold
+        "pages_state_bytes_ratio_x": results.get("pages_state_bytes_ratio_x"),
+        "pages_update_throughput_ratio": results.get(
+            "pages_update_throughput_ratio"),
+        "pages_steady_state_compiles": results.get(
+            "pages_steady_state_compiles"),
+        "pages_collect_bitident": results.get("pages_collect_bitident"),
+        "pages_accept_ok": results.get("pages_accept_ok"),
     }
     if errors:
         extra["errors"] = errors

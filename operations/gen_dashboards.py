@@ -222,6 +222,20 @@ def dashboards() -> dict[str, dict]:
                   "tempo_sched_batch_occupancy_ratio_bucket"
                   '{shard!=""}[5m])) by (le, shard))',
                   unit="percentunit", legend="shard {{shard}}"),
+                # device page pool (runbook "Sizing the page pool"):
+                # demand-paged registry/sketch state health — free pages
+                # by arena kind, churn, and the exhaustion signal
+                p("Page pool free pages by arena",
+                  "tempo_pages_free",
+                  legend="{{role}}"),
+                p("Page allocations / evictions /s",
+                  _rate("tempo_pages_allocated_total"),
+                  _rate("tempo_pages_evicted_total")),
+                p("Page-pool alloc failures /s (exhaustion)",
+                  _rate("tempo_pages_alloc_failures_total")),
+                p("Registry state bytes by layout",
+                  "sum(tempo_registry_state_bytes) by (layout)",
+                  legend="{{layout}}"),
             ]),
         "tempo-tpu-resources.json": dash(
             "Tempo-TPU / Resources",

@@ -610,8 +610,32 @@ class Handler(BaseHTTPRequestHandler):
             # online dispatch cost model + tuner state (runbook
             # "Scheduler auto-tuning")
             "cost_model": self._cost_model_status(sc),
+            # device page pool (runbook "Sizing the page pool"): None =
+            # dense fixed-capacity layout
+            "pages": self._pages_status(),
+            # per-tenant device state bytes (registry + sketch planes),
+            # paged and dense — also tempo_registry_state_bytes on
+            # /metrics
+            "registry_state_bytes": self._registry_state_status(),
         }
         self._reply(200, _json_bytes(body))
+
+    def _pages_status(self) -> "dict | None":
+        from tempo_tpu.registry import pages
+        pool = pages.active()
+        return None if pool is None else pool.status()
+
+    def _registry_state_status(self) -> dict:
+        gen = getattr(self.app, "generator", None)
+        if gen is None:
+            return {}
+        with gen._lock:   # a concurrent push may be creating a tenant
+            insts = dict(gen.instances)
+        rows = [(t, gi.state_layout, gi.device_state_bytes())
+                for t, gi in insts.items()]
+        rows.sort(key=lambda r: -r[2])   # biggest state holders first
+        return {t: {"layout": layout, "bytes": b}
+                for t, layout, b in rows[:50]}
 
     def _devtime_status(self) -> dict:
         from tempo_tpu.obs import devtime

@@ -196,6 +196,12 @@ class App:
         # shape doesn't fit the visible devices (warned, never fatal)
         from tempo_tpu.parallel import serving
         self.mesh = serving.configure(self.cfg.mesh)
+        # the device page pool comes AFTER the mesh (arenas shard
+        # page-aligned over 'series' when the mesh is on) and BEFORE any
+        # registry is built: tenants created from here on page their
+        # state instead of allocating dense planes
+        from tempo_tpu.registry import pages as device_pages
+        self.pages = device_pages.configure(self.cfg.pages)
         self._init_backend()
         self._init_bus()
         if OVERRIDES in mods:

@@ -25,6 +25,7 @@ from tempo_tpu.ingester.instance import InstanceConfig
 from tempo_tpu.overrides.limits import Limits
 from tempo_tpu.parallel.serving import MeshConfig
 from tempo_tpu.querier.querier import QuerierConfig
+from tempo_tpu.registry.pages import PagePoolConfig
 from tempo_tpu.sched import SchedConfig
 
 
@@ -130,6 +131,12 @@ class Config:
     # data-major. Default off (single device) — enable on multi-chip
     # hosts; see runbook "Serving on a mesh"
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    # device page pool (tempo_tpu.registry.pages): registry/sketch state
+    # paged into process-wide HBM arenas allocated on demand per tenant,
+    # killing the fixed-capacity dense planes (~85MB/tenant for the
+    # DDSketch plane alone). Default off (dense layout); see runbook
+    # "Sizing the page pool"
+    pages: PagePoolConfig = dataclasses.field(default_factory=PagePoolConfig)
     overrides_defaults: Limits = dataclasses.field(default_factory=Limits)
     per_tenant_override_config: str = ""   # runtime-config file path
     compaction_interval_s: float = 30.0
@@ -196,6 +203,12 @@ class Config:
                                 "(0, 1]: 0 would drop every non-forced span "
                                 "at saturation")
         warnings.extend(self.mesh.check())
+        if self.pages.enabled:
+            # only the series-table capacity must split into whole pages;
+            # the spanmetrics sketch plane rounds ITSELF up to page
+            # multiples (masking at the configured row count)
+            warnings.extend(self.pages.check(
+                (self.generator.registry.max_active_series,)))
         if self.distributor.jaeger_agent_port and \
                 self.distributor.jaeger_agent_host in ("", "0.0.0.0", "::") \
                 and not self.distributor.jaeger_agent_allow_wildcard:

@@ -59,6 +59,15 @@ class Generator:
                      for t, gi in insts().items()],
             help="Active series in the tenant registry vs its budget",
             labels=("tenant",))
+        reg.gauge_func(
+            "tempo_registry_state_bytes",
+            lambda: [((t, gi.state_layout), gi.device_state_bytes())
+                     for t, gi in insts().items()],
+            help="Device bytes of per-tenant metric state (registry "
+                 "families + sketch planes): dense tenants report full "
+                 "pre-sized planes, paged tenants only backed pages — "
+                 "the paging win, visible without a heap dump",
+            labels=("tenant", "layout"))
         self.collect_duration = reg.histogram(
             "tempo_metrics_generator_collect_duration_seconds",
             "One tenant collection tick: device-state gather through "

@@ -248,6 +248,25 @@ class GeneratorInstance:
         self.remote_write.send(samples, native)
         return len(samples)
 
+    # -- accounting --------------------------------------------------------
+
+    @property
+    def state_layout(self) -> str:
+        return "paged" if self.registry.pages is not None else "dense"
+
+    def device_state_bytes(self) -> int:
+        """Device bytes this tenant's metric state holds: registry
+        families plus processor-owned sketch sidecars. Dense tenants
+        report their full pre-sized planes; paged tenants only the pages
+        they actually backed — the /status + tempo_registry_state_bytes
+        surface that makes the paging win visible without a heap dump."""
+        total = self.registry.device_state_bytes()
+        for proc in self.processors.values():
+            fn = getattr(proc, "device_state_bytes", None)
+            if fn is not None:
+                total += fn()
+        return total
+
     # -- maintenance -------------------------------------------------------
 
     def tick(self, immediate: bool = False) -> None:

@@ -77,11 +77,11 @@ class ServiceGraphsProcessor:
         self.server_hist = registry.new_histogram(
             "traces_service_graph_request_server_seconds", labels, edges=edges)
         for fam in (self.failed, self.client_hist, self.server_hist):
-            fam.table = self.total.table  # edge families stay slot-aligned
+            fam.share_table(self.total)  # edge families stay slot-aligned
         if self.cfg.enable_messaging_system_latency_histogram:
             self.messaging_hist = registry.new_histogram(
                 "traces_service_graph_request_messaging_system_seconds", labels, edges=edges)
-            self.messaging_hist.table = self.total.table
+            self.messaging_hist.share_table(self.total)
         else:
             self.messaging_hist = None
         self._store: dict[bytes, _HalfEdge] = {}
@@ -183,16 +183,17 @@ class ServiceGraphsProcessor:
             mdur[j] = msg_delay
         slots = np.full(cap, -1, np.int32)
         slots[:n] = self.total.resolve_slots(rows)
-        from tempo_tpu.registry import metrics as rmx
-        self.total.state = rmx.counter_update(self.total.state, slots)
-        self.failed.state = rmx.counter_update(self.failed.state, slots, fail)
-        self.client_hist.state = rmx.histogram_update(self.client_hist.state, slots, cdur)
-        self.server_hist.state = rmx.histogram_update(self.server_hist.state, slots, sdur)
+        # family-level slot updates: the same dense scatter kernels as
+        # before, but the families own the device half — the paged
+        # layout (registry/pages.py) swaps it for arena scatters
+        self.total.add_slots(slots)
+        self.failed.add_slots(slots, fail)
+        self.client_hist.observe_slots(slots, cdur)
+        self.server_hist.observe_slots(slots, sdur)
         if self.messaging_hist is not None:
             msg = np.zeros(cap, bool)
             msg[:n] = [e[2] == "messaging_system" for e in edges]
-            self.messaging_hist.state = rmx.histogram_update(
-                self.messaging_hist.state, np.where(msg, slots, -1), mdur)
+            self.messaging_hist.observe_slots(np.where(msg, slots, -1), mdur)
 
     def _expire(self, now: float) -> None:
         """Expired half-edges become virtual-node edges (`servicegraphs.go:390-421`)."""

@@ -30,7 +30,8 @@ from tempo_tpu.model.interner import INVALID_ID
 from tempo_tpu.model.span_batch import SpanBatch
 from tempo_tpu.ops import sketches
 from tempo_tpu.registry import metrics as rm
-from tempo_tpu.registry.registry import DEFAULT_HISTOGRAM_EDGES, ManagedRegistry
+from tempo_tpu.registry.registry import (DEFAULT_HISTOGRAM_EDGES,
+                                         ManagedRegistry, _pad_len)
 from tempo_tpu.utils.spanfilter import FilterPolicy, compile_policies
 
 _KIND_STRS = ("SPAN_KIND_UNSPECIFIED", "SPAN_KIND_INTERNAL", "SPAN_KIND_SERVER",
@@ -137,16 +138,51 @@ class SpanMetricsProcessor:
         self.calls = registry.new_counter("traces_spanmetrics_calls_total", self._labels)
         self.latency = registry.new_histogram(
             "traces_spanmetrics_latency", self._labels, edges=self.cfg.histogram_buckets)
-        # size/ latency share the calls table so all three stay slot-aligned.
-        self.latency.table = self.calls.table
+        # size/ latency share the calls table so all three stay slot-aligned
+        # (paged mode: the shared table's backing adopts their planes too).
+        self.latency.share_table(self.calls)
         self.sizes = registry.new_counter("traces_spanmetrics_size_total", self._labels)
-        self.sizes.table = self.calls.table
-        # Sketch plane sized for HBM: [min(series), ~1.3k buckets] f32.
-        self.dd = (sketches.dd_init(min(cap, self.cfg.sketch_max_series),
-                                    rel_err=self.cfg.sketch_rel_err,
-                                    min_value=self.cfg.sketch_min_s,
-                                    max_value=self.cfg.sketch_max_s)
-                   if self.cfg.enable_quantile_sketch else None)
+        self.sizes.share_table(self.calls)
+        # paged layout (registry/pages.py): families above came back
+        # paged; the sketch sidecar rides the same pool + shared backing
+        self._pool = registry.pages
+        self._paged = self._pool is not None and \
+            hasattr(self.calls, "planes")
+        self._pdd = None
+        self._paged_steps: dict[bool, object] = {}
+        dd_rows = min(cap, self.cfg.sketch_max_series)
+        if self._paged and self.cfg.enable_quantile_sketch:
+            from tempo_tpu.registry.pages import PagedPlane
+            gamma, nb = sketches.dd_params(self.cfg.sketch_rel_err,
+                                           self.cfg.sketch_min_s,
+                                           self.cfg.sketch_max_s)
+            pr = self._pool.page_rows
+            plane_rows = -(-dd_rows // pr) * pr  # page-aligned cover
+            ddc = PagedPlane(self._pool, "float32", nb, plane_rows,
+                             registry.tenant,
+                             role="traces_spanmetrics_latency/ddsketch")
+            ddz = PagedPlane(self._pool, "float32", 1, plane_rows,
+                             registry.tenant,
+                             role="traces_spanmetrics_latency/ddzeros")
+            # back only the CONFIGURED sketch range: updates mask at
+            # dd_rows exactly like the dense plane, so collect/quantile
+            # stay bit-identical to the dense layout
+            self.calls.table.backing.add_plane(ddc, dd_rows)
+            self.calls.table.backing.add_plane(ddz, dd_rows)
+            self._pdd = (ddc, ddz, gamma, self.cfg.sketch_min_s, dd_rows)
+            self.dd = None
+        else:
+            # Sketch plane sized for HBM: [min(series), ~1.3k buckets] f32.
+            self.dd = (sketches.dd_init(dd_rows,
+                                        rel_err=self.cfg.sketch_rel_err,
+                                        min_value=self.cfg.sketch_min_s,
+                                        max_value=self.cfg.sketch_max_s)
+                       if self.cfg.enable_quantile_sketch else None)
+        if self._pdd is not None or self.dd is not None:
+            # eviction must clear the sketch sidecar's rows along with
+            # the family planes: a reused slot starting from another
+            # series' latency history would corrupt its quantiles
+            self.calls.evict_hooks.append(self._zero_sketch_slots)
         self.target_info = (registry.new_gauge("traces_target_info", ("service",))
                             if self.cfg.enable_target_info else None)
         self._policies = compile_policies(self.cfg.filter_policies)
@@ -189,6 +225,12 @@ class SpanMetricsProcessor:
         the state_lock, and the processor stays on that mesh for its
         lifetime (reconfiguring the process mesh does not migrate
         already-placed tenants)."""
+        if self._paged:
+            # paged state composes with the mesh at the POOL level:
+            # arenas shard page-aligned over 'series' and the paged fused
+            # step is already mesh-aware — the dense placement path
+            # (capacity-divisibility and all) does not apply
+            return None
         if self._mesh_checked:
             return self._mesh
         from tempo_tpu.parallel import serving
@@ -344,6 +386,103 @@ class SpanMetricsProcessor:
                 self.calls.state, self.latency.state, self.sizes.state,
                 self.dd, packed)
 
+    # -- paged route (registry/pages.py + ops/pages.py) --------------------
+
+    def _paged_step(self, packed: bool):
+        """The paged fused step for this processor's static meta — cached
+        process-wide in ops.pages, so every tenant with the same config
+        shares ONE trace (page tables and arenas are operands). The
+        resolved callable is memoized per processor: meta, pool, and
+        mesh are all fixed for the processor's lifetime, and the key
+        build (tuple + mesh fingerprint) is hot-path overhead."""
+        step = self._paged_steps.get(packed)
+        if step is None:
+            step = self._paged_steps[packed] = self._build_paged_step(packed)
+        return step
+
+    def _build_paged_step(self, packed: bool):
+        from tempo_tpu.ops import pages as op
+        pool = self._pool
+        dd_rows = self._pdd[4] if self._pdd is not None else 0
+        gamma = self._pdd[2] if self._pdd is not None else 1.0202
+        minv = self._pdd[3] if self._pdd is not None else 1e-9
+        mesh = pool.mesh
+        if mesh is None:
+            mesh_key = jmesh = None
+        else:
+            # value identity, not shape: a re-configured mesh with the
+            # same (devices, shards) shape but different device layout
+            # must NOT hit the old mesh's cached shard_map step (the
+            # id-reuse aliasing class mesh_fingerprint exists for)
+            from tempo_tpu.parallel.mesh import mesh_fingerprint
+            jmesh = mesh.registry_mesh
+            mesh_key = mesh_fingerprint(jmesh)
+        return op.fused_step(
+            tuple(self.cfg.histogram_buckets), gamma, minv, dd_rows,
+            pool.page_shift, packed,
+            mesh_key=mesh_key, mesh=jmesh,
+            series_shards=1 if mesh is None else mesh.series_shards)
+
+    def _paged_update(self, slots, dur_s, sizes, weights) -> None:
+        """One paged fused update: gather each row's physical page
+        through the indirection tables, scatter into the pooled arenas
+        (donated — the registry state lock IS the pool lock). Below the
+        2^24 capacity gate the batch ships as one packed [4, n] f32
+        matrix, mirroring the dense packed push paths."""
+        if self.calls.table.capacity < (1 << 24):
+            n = len(slots)
+            mat = np.empty((4, n), np.float32)
+            mat[0] = slots
+            mat[1] = dur_s
+            mat[2] = sizes
+            mat[3] = weights
+            self._paged_dispatch_packed4(mat)
+            return
+        self._paged_dispatch_vec(
+            np.ascontiguousarray(slots, np.int32),
+            np.asarray(dur_s, np.float32), np.asarray(sizes, np.float32),
+            np.asarray(weights, np.float32))
+
+    def _paged_planes(self):
+        """Role-aligned plane tuple for the fused paged step: (calls,
+        hist_sums, hist_counts, sizes, hist_buckets[, dd_zeros,
+        dd_counts])."""
+        lat = self.latency
+        planes = (self.calls.values, lat.sums, lat.counts,
+                  self.sizes.values, lat.buckets)
+        if self._pdd is not None:
+            planes += (self._pdd[1], self._pdd[0])
+        return planes
+
+    def _paged_args(self):
+        """(arenas, tables) operand tuples for the fused paged step.
+        Caller holds the pool lock."""
+        planes = self._paged_planes()
+        return (tuple(p.data for p in planes),
+                tuple(p.device_map() for p in planes))
+
+    def _paged_rebind(self, out) -> None:
+        for plane, new in zip(self._paged_planes(), out):
+            plane.rebind(new)
+
+    def _paged_dispatch_packed4(self, mat) -> None:
+        """Packed dispatch (direct pushes AND the sched coalescer's
+        merged [4, bucket] windows — the page table is an extra operand,
+        not a new trace per tenant)."""
+        step = self._paged_step(packed=True)
+        with self.registry.state_lock:
+            arenas, tables = self._paged_args()
+            self._paged_rebind(step(*arenas, *tables, mat))
+
+    def _paged_dispatch_vec(self, slots, dur_s, sizes, weights) -> None:
+        """Per-role-vector dispatch (capacity >= 2^24: slot ids do not
+        survive the f32 matrix)."""
+        step = self._paged_step(packed=False)
+        with self.registry.state_lock:
+            arenas, tables = self._paged_args()
+            self._paged_rebind(step(*arenas, *tables, slots, dur_s,
+                                    sizes, weights))
+
     def _submit_rows(self, sc, slots: np.ndarray, dur_s: np.ndarray,
                      sizes: np.ndarray, weights: np.ndarray):
         # slot ids round-trip f32 exactly below 2^24: ride the packed
@@ -354,7 +493,10 @@ class SpanMetricsProcessor:
         # device.
         sm = self._serving_mesh()
         packed = self.calls.table.capacity < (1 << 24)
-        if sm is not None:
+        if self._paged:
+            dispatch = self._paged_dispatch_packed4 if packed \
+                else self._paged_dispatch_vec
+        elif sm is not None:
             dispatch = self._sched_dispatch_sharded_packed if packed \
                 else self._sched_dispatch_sharded
         else:
@@ -504,6 +646,17 @@ class SpanMetricsProcessor:
                 else:
                     pipe.release(bufs)
             return n_valid, n_filtered
+        if self._paged:
+            # paged direct path (no scheduler): one fused paged dispatch
+            # over the pooled arenas — same padded staging arrays
+            wfull = np.ones(len(slots), np.float32)
+            if weights is not None:
+                wfull[:n] = weights[:n]
+            self._paged_update(slots, packed[1], packed[2], wfull)
+            self.calls.note_exemplars(slots[:n], trace_ids, packed[1],
+                                      int(now * 1000))
+            self.latency.exemplars = self.calls.exemplars
+            return n_valid, n_filtered
         sm = self._serving_mesh()
         if sm is not None:
             # mesh-resident direct path (no scheduler): the padded
@@ -621,6 +774,9 @@ class SpanMetricsProcessor:
         if sc is not None:
             self._submit_rows(sc, slots, dur_s,
                               span_sizes.astype(np.float32), weights)
+        elif self._paged:
+            self._paged_update(slots, dur_s,
+                               span_sizes.astype(np.float32), weights)
         else:
             sm = self._serving_mesh()
             if sm is not None:
@@ -642,10 +798,35 @@ class SpanMetricsProcessor:
 
     # -- sketch quantiles ---------------------------------------------------
 
+    def _zero_sketch_slots(self, padded: np.ndarray) -> None:
+        """Purge hook (under the registry state lock): zero the evicted
+        slots' DDSketch rows in whichever layout owns them. Slots past
+        the sketch plane — including the registry's capacity-valued
+        padding — drop on device."""
+        if self._pdd is not None:
+            dd_rows = self._pdd[4]
+            s = np.where(padded < dd_rows, padded, -1)
+            self._pdd[0].zero_slots(s)
+            self._pdd[1].zero_slots(s)
+        elif self.dd is not None:
+            self.dd = rm.zero_slots(self.dd, padded)
+
+    def device_state_bytes(self) -> int:
+        """Device bytes of the processor-OWNED sketch sidecar (the
+        registry families report their own); paged: backed pages only."""
+        if self._pdd is not None:
+            return (self._pdd[0].device_state_bytes()
+                    + self._pdd[1].device_state_bytes())
+        if self.dd is not None:
+            return int(self.dd.counts.nbytes) + int(self.dd.zeros.nbytes)
+        return 0
+
     def quantile(self, q: float) -> dict[tuple[tuple[str, str], ...], float]:
         """Per-series latency quantile from the DDSketch plane (<1% error).
         Takes the registry state lock: the packed ingest path DONATES the
         previous dd buffers at dispatch."""
+        if self._pdd is not None:
+            return self._paged_quantile(q)
         if self.dd is None:
             return {}
         # drain any queued scheduler batches first: a quantile read must
@@ -665,6 +846,28 @@ class SpanMetricsProcessor:
         slots = self.calls.table.active_slots()
         slots = slots[slots < nrows]
         return {self.calls.labels_of(int(s)): float(vals[int(s)]) for s in slots}
+
+    def _paged_quantile(self, q: float) -> dict:
+        """Paged sketch quantile: gather the active slots' rows through
+        the page table (device-side), run the SAME per-row dd_quantile —
+        row contents are bijective with the dense plane, so values are
+        bit-identical."""
+        from tempo_tpu import sched as sched_mod
+        sched_mod.flush()
+        ddc, ddz, gamma, minv, dd_rows = self._pdd
+        with self.registry.state_lock:
+            slots = self.calls.table.active_slots()
+            slots = slots[slots < dd_rows]
+            if not slots.size:
+                return {}
+            padded = np.full(_pad_len(slots.size), -1, np.int32)
+            padded[:slots.size] = slots
+            counts = ddc.gather_dev(padded)
+            zeros = ddz.gather_dev(padded)
+            vals = np.asarray(sketches.dd_quantile(
+                sketches.DDSketch(counts, zeros, gamma, minv), q))
+        return {self.calls.labels_of(int(s)): float(vals[i])
+                for i, s in enumerate(slots.tolist())}
 
 
 def _sanitize(k: str) -> str:

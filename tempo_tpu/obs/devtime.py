@@ -155,7 +155,7 @@ class DeviceTimeLedger:
             padded = sum(c.padded_rows for c in self._cells.values())
             tenants = sorted(self._tenant_ns.items(),
                              key=lambda kv: -kv[1])[:top_tenants]
-        return {
+        out = {
             "device_seconds_total": round(total / 1e9, 6),
             "queue_wait_seconds_total": round(queue / 1e9, 6),
             "rows_total": rows,
@@ -163,6 +163,15 @@ class DeviceTimeLedger:
             "top_tenant_device_seconds": {
                 t: round(ns / 1e9, 6) for t, ns in tenants},
         }
+        # the paged layout's HBM cost, attributed next to device time:
+        # arena bytes held per tenant (page ownership × page bytes)
+        from tempo_tpu.registry import pages
+        pool = pages.active()
+        if pool is not None:
+            top = sorted(pool.tenant_bytes().items(),
+                         key=lambda kv: -kv[1])[:top_tenants]
+            out["top_tenant_arena_bytes"] = dict(top)
+        return out
 
 
 class _PairFit:

@@ -298,6 +298,12 @@ def place_spanmetrics_state(proc, sm: "ServingMesh | None" = None) -> bool:
     sm = sm or _active
     if sm is None:
         return False
+    if getattr(proc, "_paged", False):
+        # paged processors shard at the POOL level: arenas are placed
+        # page-aligned over 'series' when the pool is built, and the
+        # paged fused step is mesh-aware — there is no per-tenant dense
+        # state to move (and no capacity-divisibility requirement)
+        return False
     from tempo_tpu.ops.sketches import dd_place
     from tempo_tpu.registry import metrics as rm
 

@@ -19,6 +19,7 @@ runs one scatter kernel; `collect` gathers each family's arrays once.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time
 from typing import Callable, Iterable, Sequence
@@ -31,6 +32,8 @@ from tempo_tpu.registry import metrics as m
 from tempo_tpu.registry.series import Exemplar, Sample, SeriesBudget, SeriesTable
 
 STALE_NAN = float("nan")
+
+_LOG = logging.getLogger("tempo_tpu.registry")
 
 DEFAULT_HISTOGRAM_EDGES = (0.002, 0.004, 0.008, 0.016, 0.032, 0.064, 0.128,
                            0.256, 0.512, 1.024, 2.048, 4.096, 8.192, 16.384)
@@ -58,6 +61,12 @@ class _MetricBase:
         self.exemplars: dict[int, Exemplar] = {}  # slot -> last exemplar
         self._stale_pending: list[tuple[tuple[tuple[str, str], ...], float]] = []
         self._ex_cursor = 0   # rotating exemplar-sampling window offset
+        # processor-owned sidecar planes keyed to this family's slots
+        # (the spanmetrics DDSketch) register here so the staleness purge
+        # zeroes THEIR rows too — slot reuse must not inherit another
+        # series' sketch history. Called with the padded eviction batch,
+        # inside the registry state lock.
+        self.evict_hooks: list = []
 
     # -- staging helpers ---------------------------------------------------
 
@@ -115,6 +124,31 @@ class _MetricBase:
         self._stale_pending = []
         return out
 
+    def share_table(self, other: "_MetricBase") -> None:
+        """Adopt `other`'s series table so the families stay slot-aligned
+        (the spanmetrics calls/latency/size trio). In the paged layout
+        the shared table's backing adopts THIS family's planes, so one
+        slot allocation backs every co-tabled plane atomically."""
+        mine = self.table
+        if mine is other.table:
+            return
+        if getattr(other.table, "backing", None) is not None and \
+                getattr(mine, "backing", None) is not None:
+            other.table.backing.adopt(mine.backing)
+        self.table = other.table
+
+    def zero_evicted(self, padded_slots: np.ndarray) -> None:
+        """Zero the device rows of evicted slots (staleness purge).
+        Paged families override to scatter through their page tables."""
+        self.state = m.zero_slots(self.state, padded_slots)
+
+    def device_state_bytes(self) -> int:
+        """Device bytes this family holds (dense: full pre-sized arrays;
+        paged override: backed pages only)."""
+        state = getattr(self, "state", None)
+        return sum(int(getattr(leaf, "nbytes", 0))
+                   for leaf in jax.tree.leaves(state))
+
 
 class Counter(_MetricBase):
     def __init__(self, registry, name, label_names, capacity):
@@ -124,8 +158,14 @@ class Counter(_MetricBase):
     def inc_batch(self, label_rows: np.ndarray, weights: np.ndarray | None = None,
                   valid: np.ndarray | None = None) -> np.ndarray:
         slots = self.resolve_slots(label_rows, valid)
-        self.state = m.counter_update(self.state, slots, weights, None)
+        self.add_slots(slots, weights)
         return slots
+
+    def add_slots(self, slots: np.ndarray,
+                  weights: np.ndarray | None = None) -> None:
+        """Device half with slots already resolved (processors that share
+        one resolve across families — servicegraphs, spanmetrics)."""
+        self.state = m.counter_update(self.state, slots, weights, None)
 
     def inc(self, label_values: Sequence[str], value: float = 1.0) -> None:
         row = self.registry.interner.intern_many(label_values)[None, :]
@@ -168,7 +208,10 @@ class Gauge(_MetricBase):
         s[:n] = slots[idx]
         v = np.zeros(cap, np.float32)
         v[:n] = values[idx]
-        self.state = m.gauge_set(self.state, s, v, None)
+        self._device_set(s, v)
+
+    def _device_set(self, slots: np.ndarray, values: np.ndarray) -> None:
+        self.state = m.gauge_set(self.state, slots, values, None)
 
     def set(self, label_values: Sequence[str], value: float) -> None:
         row = self.registry.interner.intern_many(label_values)[None, :]
@@ -196,12 +239,19 @@ class Histogram(_MetricBase):
                       weights: np.ndarray | None = None,
                       valid: np.ndarray | None = None) -> np.ndarray:
         slots = self.resolve_slots(label_rows, valid)
-        self.state = m.histogram_update(self.state, slots, values, weights, None)
+        self.observe_slots(slots, values, weights)
         return slots
+
+    def observe_slots(self, slots: np.ndarray, values: np.ndarray,
+                      weights: np.ndarray | None = None) -> None:
+        self.state = m.histogram_update(self.state, slots, values, weights, None)
 
     def observe(self, label_values: Sequence[str], value: float) -> None:
         row = self.registry.interner.intern_many(label_values)[None, :]
         self.observe_batch(row, np.array([value], np.float32))
+
+    def hist_edges(self) -> tuple:
+        return self.state.edges
 
     def _snap(self) -> tuple:
         return (np.asarray(self.state.bucket_counts),
@@ -210,7 +260,7 @@ class Histogram(_MetricBase):
     def collect(self, ts_ms: int, snap: tuple | None = None) -> list[Sample]:
         bc, sums, counts = snap if snap is not None else self._snap()
         out: list[Sample] = []
-        edges = self.state.edges
+        edges = self.hist_edges()
         for s in self.table.active_slots().tolist():
             base = self.labels_of(s)
             ex = self.exemplars.get(s)
@@ -237,8 +287,13 @@ class NativeHistogram(_MetricBase):
                       weights: np.ndarray | None = None,
                       valid: np.ndarray | None = None) -> np.ndarray:
         slots = self.resolve_slots(label_rows, valid)
-        self.state = m.native_histogram_update(self.state, slots, values, weights, None)
+        self.observe_slots(slots, values, weights)
         return slots
+
+    def observe_slots(self, slots: np.ndarray, values: np.ndarray,
+                      weights: np.ndarray | None = None) -> None:
+        self.state = m.native_histogram_update(self.state, slots, values,
+                                               weights, None)
 
     def _snap(self) -> tuple:
         return (np.asarray(self.state.sums), np.asarray(self.state.counts))
@@ -253,6 +308,9 @@ class NativeHistogram(_MetricBase):
             out.append(Sample(self.name + "_count", base, float(counts[s]), ts_ms))
             out.append(Sample(self.name + "_sum", base, float(sums[s]), ts_ms))
         return out + self._drain_stale_markers(ts_ms)
+
+    def hist_offset(self) -> int:
+        return self.state.hist.offset
 
     def native_payload(self):
         """(slots, labels, log2 counts, sums, counts, zeros) for remote write."""
@@ -281,12 +339,28 @@ class ManagedRegistry:
         self.now = now
         self.budget = SeriesBudget(self.overrides.max_active_series)
         self._metrics: dict[str, _MetricBase] = {}
+        # paged layout (registry/pages.py): when the process page pool is
+        # on and this tenant's capacity splits into whole pages, families
+        # are built PAGED — device rows live in the pooled arenas behind
+        # per-family indirection tables instead of full dense planes
+        from tempo_tpu.registry import pages as pages_mod
+        self.pages = pages_mod.active()
+        if self.pages is not None and \
+                self.overrides.max_active_series % self.pages.page_rows:
+            _LOG.warning(
+                "registry %s: max_active_series %d not divisible by "
+                "pages.page_rows %d — tenant stays on the dense layout",
+                tenant, self.overrides.max_active_series,
+                self.pages.page_rows)
+            self.pages = None
         # serializes device-state REBINDS that donate the old buffers
         # (the packed ingest fast path) against state READERS (collect /
         # native_histograms / purge's zero_slots): a donated input is
         # DELETED at dispatch, so an unlocked concurrent np.asarray on the
-        # collection thread would hit a dead array
-        self.state_lock = threading.Lock()
+        # collection thread would hit a dead array. Paged tenants share
+        # the POOL's re-entrant lock — arenas are cross-tenant state.
+        self.state_lock = self.pages.lock if self.pages is not None \
+            else threading.Lock()
 
     # -- family constructors ----------------------------------------------
 
@@ -296,24 +370,35 @@ class ManagedRegistry:
         # SeriesTables consult on allocation (registry.go:184-197 analog).
         return self.overrides.max_active_series
 
+    def _family_types(self):
+        if self.pages is not None:
+            from tempo_tpu.registry import paged
+            return (paged.PagedCounter, paged.PagedGauge,
+                    paged.PagedHistogram, paged.PagedNativeHistogram)
+        return (Counter, Gauge, Histogram, NativeHistogram)
+
     def new_counter(self, name: str, label_names: Sequence[str]) -> Counter:
-        c = Counter(self, name, label_names, self._capacity_share())
+        c = self._family_types()[0](self, name, label_names,
+                                    self._capacity_share())
         self._metrics[name] = c
         return c
 
     def new_gauge(self, name: str, label_names: Sequence[str]) -> Gauge:
-        g = Gauge(self, name, label_names, self._capacity_share())
+        g = self._family_types()[1](self, name, label_names,
+                                    self._capacity_share())
         self._metrics[name] = g
         return g
 
     def new_histogram(self, name: str, label_names: Sequence[str],
                       edges: tuple[float, ...] = DEFAULT_HISTOGRAM_EDGES) -> Histogram:
-        h = Histogram(self, name, label_names, self._capacity_share(), edges)
+        h = self._family_types()[2](self, name, label_names,
+                                    self._capacity_share(), edges)
         self._metrics[name] = h
         return h
 
     def new_native_histogram(self, name: str, label_names: Sequence[str]) -> NativeHistogram:
-        h = NativeHistogram(self, name, label_names, self._capacity_share())
+        h = self._family_types()[3](self, name, label_names,
+                                    self._capacity_share())
         self._metrics[name] = h
         return h
 
@@ -374,10 +459,19 @@ class ManagedRegistry:
             with self.state_lock:
                 for mt in fams:
                     mt.note_stale(stale)
-                    mt.state = m.zero_slots(mt.state, padded)
+                    mt.zero_evicted(padded)
+                    for hook in mt.evict_hooks:
+                        hook(padded)
                 table.purge_stale(cutoff)
             total += stale.size
         return total
+
+    def device_state_bytes(self) -> int:
+        """Device bytes across this registry's families (dense: full
+        pre-sized planes; paged: backed pages only). Processor-owned
+        sidecars (the spanmetrics DDSketch plane) are NOT included —
+        `GeneratorInstance.device_state_bytes` adds those."""
+        return sum(mt.device_state_bytes() for mt in self._metrics.values())
 
     def native_histograms(self, ts_ms: int | None = None) -> list[tuple]:
         """(labels, log2_counts, sum, count, zeros, ts, offset) per active
@@ -390,7 +484,7 @@ class ManagedRegistry:
             payloads = [(mt, p()) for mt, p in payloads if p is not None]
         for mt, payload in payloads:
             slots, labels, hists, sums, counts, zeros = payload
-            offset = mt.state.hist.offset
+            offset = mt.hist_offset()
             for i in range(len(labels)):
                 out.append((labels[i], hists[i], float(sums[i]),
                             float(counts[i]), float(zeros[i]), ts, offset))

@@ -61,10 +61,15 @@ class SeriesTable:
     """Fixed-capacity table of label-value-id rows → slot ids."""
 
     def __init__(self, capacity: int, n_labels: int,
-                 budget: "SeriesBudget | None" = None):
+                 budget: "SeriesBudget | None" = None,
+                 backing=None):
         self.capacity = capacity
         self.n_labels = n_labels
         self.budget = budget
+        # paged layout (registry/pages.py): a PageBacking that must back
+        # a slot's device pages before the slot can be handed out; pool
+        # exhaustion rejects the combo exactly like a spent budget
+        self.backing = backing
         self._slots: dict[bytes, int] = {}
         self._free: list[int] = list(range(capacity - 1, -1, -1))
         self.slot_keys = np.full((capacity, n_labels), -1, np.int32)
@@ -116,6 +121,13 @@ class SeriesTable:
                     self.discarded += 1
                     continue
                 slot = self._free.pop()
+                if self.backing is not None and \
+                        not self.backing.ensure_slot(slot):
+                    self._free.append(slot)
+                    if self.budget is not None:
+                        self.budget.release()
+                    self.discarded += 1
+                    continue
                 self._slots[key] = slot
                 self.slot_keys[slot] = uniq[i]
                 self.active[slot] = True
@@ -158,6 +170,15 @@ class SeriesTable:
                 pend[key] = -1
                 continue
             slot = self._free.pop()
+            if self.backing is not None and \
+                    not self.backing.ensure_slot(slot):
+                self._free.append(slot)
+                if self.budget is not None:
+                    self.budget.release()
+                self.discarded += 1
+                self._nat.remove(row)
+                pend[key] = -1
+                continue
             self._nat.insert(row, slot)
             self.slot_keys[slot] = row
             self.active[slot] = True
@@ -182,6 +203,10 @@ class SeriesTable:
             self._free.append(slot)
         if self.budget is not None and stale.size:
             self.budget.release(stale.size)
+        if self.backing is not None and stale.size:
+            # AFTER the families zeroed the evicted rows (registry
+            # purge order): pages that emptied return to the free list
+            self.backing.release(stale)
         return stale
 
     def active_slots(self) -> np.ndarray:

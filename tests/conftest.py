@@ -39,6 +39,12 @@ def _reset_device_scheduler():
     from tempo_tpu import sched
 
     sched.reset()
+    # the device page pool is process-wide the same way: an App-based
+    # test leaving it configured would silently page every later test's
+    # registries
+    from tempo_tpu.registry import pages
+
+    pages.reset()
 
 
 # ---------------------------------------------------------------------------

@@ -182,3 +182,106 @@ def test_fuzz_search_parity(fuzz_dbs):
         except Exception as e:
             raise AssertionError(f"{ctx}: {e}") from e
         assert a == b, f"{ctx}: {len(a)} dev vs {len(b)} host trace ids"
+
+
+# -- paged-vs-dense differential arm -----------------------------------------
+#
+# The write-plane twin of the read-path parity gate above: random
+# push/purge/collect/quantile interleavings across 3 tenants must be
+# BIT-identical between the paged layout (registry/pages.py page-table
+# arenas) and the dense fixed-capacity layout — including full-eviction
+# rounds that free pages and the immediate reuse of the same physical
+# pages (the free list is LIFO) by other tenants' new series.
+
+def _pv_make_world(paged: bool):
+    from tempo_tpu.generator.processors.spanmetrics import (
+        SpanMetricsConfig, SpanMetricsProcessor)
+    from tempo_tpu.registry import pages as device_pages
+    from tempo_tpu.registry.registry import ManagedRegistry, RegistryOverrides
+
+    clock = [1000.0]
+    pool = device_pages.PagePool(device_pages.PagePoolConfig(
+        enabled=True, page_rows=16, arena_slots=1024)) if paged else None
+    tenants = {}
+    with device_pages.use(pool):
+        for t in ("a", "b", "c"):
+            reg = ManagedRegistry(
+                t, RegistryOverrides(max_active_series=64,
+                                     stale_duration_s=50.0),
+                now=lambda: clock[0])
+            proc = SpanMetricsProcessor(reg, SpanMetricsConfig(
+                use_scheduler=False, sketch_max_series=32))
+            tenants[t] = (reg, proc)
+    return clock, tenants, pool
+
+
+def _pv_batch(reg, rng: random.Random, n: int):
+    from tempo_tpu.model.span_batch import SpanBatchBuilder
+
+    b = SpanBatchBuilder(reg.interner)
+    for _ in range(n):
+        b.append(trace_id=rng.getrandbits(128).to_bytes(16, "big"),
+                 span_id=rng.getrandbits(64).to_bytes(8, "big"),
+                 name=f"op-{rng.randrange(12)}",
+                 service=f"svc-{rng.randrange(4)}",
+                 kind=rng.randrange(6), status_code=rng.randrange(3),
+                 start_unix_nano=10**18,
+                 end_unix_nano=10**18 + rng.randrange(1, 10**9))
+    return b.build()
+
+
+def test_fuzz_paged_vs_dense_differential():
+    n_ops = int(os.environ.get("TEMPO_FUZZ_CASES", 40))
+    worlds = [_pv_make_world(paged) for paged in (True, False)]
+    script = random.Random(SEED + 2)
+    tenant_names = ("a", "b", "c")
+    for step in range(n_ops):
+        op = script.choice(["push", "push", "push", "purge", "collect",
+                            "quantile", "idle"])
+        t = script.choice(tenant_names)
+        seed = script.randrange(1 << 30)
+        n = script.choice([17, 64, 256])
+        dt = script.choice([0.0, 5.0, 60.0])   # 60s+ steps age series out
+        ctx = f"seed={SEED} step={step} op={op} tenant={t}"
+        results = []
+        for clock, tenants, _pool in worlds:
+            reg, proc = tenants[t]
+            rng = random.Random(seed)
+            clock[0] += dt
+            if op == "push":
+                proc.push_batch(_pv_batch(reg, rng, n))
+                results.append(reg.budget.used)
+            elif op == "purge":
+                results.append(reg.purge_stale())
+            elif op == "collect":
+                results.append(sorted(
+                    (s.name, s.labels, s.value)
+                    for s in reg.collect(step) if s.value == s.value))
+            elif op == "quantile":
+                results.append(proc.quantile(
+                    rng.choice([0.5, 0.9, 0.99])))
+            else:
+                results.append(None)
+        assert results[0] == results[1], ctx
+    # deterministic coda (random scripts may not evict): age EVERY
+    # series out, purge, and repopulate — the paged world must recycle
+    # the just-freed physical pages (LIFO free list) for the new series
+    for clock, tenants, _pool in worlds:
+        clock[0] += 1000.0
+        for t in tenant_names:
+            tenants[t][0].purge_stale()
+        rng = random.Random(SEED + 3)
+        for t in tenant_names:
+            tenants[t][1].push_batch(_pv_batch(tenants[t][0], rng, 64))
+    # closing audit: every tenant's full state agrees bit-for-bit, and
+    # the paged world actually exercised eviction + page reuse
+    for t in tenant_names:
+        outs = [sorted((s.name, s.labels, s.value)
+                       for s in w[1][t][0].collect(10**6)
+                       if s.value == s.value) for w in worlds]
+        qq = [w[1][t][1].quantile(0.99) for w in worlds]
+        assert outs[0] == outs[1], f"seed={SEED} tenant={t} final collect"
+        assert qq[0] == qq[1], f"seed={SEED} tenant={t} final quantile"
+    pool = worlds[0][2]
+    assert pool.allocated_total > pool.total_pages() - pool.free_pages(), \
+        f"seed={SEED}: fuzz script never recycled a page (weak run)"
