@@ -52,12 +52,10 @@ import time
 import numpy as np
 
 PROBE_TIMEOUT_S = float(os.environ.get("TEMPO_BENCH_PROBE_TIMEOUT_S", 360))
-REPROBE_TIMEOUT_S = float(
-    os.environ.get("TEMPO_BENCH_REPROBE_TIMEOUT_S", 240))
 STAGE_TIMEOUT_S = float(os.environ.get("TEMPO_BENCH_STAGE_TIMEOUT_S", 900))
-# soft deadline for OPTIONAL work (mid-run re-probes, accelerator re-runs
-# of stages that already have a CPU number). Mandatory work — one probe
-# pass + one run of every stage — always happens regardless.
+# soft deadline for OPTIONAL work (accelerator re-runs of stages that
+# already have a CPU number). Mandatory work — one probe attempt + one
+# run of every stage — always happens regardless.
 SOFT_DEADLINE_S = float(os.environ.get("TEMPO_BENCH_DEADLINE_S", 4200))
 
 
@@ -1964,12 +1962,178 @@ def bench_pages() -> dict:
     }
 
 
+def bench_moments() -> dict:
+    """Moments sketch tier (ISSUE 10): the ~15-float quantile rows vs
+    the DDSketch plane — state bytes/series (gate ≥10x), frontend
+    combine latency vs the 64-bucket histogram fold, quantile error vs
+    exact on lognormal + bimodal workloads (gate ≤5%, solver fallbacks
+    0), zero steady-state recompiles, and bit-identical dd behavior
+    when the tier is off (the dd plane of a `both` tenant matches a
+    `dd` tenant bit-for-bit)."""
+    import numpy as np
+
+    from tempo_tpu.generator.processors.spanmetrics import (
+        SpanMetricsConfig, SpanMetricsProcessor)
+    from tempo_tpu.model.span_batch import SpanBatchBuilder
+    from tempo_tpu.ops import moments as msk
+    from tempo_tpu.registry.registry import ManagedRegistry, RegistryOverrides
+    from tempo_tpu.traceql.engine_metrics import (_LABEL_BUCKET,
+                                                  _LABEL_MOMENT,
+                                                  SeriesCombiner, TimeSeries)
+    from tempo_tpu.traceql import ast as A
+
+    msk.reset_solver_cache()
+    rng = np.random.default_rng(11)
+    n_series, cap = 48, 1024
+
+    def mk(sketch):
+        reg = ManagedRegistry(
+            f"bench-{sketch}", RegistryOverrides(max_active_series=cap),
+            now=time.time)
+        return reg, SpanMetricsProcessor(reg, SpanMetricsConfig(
+            use_scheduler=False, sketch=sketch, sketch_max_series=cap))
+
+    worlds = {s: mk(s) for s in ("dd", "moments", "both")}
+    durations: dict[str, list] = {}
+    # lognormal series + bimodal series, several pushes each
+    for _ in range(6):
+        per_op = {}
+        for i in range(n_series):
+            if i % 3 == 2:   # bimodal: overlapping fast/slow modes
+                d = np.concatenate([
+                    rng.lognormal(np.log(0.02 + i * 1e-4), 0.5, 32),
+                    rng.lognormal(np.log(0.4), 0.45, 32)])
+            else:
+                d = rng.lognormal(np.log(0.01 * (1 + i % 7)), 0.7, 64)
+            per_op[f"op-{i}"] = d
+            durations.setdefault(f"op-{i}", []).extend(d.tolist())
+        for _reg, proc in worlds.values():
+            b = SpanBatchBuilder(proc.registry.interner)
+            for op, ds in per_op.items():
+                for d in ds:
+                    b.append(trace_id=bytes(16), span_id=bytes(8), name=op,
+                             service="svc", kind=2, status_code=0,
+                             start_unix_nano=10**18,
+                             end_unix_nano=10**18 + int(d * 1e9))
+            proc.push_batch(b.build())
+
+    # --- quantile error vs exact (moments tier) + solver fallbacks.
+    # Error metric: min(relative value error, rank error) — inside a
+    # bimodal density gap EVERY sketch's value error is unbounded (any
+    # value across the gap has the same CDF), so the gap cases gate on
+    # the rank guarantee the moments sketch actually makes (Gan et al.)
+    # while smooth quantiles gate on plain value error.
+    fb0 = msk.fallbacks_total
+    max_err = 0.0
+    for q in (0.5, 0.9, 0.99):
+        got = worlds["moments"][1].quantile(q)
+        for labels, est in got.items():
+            op = dict(labels)["span_name"]
+            xs = np.sort(durations[op])
+            exact = float(np.quantile(xs, q))
+            vrel = abs(est - exact) / exact
+            rank = abs(np.searchsorted(xs, est) / len(xs) - q)
+            max_err = max(max_err, min(vrel, rank))
+    fallbacks = msk.fallbacks_total - fb0
+
+    # --- state bytes per active series, dd plane vs moments rows
+    active = worlds["dd"][1].calls.table.active_count
+    dd_bytes = worlds["dd"][1].device_state_bytes()
+    mom_bytes = worlds["moments"][1].device_state_bytes()
+    bytes_ratio = dd_bytes / max(mom_bytes, 1)
+
+    # --- steady-state recompiles: the warm pushes above compiled every
+    # shape; these must not add a single trace
+    jit0 = _jit_compiles_total("spanmetrics")
+    for _ in range(5):
+        b = SpanBatchBuilder(worlds["moments"][1].registry.interner)
+        for i in range(n_series):
+            for _j in range(64):   # same rows/push as the warm batches:
+                # steady state re-uses the warm pow-2 shape bucket
+                b.append(trace_id=bytes(16), span_id=bytes(8),
+                         name=f"op-{i}", service="svc", kind=2,
+                         status_code=0, start_unix_nano=10**18,
+                         end_unix_nano=10**18 + int(5e7))
+        worlds["moments"][1].push_batch(b.build())
+    steady_compiles = int(_jit_compiles_total("spanmetrics") - jit0)
+
+    # --- dd bit-identity: the moments sidecar must not perturb the dd
+    # plane ("both" vs "dd" bit-equal), and the default tier IS dd
+    dd_a = np.asarray(worlds["dd"][1].dd.counts)
+    dd_b = np.asarray(worlds["both"][1].dd.counts)
+    dd_ident = bool((dd_a == dd_b).all() and
+                    SpanMetricsConfig().sketch == "dd")
+
+    # --- frontend combine: J jobs' quantile series folded into one —
+    # the moments tier ships k+3 moment series per group, the histogram
+    # fold 64 bucket series per group (the cross-shard payload shrink)
+    jobs, groups, steps = 24, 24, 32
+    kq = msk.QUERY_K
+
+    def hist_job(j):
+        out = []
+        for g in range(groups):
+            base = (("svc", f"g{g}"),)
+            for b in range(16, 40):
+                out.append(TimeSeries(
+                    base + ((_LABEL_BUCKET, 2.0 ** b / 1e9),),
+                    rng.random(steps)))
+        return out
+
+    def mom_job(j):
+        out = []
+        for g in range(groups):
+            base = (("svc", f"g{g}"),)
+            for m in range(kq + 1):
+                out.append(TimeSeries(
+                    base + ((_LABEL_MOMENT, str(m)),), rng.random(steps)))
+            out.append(TimeSeries(base + ((_LABEL_MOMENT, "hi"),),
+                                  rng.random(steps)))
+            out.append(TimeSeries(base + ((_LABEL_MOMENT, "lo"),),
+                                  rng.random(steps)))
+        return out
+
+    def fold(job_fn):
+        payload = [job_fn(j) for j in range(jobs)]
+        t0 = time.perf_counter()
+        comb = SeriesCombiner(A.MetricsKind.QUANTILE_OVER_TIME, steps)
+        for lst in payload:
+            comb.add_all(lst)
+        _ = comb.series
+        return time.perf_counter() - t0, comb
+
+    t_hist = min(fold(hist_job)[0] for _ in range(3))
+    t_mom = min(fold(mom_job)[0] for _ in range(3))
+    combine_speedup = t_hist / max(t_mom, 1e-9)
+
+    accept = bool(bytes_ratio >= 10.0 and max_err <= 0.05
+                  and fallbacks == 0 and steady_compiles == 0
+                  and dd_ident and combine_speedup >= 1.0)
+    return {
+        "moments_series": int(active),
+        "moments_state_bytes_per_series": round(mom_bytes / max(active, 1), 1),
+        "moments_dd_state_bytes_per_series": round(
+            dd_bytes / max(active, 1), 1),
+        "moments_state_bytes_ratio_x": round(bytes_ratio, 1),
+        "moments_quantile_rel_err_max": round(max_err, 4),
+        "moments_solver_fallbacks": int(fallbacks),
+        "moments_combine_ms_hist_fold": round(t_hist * 1e3, 2),
+        "moments_combine_ms_moments_fold": round(t_mom * 1e3, 2),
+        "moments_combine_speedup_x": round(combine_speedup, 2),
+        "moments_steady_state_compiles": steady_compiles,
+        "moments_dd_bitident": dd_ident,
+        "moments_solve_cache_hits": int(msk.cache_hits_total),
+        "moments_accept_ok": accept,
+    }
+
+
 # --- orchestrator ----------------------------------------------------------
 
 STAGES = {"e2e": bench_e2e_ingest, "kernel": bench_kernel,
           "query": bench_query, "obs": bench_obs, "sched": bench_sched,
           "saturation": bench_saturation, "multichip": bench_multichip,
-          "pages": bench_pages, "soak": bench_soak}
+          "pages": bench_pages, "moments": bench_moments,
+          "soak": bench_soak}
 
 
 def _cpu_env(env: dict) -> dict:
@@ -2056,27 +2220,36 @@ def main() -> int:
             print(json.dumps(fn()))
             return 0
 
-    # Platform handling (round-5 rework): the round-4 failure mode was a
-    # tunnel that timed out during the first 8 minutes and a bench that
-    # then NEVER looked at the accelerator again — the whole round's
-    # record fell back to a CPU diagnostic. Now the probe is retried
-    # between stages, and any stage that had to run on CPU is re-run on
-    # the accelerator if it comes back before the soft deadline.
+    # Platform handling (round-9 rework of the round-5 shape): ONE
+    # bounded startup probe decides the run's platform. BENCH_r05 showed
+    # a wedged tunnel hangs the immediate retry and every background
+    # re-probe exactly like the first attempt (2x360s burned before any
+    # stage ran), so a failed first probe commits the run to CPU; a
+    # SUCCESSFUL probe's accelerator is still used to re-run any stage
+    # that had to fall back to CPU mid-run.
     t_start = time.time()
     base = dict(os.environ)
     forced_cpu = bool(os.environ.get("TEMPO_BENCH_FORCE_CPU"))
     accel: str | None = None        # accelerator platform name once seen
     cpu_confirmed = False  # a probe RETURNED "cpu": default backend IS cpu,
     #                        no accelerator will ever appear — stop probing
+    # BENCH_r05 burned two back-to-back 360s startup timeouts (12 min)
+    # before the CPU fallback even started: a tunnel that hangs the first
+    # probe hangs the immediate retry too. Remember the first failure and
+    # skip both the startup retry AND the background re-probes — the run
+    # commits to CPU and spends its wall budget on stages.
+    probe_gave_up = False
     if not forced_cpu:
-        for attempt in range(2):
-            p = _probe_once(base, PROBE_TIMEOUT_S, f"startup {attempt + 1}")
-            if p is not None:
-                if p != "cpu":
-                    accel = p
-                else:
-                    cpu_confirmed = True
-                break
+        p = _probe_once(base, PROBE_TIMEOUT_S, "startup")
+        if p is None:
+            probe_gave_up = True
+            print("bench: startup probe failed; committing to cpu for "
+                  "this run (no retry, no background probes)",
+                  file=sys.stderr)
+        elif p != "cpu":
+            accel = p
+        else:
+            cpu_confirmed = True
 
     def soft_time_left() -> bool:
         return (time.time() - t_start) < SOFT_DEADLINE_S
@@ -2084,52 +2257,10 @@ def main() -> int:
     results: dict = {}
     errors: dict = {}
     stage_platform: dict = {}
-
-    # Background re-probe: while stages run on CPU (their children drop the
-    # relay env entirely, so they never contend for the accelerator lease),
-    # a single probe child keeps watching for the tunnel to come back —
-    # zero added wall time vs the serial probe-then-stage shape.
-    probe_proc: subprocess.Popen | None = None
-    probe_t0 = 0.0
-    reprobes_left = 4
-
-    def start_bg_probe() -> None:
-        nonlocal probe_proc, probe_t0, reprobes_left
-        if (probe_proc is not None or accel is not None or cpu_confirmed
-                or forced_cpu or reprobes_left <= 0 or not soft_time_left()):
-            return
-        reprobes_left -= 1
-        probe_proc = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "--probe"],
-            env=base, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-            text=True, cwd=os.path.dirname(os.path.abspath(__file__)))
-        probe_t0 = time.time()
-
-    def harvest_bg_probe(wait: bool = False) -> None:
-        """Collect a finished (or overdue) background probe, non-blocking
-        unless `wait` — then block up to the probe's remaining budget."""
-        nonlocal probe_proc, accel, cpu_confirmed
-        if probe_proc is None:
-            return
-        left = REPROBE_TIMEOUT_S - (time.time() - probe_t0)
-        try:
-            out = probe_proc.communicate(
-                timeout=max(left, 0.1) if wait else 0.01)[0]
-        except subprocess.TimeoutExpired:
-            if wait or left <= 0:
-                probe_proc.kill()
-                probe_proc.communicate()
-                probe_proc = None
-                print("bench: background probe timed out", file=sys.stderr)
-            return
-        probe_proc = None
-        got = _last_json(out)
-        p = got.get("platform") if got else None
-        if p and p != "cpu":
-            accel = str(p)
-            print(f"bench: background probe found {accel}", file=sys.stderr)
-        elif p == "cpu":
-            cpu_confirmed = True
+    # (The round-5 background re-probe machinery is gone: after the
+    # single startup probe exactly one of accel / cpu_confirmed /
+    # probe_gave_up / forced_cpu holds, so a mid-run probe could never
+    # fire — a failed tunnel commits the run to CPU by design now.)
 
     def run_stage(name: str, want_accel: bool) -> None:
         """Run one stage; on accelerator failure fall back to CPU."""
@@ -2151,22 +2282,14 @@ def main() -> int:
             stage_platform[name] = used
 
     for name in STAGES:
-        # a tunnel that recovers minutes after a cold start is still worth
-        # using: keep a background probe alive while stages run on CPU
-        start_bg_probe()
         run_stage(name, want_accel=True)
-        harvest_bg_probe()
-        start_bg_probe()          # relaunch if the last one timed out
 
-    # the accelerator may have appeared mid-run; re-run any stage whose
-    # number was captured on CPU (e2e first — it is the headline metric)
+    # a stage may have failed on the accelerator and fallen back to CPU;
+    # re-run any CPU-captured stage on the accelerator we know exists
+    # (e2e first — it is the headline metric)
     if not forced_cpu:
         cpu_stages = [n for n in STAGES if stage_platform.get(n) != accel
                       or n in errors]
-        if cpu_stages and accel is None and soft_time_left():
-            harvest_bg_probe(wait=True)     # give the in-flight probe its
-            start_bg_probe()                # remaining budget, then one
-            harvest_bg_probe(wait=True)     # last fresh attempt
         if accel is not None:
             for name in cpu_stages:
                 if not soft_time_left():
@@ -2185,13 +2308,6 @@ def main() -> int:
                 else:
                     print(f"bench: re-run of {name} on {accel} failed "
                           f"({err}); keeping cpu number", file=sys.stderr)
-
-    if probe_proc is not None:
-        # never leak a probe child past exit: a wedged one can hold the
-        # accelerator tunnel lease into the NEXT bench run
-        probe_proc.kill()
-        probe_proc.communicate()
-        probe_proc = None
 
     # headline platform = the platform the headline (e2e) number was
     # captured on; fall back to the best any stage achieved
@@ -2225,6 +2341,15 @@ def main() -> int:
             "ingest_steady_state_compiles"),
         "ingest_parity_bitident": results.get("ingest_parity_bitident"),
         "ingest_accept_ok": results.get("ingest_accept_ok"),
+        # moments sketch tier (ISSUE 10): state + combine + accuracy
+        "moments_state_bytes_ratio_x": results.get(
+            "moments_state_bytes_ratio_x"),
+        "moments_quantile_rel_err_max": results.get(
+            "moments_quantile_rel_err_max"),
+        "moments_combine_speedup_x": results.get(
+            "moments_combine_speedup_x"),
+        "moments_solver_fallbacks": results.get("moments_solver_fallbacks"),
+        "moments_accept_ok": results.get("moments_accept_ok"),
         "kernel_spans_per_sec": round(kernel_sps, 1) if kernel_sps else None,
         "kernel_vs_baseline": round(kernel_sps / 1e7, 4) if kernel_sps else None,
         "query_range_100k_spans_ms": round(results["query_range_ms"], 1)

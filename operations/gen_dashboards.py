@@ -155,6 +155,17 @@ def dashboards() -> dict[str, dict]:
                 p("Query-log records /s by reason",
                   _rate("tempo_query_log_records_total", "reason"),
                   legend="{{reason}}"),
+                # moments sketch tier (runbook "Choosing a quantile
+                # sketch tier"): maxent solver health — fallbacks > 0 in
+                # steady state means quantiles are being served from the
+                # bucket-sketch fallback, not the moments rows
+                p("Moments solver fallbacks /s",
+                  _rate("tempo_moments_solver_fallback_total")),
+                p("Moments solves /s vs cache hits /s",
+                  _rate("tempo_moments_solves_total"),
+                  _rate("tempo_moments_solve_cache_hits_total")),
+                p("Moments solve wall s/s",
+                  _rate("tempo_moments_solve_seconds_total")),
             ]),
         "tempo-tpu-writes.json": dash(
             "Tempo-TPU / Writes",

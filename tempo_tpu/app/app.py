@@ -202,6 +202,13 @@ class App:
         # state instead of allocating dense planes
         from tempo_tpu.registry import pages as device_pages
         self.pages = device_pages.configure(self.cfg.pages)
+        # the TraceQL quantile_over_time accumulation axis follows the
+        # spanmetrics sketch tier: "moments" switches query grids to
+        # k+1-float moment rows (ops/moments.py); dd/both keep the
+        # log2 bucket grids (process-wide, like the sched/mesh/pages
+        # state — every MetricsEvaluator consults it)
+        from tempo_tpu.ops import moments as moments_mod
+        moments_mod.set_query_tier(self.cfg.generator.spanmetrics.sketch)
         self._init_backend()
         self._init_bus()
         if OVERRIDES in mods:

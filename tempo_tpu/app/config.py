@@ -202,6 +202,25 @@ class Config:
                 warnings.append("sched.sampling_min_fraction must be in "
                                 "(0, 1]: 0 would drop every non-forced span "
                                 "at saturation")
+        sm = self.generator.spanmetrics
+        if sm.sketch not in ("dd", "moments", "both"):
+            warnings.append(
+                f"generator.spanmetrics.sketch {sm.sketch!r} unknown: use "
+                "'dd' (DDSketch plane), 'moments' (~15-float moments "
+                "rows, psum combine), or 'both' (moments answers, "
+                "DDSketch fallback) — serve time falls back to 'dd'")
+        if not (2 <= sm.moments_k <= 16):
+            warnings.append(
+                f"generator.spanmetrics.moments_k ({sm.moments_k}) outside "
+                "2..16: fewer than 2 moments cannot fit a distribution, "
+                "more than 16 adds f32 accumulation noise faster than "
+                "accuracy — serve time clamps into range")
+        if sm.sketch in ("moments", "both") and \
+                not sm.enable_quantile_sketch:
+            warnings.append(
+                "generator.spanmetrics.sketch selects the moments tier "
+                "but enable_quantile_sketch is false: no sketch plane "
+                "will be built and quantile() answers will be empty")
         warnings.extend(self.mesh.check())
         if self.pages.enabled:
             # only the series-table capacity must split into whole pages;

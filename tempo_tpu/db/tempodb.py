@@ -270,7 +270,12 @@ class TempoDB:
                    and (ev.fetch_req.all_conditions
                         or ev.fetch_req.pure_disjunction)
                    and all(isinstance(s, A.SpansetFilter) for s in ev.q.stages)
-                   and ev.m.kind != A.MetricsKind.COMPARE)
+                   and ev.m.kind != A.MetricsKind.COMPARE
+                   # moments query tier: the block plane's fused grid is
+                   # the log2 bucket axis — mixing bucket series with the
+                   # evaluator's moment series in one combine would be
+                   # meaningless, so quantile queries take the evaluator
+                   and not ev._moments)
         preds = [c for c in ev.fetch_req.conditions if c.op is not None]
         # phase 1: LAUNCH every supported block's fused grid (async — the
         # dispatches pipeline their device round trips) and run the host

@@ -88,6 +88,14 @@ class Generator:
                     disable_collection=lim.generator.disable_collection)
                 cfg.ingestion_time_range_slack_s = \
                     lim.generator.ingestion_time_range_slack_s
+                sm_patch = {}
+                if lim.generator.sketch:
+                    sm_patch["sketch"] = lim.generator.sketch
+                if lim.generator.sketch_moments_k:
+                    sm_patch["moments_k"] = lim.generator.sketch_moments_k
+                if sm_patch:
+                    cfg.spanmetrics = dataclasses.replace(
+                        cfg.spanmetrics, **sm_patch)
                 inst = self.instances[tenant] = GeneratorInstance(
                     tenant, cfg, now=self.now)
             return inst

@@ -28,11 +28,15 @@ import numpy as np
 
 from tempo_tpu.model.interner import INVALID_ID
 from tempo_tpu.model.span_batch import SpanBatch
-from tempo_tpu.ops import sketches
+from tempo_tpu.ops import moments, sketches
 from tempo_tpu.registry import metrics as rm
 from tempo_tpu.registry.registry import (DEFAULT_HISTOGRAM_EDGES,
                                          ManagedRegistry, _pad_len)
 from tempo_tpu.utils.spanfilter import FilterPolicy, compile_policies
+
+import logging
+
+_TIER_LOG = logging.getLogger("tempo_tpu.spanmetrics")
 
 _KIND_STRS = ("SPAN_KIND_UNSPECIFIED", "SPAN_KIND_INTERNAL", "SPAN_KIND_SERVER",
               "SPAN_KIND_CLIENT", "SPAN_KIND_PRODUCER", "SPAN_KIND_CONSUMER")
@@ -50,7 +54,15 @@ class SpanMetricsConfig:
     enable_target_info: bool = False
     filter_policies: tuple[FilterPolicy, ...] = ()
     span_multiplier_key: str = ""             # attr holding a weight multiplier
-    enable_quantile_sketch: bool = True       # DDSketch sidecar per series
+    enable_quantile_sketch: bool = True       # quantile sidecar per series
+    # quantile sketch tier: "dd" (the ~1100-bucket DDSketch plane,
+    # ≤1% relative error), "moments" (the ~15-float moments sketch of
+    # ops/moments.py — ~90x smaller state, psum-only combine, ≤5%-class
+    # quantiles via the maxent solver), or "both" (moments answers,
+    # DDSketch kept as the solver's per-series fallback). Per-tenant via
+    # the overrides `generator.sketch` knob.
+    sketch: str = "dd"
+    moments_k: int = 12                       # moment count (2..16)
     sketch_rel_err: float = 0.01              # DDSketch relative-error budget
     sketch_min_s: float = 1e-6                # 1µs .. ~28h latency range
     sketch_max_s: float = 1e5
@@ -64,9 +76,12 @@ class SpanMetricsConfig:
     use_scheduler: bool = True
 
 
-def _fused_update_impl(calls, latency, sizes, dd, slots, dur_s, size_bytes,
-                       weights):
-    """One device step for all spanmetrics families (slots shared)."""
+def _fused_update_impl(calls, latency, sizes, dd, mom, slots, dur_s,
+                       size_bytes, weights):
+    """One device step for all spanmetrics families (slots shared).
+    `dd` / `mom` are the optional quantile-sketch sidecars (the tier
+    knob: dd, moments, or both); a None sidecar traces to exactly the
+    pre-tier graph, keeping `sketch: dd` behavior bit-identical."""
     calls = rm.counter_update(calls, slots, weights)
     latency = rm.histogram_update(latency, slots, dur_s, weights)
     sizes = rm.counter_update(sizes, slots, size_bytes * weights)
@@ -74,7 +89,11 @@ def _fused_update_impl(calls, latency, sizes, dd, slots, dur_s, size_bytes,
         keep = (slots >= 0) & (slots < dd.counts.shape[0])
         dd = sketches.dd_update(dd, jax.numpy.where(keep, slots, 0), dur_s,
                                 mask=keep, weights=weights)
-    return calls, latency, sizes, dd
+    if mom is not None:
+        mkeep = (slots >= 0) & (slots < mom.data.shape[0])
+        mom = moments.moments_update(mom, slots, dur_s, mask=mkeep,
+                                     weights=weights)
+    return calls, latency, sizes, dd, mom
 
 
 # donating jit of the fused step: without donation every push COPIES the
@@ -87,10 +106,11 @@ from tempo_tpu.obs.jaxruntime import instrumented_jit
 
 _fused_update_donated = instrumented_jit(
     _fused_update_impl, name="spanmetrics_fused_update",
-    donate_argnums=(0, 1, 2, 3))
+    donate_argnums=(0, 1, 2, 3, 4))
 
 
-def _fused_update_packed_impl(calls, latency, sizes, dd, packed, weights):
+def _fused_update_packed_impl(calls, latency, sizes, dd, mom, packed,
+                              weights):
     """The fused step with (slots, dur_s, size_bytes) packed into ONE
     [3, cap] f32 H2D transfer (the staged fast paths): behind a
     high-latency device link the per-push transfer COUNT is the cost, not
@@ -102,29 +122,29 @@ def _fused_update_packed_impl(calls, latency, sizes, dd, packed, weights):
     state_lock across dispatch+rebind so the collection thread can never
     observe a donated-dead buffer."""
     slots = packed[0].astype(jax.numpy.int32)
-    return _fused_update_impl(calls, latency, sizes, dd, slots, packed[1],
-                              packed[2], weights)
+    return _fused_update_impl(calls, latency, sizes, dd, mom, slots,
+                              packed[1], packed[2], weights)
 
 
 _fused_update_packed = instrumented_jit(
     _fused_update_packed_impl, name="spanmetrics_fused_update_packed",
-    donate_argnums=(0, 1, 2, 3))
+    donate_argnums=(0, 1, 2, 3, 4))
 
 
-def _fused_update_packed4_impl(calls, latency, sizes, dd, packed):
+def _fused_update_packed4_impl(calls, latency, sizes, dd, mom, packed):
     """The scheduler-coalesced form: the merged batch arrives as ONE
     [4, bucket] f32 matrix (slots, dur_s, size_bytes, weights) — one H2D
     per merged dispatch, the coalescer-side twin of the [3, cap] packed
     push path. Slots ride f32 exactly under the same capacity < 2^24
     gate; padding rows carry slot -1 and drop on device."""
     slots = packed[0].astype(jax.numpy.int32)
-    return _fused_update_impl(calls, latency, sizes, dd, slots, packed[1],
-                              packed[2], packed[3])
+    return _fused_update_impl(calls, latency, sizes, dd, mom, slots,
+                              packed[1], packed[2], packed[3])
 
 
 _fused_update_packed4 = instrumented_jit(
     _fused_update_packed4_impl, name="spanmetrics_fused_update",
-    donate_argnums=(0, 1, 2, 3))
+    donate_argnums=(0, 1, 2, 3, 4))
 
 
 class SpanMetricsProcessor:
@@ -144,41 +164,84 @@ class SpanMetricsProcessor:
         self.sizes = registry.new_counter("traces_spanmetrics_size_total", self._labels)
         self.sizes.share_table(self.calls)
         # paged layout (registry/pages.py): families above came back
-        # paged; the sketch sidecar rides the same pool + shared backing
+        # paged; the sketch sidecars ride the same pool + shared backing
         self._pool = registry.pages
         self._paged = self._pool is not None and \
             hasattr(self.calls, "planes")
         self._pdd = None
+        self._pmom = None
         self._paged_steps: dict[bool, object] = {}
         dd_rows = min(cap, self.cfg.sketch_max_series)
-        if self._paged and self.cfg.enable_quantile_sketch:
+        # quantile sketch tier (ops/moments.py): which sidecar(s) the
+        # latency stream feeds. Unknown names fall back to "dd" with a
+        # warning (config.check() already surfaced the typo) so a bad
+        # override can never silently drop the quantile surface.
+        tier = self.cfg.sketch
+        if tier not in ("dd", "moments", "both"):
+            _TIER_LOG.warning(
+                "spanmetrics %s: unknown sketch tier %r (use dd | moments "
+                "| both) — falling back to dd", registry.tenant, tier)
+            tier = "dd"
+        self._tier = tier
+        dd_on = self.cfg.enable_quantile_sketch and tier in ("dd", "both")
+        mom_on = self.cfg.enable_quantile_sketch and \
+            tier in ("moments", "both")
+        if mom_on:
+            mk = max(2, min(int(self.cfg.moments_k), 16))
+            if mk != self.cfg.moments_k:
+                _TIER_LOG.warning(
+                    "spanmetrics %s: moments_k %d clamped to %d (supported "
+                    "range 2..16)", registry.tenant, self.cfg.moments_k, mk)
+            self._mom_meta = moments.moments_params(
+                mk, self.cfg.sketch_min_s, self.cfg.sketch_max_s)
+        else:
+            self._mom_meta = None
+        self.dd = None
+        self.mom = None
+        if self._paged and (dd_on or mom_on):
             from tempo_tpu.registry.pages import PagedPlane
-            gamma, nb = sketches.dd_params(self.cfg.sketch_rel_err,
-                                           self.cfg.sketch_min_s,
-                                           self.cfg.sketch_max_s)
             pr = self._pool.page_rows
             plane_rows = -(-dd_rows // pr) * pr  # page-aligned cover
-            ddc = PagedPlane(self._pool, "float32", nb, plane_rows,
-                             registry.tenant,
-                             role="traces_spanmetrics_latency/ddsketch")
-            ddz = PagedPlane(self._pool, "float32", 1, plane_rows,
-                             registry.tenant,
-                             role="traces_spanmetrics_latency/ddzeros")
             # back only the CONFIGURED sketch range: updates mask at
-            # dd_rows exactly like the dense plane, so collect/quantile
+            # dd_rows exactly like the dense planes, so collect/quantile
             # stay bit-identical to the dense layout
-            self.calls.table.backing.add_plane(ddc, dd_rows)
-            self.calls.table.backing.add_plane(ddz, dd_rows)
-            self._pdd = (ddc, ddz, gamma, self.cfg.sketch_min_s, dd_rows)
-            self.dd = None
+            if dd_on:
+                gamma, nb = sketches.dd_params(self.cfg.sketch_rel_err,
+                                               self.cfg.sketch_min_s,
+                                               self.cfg.sketch_max_s)
+                ddc = PagedPlane(self._pool, "float32", nb, plane_rows,
+                                 registry.tenant,
+                                 role="traces_spanmetrics_latency/ddsketch")
+                ddz = PagedPlane(self._pool, "float32", 1, plane_rows,
+                                 registry.tenant,
+                                 role="traces_spanmetrics_latency/ddzeros")
+                self.calls.table.backing.add_plane(ddc, dd_rows)
+                self.calls.table.backing.add_plane(ddz, dd_rows)
+                self._pdd = (ddc, ddz, gamma, self.cfg.sketch_min_s, dd_rows)
+            if mom_on:
+                mk, mlo, mhi = self._mom_meta
+                mp = PagedPlane(self._pool, "float32", moments.n_cols(mk),
+                                plane_rows, registry.tenant,
+                                role="traces_spanmetrics_latency/moments")
+                self.calls.table.backing.add_plane(mp, dd_rows)
+                self._pmom = (mp, mk, mlo, mhi, dd_rows)
         else:
-            # Sketch plane sized for HBM: [min(series), ~1.3k buckets] f32.
-            self.dd = (sketches.dd_init(dd_rows,
-                                        rel_err=self.cfg.sketch_rel_err,
-                                        min_value=self.cfg.sketch_min_s,
-                                        max_value=self.cfg.sketch_max_s)
-                       if self.cfg.enable_quantile_sketch else None)
-        if self._pdd is not None or self.dd is not None:
+            # Dense sidecar planes sized for HBM: DDSketch is
+            # [min(series), ~1.1k buckets] f32; the moments plane is
+            # [min(series), k+3] — the ~90x state shrink of the tier.
+            if dd_on:
+                self.dd = sketches.dd_init(dd_rows,
+                                           rel_err=self.cfg.sketch_rel_err,
+                                           min_value=self.cfg.sketch_min_s,
+                                           max_value=self.cfg.sketch_max_s)
+            if mom_on:
+                mk, mlo, mhi = self._mom_meta
+                self.mom = moments.MomentsSketch(
+                    data=jax.numpy.zeros((dd_rows, moments.n_cols(mk)),
+                                         jax.numpy.float32),
+                    k=mk, lo=mlo, hi=mhi)
+        if self._pdd is not None or self._pmom is not None or \
+                self.dd is not None or self.mom is not None:
             # eviction must clear the sketch sidecar's rows along with
             # the family planes: a reused slot starting from another
             # series' latency history would corrupt its quantiles
@@ -245,13 +308,16 @@ class SpanMetricsProcessor:
 
     def _mesh_fused_step(self, sm, packed: bool = False):
         dd = self.dd
+        mom = self.mom
         return sm.serving_step(
             tuple(self.latency.state.edges),
             dd.gamma if dd is not None else sketches.dd_params(0.01)[0],
             dd.min_value if dd is not None else 1e-9,
             self.calls.table.capacity,
             dd.counts.shape[0] if dd is not None else 0,
-            packed=packed)
+            packed=packed,
+            mom_rows=mom.data.shape[0] if mom is not None else 0,
+            mom_meta=(mom.k, mom.lo, mom.hi) if mom is not None else None)
 
     def _mesh_step_rebind(self, sm, step, batch) -> None:
         """Run one sharded donating step over the live state and rebind
@@ -259,24 +325,30 @@ class SpanMetricsProcessor:
         donation deletes the old shards at dispatch for any concurrent
         reader, so the whole call+rebind sits under the lock."""
         with self.registry.state_lock:
-            cs, hs, zs, dd = (self.calls.state, self.latency.state,
-                              self.sizes.state, self.dd)
+            cs, hs, zs, dd, mom = (self.calls.state, self.latency.state,
+                                   self.sizes.state, self.dd, self.mom)
             if getattr(cs.values, "sharding", None) != sm.series_1d:
                 # a stale-series purge's eager zero_slots may have moved
                 # the state off its mesh placement; re-place before the
                 # donating sharded dispatch (rare — eviction cadence)
                 from tempo_tpu.parallel import serving
                 serving.place_spanmetrics_state(self, sm)
-                cs, hs, zs, dd = (self.calls.state, self.latency.state,
-                                  self.sizes.state, self.dd)
+                cs, hs, zs, dd, mom = (self.calls.state, self.latency.state,
+                                       self.sizes.state, self.dd, self.mom)
+            args = [cs.values, hs.bucket_counts, hs.sums, hs.counts,
+                    zs.values]
             if dd is not None:
-                out = step(cs.values, hs.bucket_counts, hs.sums, hs.counts,
-                           zs.values, dd.counts, dd.zeros, *batch)
+                args += [dd.counts, dd.zeros]
+            if mom is not None:
+                args.append(mom.data)
+            out = step(*args, *batch)
+            i = 5
+            if dd is not None:
                 self.dd = sketches.DDSketch(out[5], out[6], dd.gamma,
                                             dd.min_value)
-            else:
-                out = step(cs.values, hs.bucket_counts, hs.sums, hs.counts,
-                           zs.values, *batch)
+                i = 7
+            if mom is not None:
+                self.mom = dataclasses.replace(mom, data=out[i])
             self.calls.state = rm.CounterState(out[0])
             self.latency.state = rm.HistogramState(out[1], out[2], out[3],
                                                    hs.edges)
@@ -371,9 +443,9 @@ class SpanMetricsProcessor:
         exact for the commutative sketch updates."""
         with self.registry.state_lock:
             (self.calls.state, self.latency.state, self.sizes.state,
-             self.dd) = _fused_update_donated(
+             self.dd, self.mom) = _fused_update_donated(
                 self.calls.state, self.latency.state, self.sizes.state,
-                self.dd, slots, dur_s, sizes, weights)
+                self.dd, self.mom, slots, dur_s, sizes, weights)
 
     def _sched_dispatch_packed(self, packed) -> None:
         """Packed-coalescer dispatch: the merged batch is one [4, bucket]
@@ -382,9 +454,9 @@ class SpanMetricsProcessor:
         f32)."""
         with self.registry.state_lock:
             (self.calls.state, self.latency.state, self.sizes.state,
-             self.dd) = _fused_update_packed4(
+             self.dd, self.mom) = _fused_update_packed4(
                 self.calls.state, self.latency.state, self.sizes.state,
-                self.dd, packed)
+                self.dd, self.mom, packed)
 
     # -- paged route (registry/pages.py + ops/pages.py) --------------------
 
@@ -406,6 +478,8 @@ class SpanMetricsProcessor:
         dd_rows = self._pdd[4] if self._pdd is not None else 0
         gamma = self._pdd[2] if self._pdd is not None else 1.0202
         minv = self._pdd[3] if self._pdd is not None else 1e-9
+        mom_rows = self._pmom[4] if self._pmom is not None else 0
+        mom_meta = tuple(self._pmom[1:4]) if self._pmom is not None else None
         mesh = pool.mesh
         if mesh is None:
             mesh_key = jmesh = None
@@ -421,7 +495,8 @@ class SpanMetricsProcessor:
             tuple(self.cfg.histogram_buckets), gamma, minv, dd_rows,
             pool.page_shift, packed,
             mesh_key=mesh_key, mesh=jmesh,
-            series_shards=1 if mesh is None else mesh.series_shards)
+            series_shards=1 if mesh is None else mesh.series_shards,
+            mom_rows=mom_rows, mom_meta=mom_meta)
 
     def _paged_update(self, slots, dur_s, sizes, weights) -> None:
         """One paged fused update: gather each row's physical page
@@ -446,12 +521,14 @@ class SpanMetricsProcessor:
     def _paged_planes(self):
         """Role-aligned plane tuple for the fused paged step: (calls,
         hist_sums, hist_counts, sizes, hist_buckets[, dd_zeros,
-        dd_counts])."""
+        dd_counts][, moments])."""
         lat = self.latency
         planes = (self.calls.values, lat.sums, lat.counts,
                   self.sizes.values, lat.buckets)
         if self._pdd is not None:
             planes += (self._pdd[1], self._pdd[0])
+        if self._pmom is not None:
+            planes += (self._pmom[0],)
         return planes
 
     def _paged_args(self):
@@ -695,18 +772,18 @@ class SpanMetricsProcessor:
             packed[0] = slots
             with self.registry.state_lock:
                 (self.calls.state, self.latency.state, self.sizes.state,
-                 self.dd) = _fused_update_packed(
+                 self.dd, self.mom) = _fused_update_packed(
                     self.calls.state, self.latency.state, self.sizes.state,
-                    self.dd, packed, ones)
+                    self.dd, self.mom, packed, ones)
         else:
             # same donation + lock discipline as the packed branch — an
             # unlocked non-donating dispatch here could read buffers the
             # dict route just donated
             with self.registry.state_lock:
                 (self.calls.state, self.latency.state, self.sizes.state,
-                 self.dd) = _fused_update_donated(
+                 self.dd, self.mom) = _fused_update_donated(
                     self.calls.state, self.latency.state, self.sizes.state,
-                    self.dd, slots, packed[1], packed[2], ones)
+                    self.dd, self.mom, slots, packed[1], packed[2], ones)
         self.calls.note_exemplars(slots[:n], trace_ids, packed[1],
                                   int(now * 1000))
         self.latency.exemplars = self.calls.exemplars
@@ -785,9 +862,9 @@ class SpanMetricsProcessor:
             else:
                 with self.registry.state_lock:
                     (self.calls.state, self.latency.state, self.sizes.state,
-                     self.dd) = _fused_update_donated(
+                     self.dd, self.mom) = _fused_update_donated(
                         self.calls.state, self.latency.state,
-                        self.sizes.state, self.dd, slots, dur_s,
+                        self.sizes.state, self.dd, self.mom, slots, dur_s,
                         span_sizes.astype(np.float32), weights)
         ts_ms = int(self.registry.now() * 1000)
         self.calls.note_exemplars(slots, sb.trace_id, dur_s, ts_ms)
@@ -810,21 +887,33 @@ class SpanMetricsProcessor:
             self._pdd[1].zero_slots(s)
         elif self.dd is not None:
             self.dd = rm.zero_slots(self.dd, padded)
+        if self._pmom is not None:
+            s = np.where(padded < self._pmom[4], padded, -1)
+            self._pmom[0].zero_slots(s)
+        elif self.mom is not None:
+            self.mom = moments.moments_zero_slots(self.mom, padded)
 
     def device_state_bytes(self) -> int:
         """Device bytes of the processor-OWNED sketch sidecar (the
         registry families report their own); paged: backed pages only."""
+        total = 0
         if self._pdd is not None:
-            return (self._pdd[0].device_state_bytes()
-                    + self._pdd[1].device_state_bytes())
-        if self.dd is not None:
-            return int(self.dd.counts.nbytes) + int(self.dd.zeros.nbytes)
-        return 0
+            total += (self._pdd[0].device_state_bytes()
+                      + self._pdd[1].device_state_bytes())
+        elif self.dd is not None:
+            total += int(self.dd.counts.nbytes) + int(self.dd.zeros.nbytes)
+        if self._pmom is not None:
+            total += self._pmom[0].device_state_bytes()
+        elif self.mom is not None:
+            total += int(self.mom.data.nbytes)
+        return total
 
     def quantile(self, q: float) -> dict[tuple[tuple[str, str], ...], float]:
-        """Per-series latency quantile from the DDSketch plane (<1% error).
+        """Per-series latency quantile from the configured sketch tier.
         Takes the registry state lock: the packed ingest path DONATES the
-        previous dd buffers at dispatch."""
+        previous sketch buffers at dispatch."""
+        if self._pmom is not None or self.mom is not None:
+            return self._moments_quantile(q)
         if self._pdd is not None:
             return self._paged_quantile(q)
         if self.dd is None:
@@ -846,6 +935,85 @@ class SpanMetricsProcessor:
         slots = self.calls.table.active_slots()
         slots = slots[slots < nrows]
         return {self.calls.labels_of(int(s)): float(vals[int(s)]) for s in slots}
+
+    def _moments_quantile(self, q: float) -> dict:
+        """Moments-tier quantile: gather the ~15-float rows of the
+        active slots (dense slice or one paged gather — versus the
+        ~1100-bucket DDSketch rows of the dd tier), run the host maxent
+        solver once per distinct row (cached), and substitute the
+        bucket-sketch answer for any row whose solve failed to converge
+        ("both": the DDSketch value; "moments": the classic latency
+        histogram interpolation). Solver fallbacks increment
+        tempo_moments_solver_fallback_total."""
+        from tempo_tpu import sched as sched_mod
+        sched_mod.flush()
+        mk, mlo, mhi = self._mom_meta
+        with self.registry.state_lock:
+            limit = self._pmom[4] if self._pmom is not None \
+                else self.mom.data.shape[0]
+            slots = self.calls.table.active_slots()
+            slots = slots[slots < limit]
+            if not slots.size:
+                return {}
+            if self._pmom is not None:
+                padded = np.full(_pad_len(slots.size), -1, np.int32)
+                padded[:slots.size] = slots
+                rows = self._pmom[0].gather(padded)[:slots.size]
+            else:
+                rows = np.asarray(self.mom.data)[slots]
+        vals, failed = moments.quantiles_for_rows(rows, mk, mlo, mhi, [q])
+        vals = vals[:, 0]
+        if failed.any():
+            vals = self._sketch_fallback(q, slots, vals, failed)
+        return {self.calls.labels_of(int(s)): float(vals[i])
+                for i, s in enumerate(slots.tolist())}
+
+    def _sketch_fallback(self, q: float, slots: np.ndarray,
+                         vals: np.ndarray, failed: np.ndarray) -> np.ndarray:
+        """Fill failed moments solves from the bucket sketches (under
+        the state lock — a concurrent donating push invalidates the
+        buffers otherwise)."""
+        idx = np.flatnonzero(failed)
+        with self.registry.state_lock:
+            if self._pdd is not None or self.dd is not None:
+                if self._pdd is not None:
+                    ddc, ddz, gamma, minv, dd_rows = self._pdd
+                    padded = np.full(_pad_len(idx.size), -1, np.int32)
+                    padded[:idx.size] = slots[idx]
+                    dd = sketches.DDSketch(ddc.gather_dev(padded),
+                                           ddz.gather_dev(padded),
+                                           gamma, minv)
+                    vals[idx] = np.asarray(
+                        sketches.dd_quantile(dd, q))[:idx.size]
+                else:
+                    dq = np.asarray(sketches.dd_quantile(self.dd, q))
+                    vals[idx] = dq[slots[idx]]
+                return vals
+            # moments-only tier: interpolate the classic latency
+            # histogram (the log2-class bounded-resolution answer)
+            edges = np.asarray(self.cfg.histogram_buckets, np.float64)
+            if self._paged:
+                padded = np.full(_pad_len(idx.size), -1, np.int32)
+                padded[:idx.size] = slots[idx]
+                bc = self.latency.buckets.gather(padded)[:idx.size]
+            else:
+                bc = np.asarray(self.latency.state.bucket_counts)[slots[idx]]
+        cum = np.cumsum(np.asarray(bc, np.float64), axis=1)
+        total = cum[:, -1]
+        target = np.maximum(q * total, 1e-12)
+        b = np.minimum((cum < target[:, None]).sum(axis=1),
+                       cum.shape[1] - 1)
+        prev = np.where(b > 0, cum[np.arange(len(b)), np.maximum(b - 1, 0)],
+                        0.0)
+        inb = bc[np.arange(len(b)), b]
+        frac = np.where(inb > 0, (target - prev) / np.maximum(inb, 1e-30),
+                        1.0)
+        lo = np.where(b > 0, edges[np.minimum(np.maximum(b - 1, 0),
+                                              len(edges) - 1)], 0.0)
+        hi = edges[np.minimum(b, len(edges) - 1)]
+        est = np.where(total > 0, lo + (hi - lo) * frac, 0.0)
+        vals[idx] = est
+        return vals
 
     def _paged_quantile(self, q: float) -> dict:
         """Paged sketch quantile: gather the active slots' rows through

@@ -40,5 +40,13 @@ from tempo_tpu.ops.sketches import (
     log2_hist_update,
     log2_quantile,
 )
+from tempo_tpu.ops.moments import (
+    MomentsSketch,
+    moments_init,
+    moments_merge,
+    moments_update,
+    moments_zero_slots,
+    solve_quantiles,
+)
 
 __all__ = [k for k in dir() if not k.startswith("_")]

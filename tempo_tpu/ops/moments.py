@@ -1,0 +1,485 @@
+"""Moments sketch: ~15-float mergeable quantiles with psum-only combine.
+
+"Moment-Based Quantile Sketches" (Gan et al., PAPERS.md) gets ≤1%-class
+quantiles from a handful of floats per series — versus the 64-bucket
+log2 grids behind `quantile_over_time` and the ~1100-bucket DDSketch
+plane behind spanmetrics `quantile()` — and, unlike bucket histograms,
+merging is a plain elementwise SUM: cross-shard / cross-block /
+cross-process quantile combine collapses to one `psum` of tiny moment
+vectors instead of shipping full bucket grids.
+
+Representation (the f32-native translation of the paper's sketch):
+
+    data[S, k+3]  per-series rows, k static (default 12):
+      col 0        weighted count  Σ w
+      col 1..k     Chebyshev-basis log-moment sums  Σ w·T_i(s),
+                   s = clip((log x − c) / h, −1, 1) over the STATIC
+                   domain [lo, hi] = [log min_value, log max_value],
+                   c = (lo+hi)/2, h = (hi−lo)/2
+      col k+1      running max of (log x − lo)  (≥ 0)  → data max bound
+      col k+2      running max of (hi − log x)  (≥ 0)  → data min bound
+
+Two deliberate deviations from the paper, both forced by f32 arenas:
+
+- **Log-domain only.** Raw power sums x^1..x^k overflow float32 at k=12
+  for any latency range wider than a few decades (1e5^12 ≈ 1e60 ≫
+  3.4e38). log x is bounded by the configured domain, so every basis
+  value is in [−1, 1] and sums stay exactly conditioned.
+- **Chebyshev basis accumulated ON DEVICE.** The paper accumulates raw
+  power sums (in f64) and Chebyshev-scales at solve time; that
+  conversion is catastrophically ill-conditioned (binomial cancellation
+  ~(domain/support)^k) at f32 precision. Computing T_i(s) in the update
+  kernel (a k-step recurrence, fully vectorized) hands the solver
+  well-scaled moments directly — this is the TPU-native move.
+
+The two bound columns are shifted so they are non-negative with 0 ==
+"no data": a zero-initialized (or page-pool-recycled) row is a valid
+empty sketch, and the columns merge by elementwise MAX (pmax in-mesh —
+also a single tiny collective). Everything else merges by ADD.
+
+Quantile recovery (`solve_quantiles` / `quantiles_for_rows`) runs on
+host in f64: maximum-entropy density exp(Σ λ_j T_j(s)) matched to the
+sketch moments by damped Newton, with three robustness moves that the
+fuzz workloads (tight clusters, far-apart bimodals, point masses)
+require:
+
+- quadrature restricted to the observed data support (the bound
+  columns), not the full static domain;
+- `lstsq` Newton steps (pseudo-inverse): on a narrow support the
+  restricted basis is nearly collinear and a plain solve diverges —
+  the cutoff acts as automatic effective-order reduction;
+- warm-started order escalation 2 → 4 → … → k_eff, keeping the highest
+  order that converged (order 2 == a lognormal fit, which always
+  converges for feasible moments);
+- a NOISE-FLOOR order cap: when the data occupy a narrow slice of the
+  static domain (support ratio r = support/domain half-widths), the
+  global-basis moments above order log(η)/log(r) carry less independent
+  information than the f32 accumulation noise η ≈ 1e-6 — fitting them
+  reproduces noise amplified ~1e4x into the quantiles. The cap degrades
+  gracefully: a point-like cluster solves at order 2 (pure lognormal
+  fit), full-domain data use every moment.
+
+Quantiles for all q's come from ONE solved CDF, so they are monotone in
+q by construction. A solve that fails even at order 2 reports failure
+(`tempo_moments_solver_fallback_total`) and the caller falls back to
+its bucket-sketch answer (DDSketch / log2 / classic histogram).
+Converged solutions are memoized per moment vector (an LRU keyed on the
+row bytes) — steady-state collects re-solve only series that changed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+from collections import OrderedDict
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_K = 12
+# TraceQL quantile_over_time domain: raw values clamped to [1, 1e14]
+# (nanoseconds: 1ns .. ~28h), mirroring log2_bucket_np's max(v, 1) clamp
+# and the 64-bucket grid's 2^63-ish ceiling.
+QUERY_K = 12
+QUERY_LO = 0.0
+QUERY_HI = math.log(1e14)
+
+
+def n_cols(k: int) -> int:
+    """Row width of a k-moment sketch: count + k sums + 2 bounds."""
+    return k + 3
+
+
+# ---------------------------------------------------------------------------
+# device sketch
+# ---------------------------------------------------------------------------
+
+@partial(jax.tree_util.register_dataclass, data_fields=["data"],
+         meta_fields=["k", "lo", "hi"])
+@dataclasses.dataclass(frozen=True)
+class MomentsSketch:
+    """Per-series moment rows: data[S, k+3] (see module docstring)."""
+
+    data: jax.Array  # [S, k+3] float32
+    k: int           # static: number of Chebyshev moments
+    lo: float        # static: log-domain lower bound (log min_value)
+    hi: float        # static: log-domain upper bound (log max_value)
+
+
+def moments_params(k: int = DEFAULT_K, min_value: float = 1e-6,
+                   max_value: float = 1e5) -> tuple[int, float, float]:
+    if not (0 < min_value < max_value):
+        raise ValueError(
+            f"moments domain needs 0 < min_value ({min_value}) < "
+            f"max_value ({max_value})")
+    return int(k), math.log(min_value), math.log(max_value)
+
+
+def moments_init(num_series: int, k: int = DEFAULT_K,
+                 min_value: float = 1e-6,
+                 max_value: float = 1e5) -> MomentsSketch:
+    k, lo, hi = moments_params(k, min_value, max_value)
+    return MomentsSketch(
+        data=jnp.zeros((num_series, n_cols(k)), jnp.float32),
+        k=k, lo=lo, hi=hi)
+
+
+def chebyshev_basis(s: jax.Array, k: int):
+    """T_0..T_k of s (any backend: jnp on device, np in the solver).
+    Returns a list of k+1 arrays shaped like `s`."""
+    xp = jnp if isinstance(s, jax.Array) else np
+    out = [xp.ones_like(s)]
+    if k >= 1:
+        out.append(s)
+    for _ in range(2, k + 1):
+        out.append(2.0 * s * out[-1] - out[-2])
+    return out
+
+
+def moments_basis(values: jax.Array, k: int, lo: float, hi: float):
+    """(z, basis[n, k+1]) for raw positive values: z = clipped log,
+    basis columns are [1, T_1(s), ..., T_k(s)]."""
+    v = jnp.asarray(values, jnp.float32)
+    z = jnp.log(jnp.clip(v, math.exp(lo), math.exp(hi)))
+    c, h = (lo + hi) / 2.0, (hi - lo) / 2.0
+    s = jnp.clip((z - c) / h, -1.0, 1.0)
+    return z, jnp.stack(chebyshev_basis(s, k), axis=-1)
+
+
+def moments_update(state: MomentsSketch, series_ids: jax.Array,
+                   values: jax.Array, mask: jax.Array | None = None,
+                   weights: jax.Array | None = None) -> MomentsSketch:
+    """Scatter a batch of observations into per-series moment rows.
+
+    jit-safe, static-shape; padding rows are handled exactly like the
+    other sketches: negative slots (or masked rows) redirect out of
+    bounds and drop on device. Weights scale the count and every moment
+    sum (Horvitz–Thompson compatible); the bound columns take the
+    unweighted value (a sampled observation still bounds the support).
+    """
+    k, w3 = state.k, n_cols(state.k)
+    S = state.data.shape[0]
+    sids = jnp.asarray(series_ids, jnp.int32)
+    v = jnp.asarray(values, jnp.float32)
+    w = jnp.ones_like(v) if weights is None \
+        else jnp.asarray(weights, jnp.float32)
+    if mask is not None:
+        sids = jnp.where(mask, sids, -1)
+    sids = jnp.where(sids < 0, S, sids)          # OOB → mode="drop"
+    z, basis = moments_basis(v, k, state.lo, state.hi)
+    flat = state.data.reshape(-1)
+    # count + moment sums: one scatter-add over [n, k+1] flat indices
+    cols = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+    idx = sids[:, None] * w3 + cols              # [n, k+1]; OOB rows drop
+    flat = flat.at[idx.reshape(-1)].add(
+        (basis * w[:, None]).reshape(-1), mode="drop")
+    # bounds: shifted non-negative running maxes (0 == no data). Weight-0
+    # rows (masked by weight rather than mask) still drop via sids above;
+    # sampled rows keep their true value in the bounds.
+    bidx = jnp.stack([sids * w3 + (k + 1), sids * w3 + (k + 2)], axis=-1)
+    bval = jnp.stack([z - state.lo, state.hi - z], axis=-1)
+    flat = flat.at[bidx.reshape(-1)].max(
+        jnp.maximum(bval, 0.0).reshape(-1), mode="drop")
+    return dataclasses.replace(state, data=flat.reshape(state.data.shape))
+
+
+def merge_meta_check(a: MomentsSketch, b: MomentsSketch) -> None:
+    if (a.k, a.lo, a.hi) != (b.k, b.lo, b.hi) or \
+            a.data.shape != b.data.shape:
+        raise ValueError(
+            "moments_merge: incompatible sketches "
+            f"(k={a.k}/{b.k}, lo={a.lo:.6g}/{b.lo:.6g}, "
+            f"hi={a.hi:.6g}/{b.hi:.6g}, "
+            f"shape={a.data.shape}/{b.data.shape})")
+
+
+def moments_merge(a: MomentsSketch, b: MomentsSketch) -> MomentsSketch:
+    """Combine: ADD for count+moment sums (psum across shards), MAX for
+    the two bound columns (pmax) — both tiny elementwise collectives."""
+    merge_meta_check(a, b)
+    k = a.k
+    summed = a.data[..., :k + 1] + b.data[..., :k + 1]
+    bounds = jnp.maximum(a.data[..., k + 1:], b.data[..., k + 1:])
+    return dataclasses.replace(
+        a, data=jnp.concatenate([summed, bounds], axis=-1))
+
+
+def moments_merge_rows(a: np.ndarray, b: np.ndarray, k: int) -> np.ndarray:
+    """Host-side row merge (frontend combine): [.., k+3] f64 rows."""
+    out = a + b
+    out[..., k + 1:] = np.maximum(a[..., k + 1:], b[..., k + 1:])
+    return out
+
+
+def moments_zero_slots(state: MomentsSketch, slots) -> MomentsSketch:
+    """Zero evicted slots' rows (staleness purge; a zero row IS the
+    empty sketch, so slot reuse starts clean)."""
+    s = jnp.asarray(slots, jnp.int32)
+    return dataclasses.replace(
+        state, data=state.data.at[s, :].set(0.0, mode="drop"))
+
+
+def moments_place(state: MomentsSketch, sharding_2d) -> MomentsSketch:
+    """Re-place the plane onto the serving mesh ('series'-sharded rows).
+    Idempotent."""
+    return dataclasses.replace(
+        state, data=jax.device_put(state.data, sharding_2d))
+
+
+# ---------------------------------------------------------------------------
+# host solver: maximum-entropy quantiles from one moment row
+# ---------------------------------------------------------------------------
+
+_GRID = 512          # quadrature points over the data support
+_MAX_ITER = 40
+_CACHE_MAX = 4096
+_NOISE_FLOOR = 1e-6  # f32 moment accumulation noise (order-cap input)
+
+# process-wide solve accounting (rendered by the RUNTIME families below)
+_stats_lock = threading.Lock()
+solves_total = 0
+fallbacks_total = 0
+cache_hits_total = 0
+solve_seconds_total = 0.0
+
+_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
+
+
+def reset_solver_cache() -> None:
+    """Drop the solution cache (tests that count solves/cache hits)."""
+    global solves_total, fallbacks_total, cache_hits_total
+    global solve_seconds_total
+    with _stats_lock:
+        _CACHE.clear()
+        solves_total = fallbacks_total = cache_hits_total = 0
+        solve_seconds_total = 0.0
+
+
+def _newton(T: np.ndarray, w: np.ndarray, mu: np.ndarray,
+            lam0: np.ndarray) -> tuple[np.ndarray, bool]:
+    """Damped Newton on the maxent dual; returns (λ, converged)."""
+    lam = lam0.copy()
+
+    def dual(l):
+        return float(np.sum(np.exp(np.minimum(T.T @ l, 500.0)) * w)
+                     - l @ mu)
+
+    g = None
+    for _ in range(_MAX_ITER):
+        p = np.exp(np.minimum(T.T @ lam, 500.0))
+        pw = p * w
+        g = T @ pw - mu
+        if np.max(np.abs(g)) < 1e-8:
+            return lam, True
+        H = (T * pw) @ T.T
+        try:
+            d = np.linalg.lstsq(H, g, rcond=1e-12)[0]
+        except np.linalg.LinAlgError:
+            return lam, False
+        f0 = dual(lam)
+        step, stepped = 1.0, False
+        while step > 1e-7:
+            cand = lam - step * d
+            if dual(cand) < f0 - 1e-14:
+                lam, stepped = cand, True
+                break
+            step *= 0.5
+        if not stepped:
+            break
+    return lam, bool(g is not None and np.max(np.abs(g)) < 1e-4)
+
+
+def _solve_cdf(vec: np.ndarray, k: int, lo: float, hi: float):
+    """One moment row [k+3] → (s_grid, cdf, c, h) or None (no converged
+    order). Degenerate supports return a point CDF."""
+    n = float(vec[0])
+    if n <= 0:
+        return None
+    c, h = (lo + hi) / 2.0, (hi - lo) / 2.0
+    zmax = lo + max(float(vec[k + 1]), 0.0)
+    zmin = hi - max(float(vec[k + 2]), 0.0)
+    zmin, zmax = max(min(zmin, zmax), lo), min(max(zmin, zmax), hi)
+    smin, smax = (zmin - c) / h, (zmax - c) / h
+    if smax - smin < 1e-7:
+        s0 = (smin + smax) / 2.0
+        return (np.array([s0, s0]), np.array([0.0, 1.0]), c, h)
+    pad = 0.005 * (smax - smin)
+    a, b = smin - pad, smax + pad
+    s = np.linspace(a, b, _GRID)
+    w = np.full(_GRID, (b - a) / (_GRID - 1))
+    w[0] *= 0.5
+    w[-1] *= 0.5
+    # noise-floor order cap (module docstring): trust only the moments
+    # whose support-localized signal r^j clears the f32 noise floor
+    r = max((smax - smin) / 2.0, 1e-9)
+    if r >= 1.0:
+        k_eff = k
+    else:
+        j = int(math.log(_NOISE_FLOOR) / math.log(r))
+        k_eff = max(2, min(k, j - (j % 2)))
+    T = np.stack(chebyshev_basis(s, k_eff))       # [k_eff+1, grid]
+    mu = np.asarray(vec[:k_eff + 1], np.float64) / n
+    mu[0] = 1.0
+    lam = np.zeros(k_eff + 1)
+    lam[0] = -math.log(b - a)
+    converged = False
+    # warm-started order escalation: the order-2 fit (≈ lognormal) is
+    # the safety net; each further pair of moments refines it
+    for kk in range(2, k_eff + 1, 2):
+        lam_kk, ok = _newton(T[:kk + 1], w, mu[:kk + 1], lam[:kk + 1])
+        if not ok:
+            break
+        lam[:kk + 1] = lam_kk
+        lam[kk + 1:] = 0.0
+        converged = True
+    if not converged:
+        return None
+    p = np.exp(np.minimum(T.T @ lam, 500.0)) * w
+    cdf = np.cumsum(p)
+    tot = cdf[-1]
+    if not np.isfinite(tot) or tot <= 0:
+        return None
+    return (s, cdf / tot, c, h)
+
+
+def solve_quantiles(vec: np.ndarray, k: int, lo: float, hi: float,
+                    qs) -> "np.ndarray | None":
+    """Quantile VALUES (exp of the log-domain quantiles) for every q in
+    `qs`, from one moment row [k+3]. All q's are read off a single
+    solved CDF, so the result is monotone in q. None when the solver
+    failed to converge (callers fall back + the counter increments) or
+    the row is empty."""
+    global solves_total, fallbacks_total, cache_hits_total
+    global solve_seconds_total
+    row = np.asarray(vec, np.float64)
+    if row[0] <= 0:
+        return None
+    # key includes the solve domain: byte-identical rows from tenants
+    # with DIFFERENT (k, lo, hi) configs solve to different CDFs
+    key = (int(k), float(lo), float(hi), row.tobytes())
+    with _stats_lock:
+        got = _CACHE.get(key)
+        if got is not None:
+            _CACHE.move_to_end(key)
+            cache_hits_total += 1
+    if got is None:
+        t0 = time.perf_counter()
+        got = _solve_cdf(row, k, lo, hi)
+        dt = time.perf_counter() - t0
+        with _stats_lock:
+            solves_total += 1
+            solve_seconds_total += dt
+            if got is None:
+                fallbacks_total += 1
+            else:
+                _CACHE[key] = got
+                while len(_CACHE) > _CACHE_MAX:
+                    _CACHE.popitem(last=False)
+    if got is None:
+        return None
+    s, cdf, c, h = got
+    zq = np.interp(np.asarray(qs, np.float64), cdf, s) * h + c
+    return np.exp(zq)
+
+
+def quantiles_for_rows(rows: np.ndarray, k: int, lo: float, hi: float,
+                       qs) -> tuple[np.ndarray, np.ndarray]:
+    """Batched solve: rows [m, k+3] → (values [m, len(qs)], failed [m]
+    bool). Failed rows get NaN values — the caller substitutes its
+    bucket-sketch fallback. Empty rows (count 0) are NOT failures; they
+    return 0.0 like the bucket sketches do."""
+    rows = np.asarray(rows, np.float64)
+    m = rows.shape[0]
+    out = np.zeros((m, len(qs)), np.float64)
+    failed = np.zeros(m, bool)
+    for i in range(m):
+        if rows[i, 0] <= 0:
+            continue
+        vals = solve_quantiles(rows[i], k, lo, hi, qs)
+        if vals is None:
+            failed[i] = True
+            out[i] = np.nan
+        else:
+            out[i] = vals
+    return out, failed
+
+
+# ---------------------------------------------------------------------------
+# TraceQL query tier (process-wide, configured by App from the
+# `generator.spanmetrics.sketch` knob)
+# ---------------------------------------------------------------------------
+
+_query_tier = "log2"
+
+
+def set_query_tier(tier: str) -> None:
+    """Select the quantile_over_time accumulation axis: "log2" (the
+    [series, steps, 64] bucket grid — the default and the `dd`/`both`
+    behavior) or "moments" ([series, steps, k+1] moment grids + bound
+    planes). Process-wide, like the sched/mesh/pages state."""
+    global _query_tier
+    _query_tier = "moments" if tier == "moments" else "log2"
+
+
+def query_moments_active() -> bool:
+    return _query_tier == "moments"
+
+
+class use_query_tier:
+    """Install a query tier for a with-block (tests, bench arms)."""
+
+    def __init__(self, tier: str) -> None:
+        self.tier = tier
+        self._prev = "log2"
+
+    def __enter__(self):
+        global _query_tier
+        self._prev = _query_tier
+        set_query_tier(self.tier)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _query_tier
+        _query_tier = self._prev
+
+
+# ---------------------------------------------------------------------------
+# obs: moments-solver families in the process-wide runtime registry
+# ---------------------------------------------------------------------------
+
+from tempo_tpu.obs.jaxruntime import RUNTIME  # noqa: E402
+
+RUNTIME.counter_func(
+    "tempo_moments_solves_total",
+    lambda: [((), float(solves_total))],
+    help="Maximum-entropy solves of moments-sketch rows (cache misses; "
+         "steady-state collects re-solve only changed series)")
+RUNTIME.counter_func(
+    "tempo_moments_solver_fallback_total",
+    lambda: [((), float(fallbacks_total))],
+    help="Moments-sketch solves that failed to converge at every order "
+         "— the caller served its bucket-sketch fallback instead. "
+         "Nonzero in steady state means the tier is misconfigured for "
+         "this workload (runbook 'Choosing a quantile sketch tier')")
+RUNTIME.counter_func(
+    "tempo_moments_solve_cache_hits_total",
+    lambda: [((), float(cache_hits_total))],
+    help="Moments quantile reads served from the per-row solution cache")
+RUNTIME.counter_func(
+    "tempo_moments_solve_seconds_total",
+    lambda: [((), float(solve_seconds_total))],
+    help="Host wall seconds spent in the maxent quantile solver")
+
+
+__all__ = ["MomentsSketch", "moments_params", "moments_init",
+           "moments_update", "moments_merge", "moments_merge_rows",
+           "moments_zero_slots", "moments_place", "moments_basis",
+           "chebyshev_basis", "merge_meta_check", "solve_quantiles",
+           "quantiles_for_rows", "reset_solver_cache", "set_query_tier",
+           "query_moments_active", "use_query_tier", "n_cols",
+           "DEFAULT_K", "QUERY_K", "QUERY_LO", "QUERY_HI"]

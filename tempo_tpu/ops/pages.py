@@ -304,20 +304,46 @@ def zero_pages_step(ndim: int, page_rows: int):
 # the fused spanmetrics step (calls + latency hist + size + DDSketch)
 # ---------------------------------------------------------------------------
 
+def _moments_scatter(am, table, slots, dur_s, w, mom_meta: tuple,
+                     page_shift: int):
+    """Paged moments-sketch update (ops/moments.py layout): count +
+    Chebyshev log-moment sums scatter-add into columns 0..k of the
+    [Rm, k+3] arena row the page table resolves; the two shifted bound
+    columns scatter-MAX. Discard slots translate OOB and drop."""
+    from tempo_tpu.ops import moments as msk
+
+    mk, mlo, mhi = mom_meta
+    r = translate(table, slots, page_shift, am.shape[0])
+    z, basis = msk.moments_basis(dur_s, mk, mlo, mhi)
+    cols = jnp.arange(mk + 1, dtype=jnp.int32)[None, :]
+    am = am.at[r[:, None], cols].add(basis * w[:, None], mode="drop")
+    # bounds mirror the dense moments_update exactly: padding/discard
+    # rows translate OOB and drop; kept rows bound the support at their
+    # true value regardless of weight (HT-sampled rows included)
+    am = am.at[r, mk + 1].max(jnp.maximum(z - mlo, 0.0), mode="drop")
+    am = am.at[r, mk + 2].max(jnp.maximum(mhi - z, 0.0), mode="drop")
+    return am
+
+
 def _fused_body(arenas, tables, slots, dur_s, sizes, weights,
                 edges: tuple, gamma: float, min_value: float,
-                dd_rows: int, page_shift: int):
+                dd_rows: int, page_shift: int, mom_rows: int = 0,
+                mom_meta: "tuple | None" = None):
     """One paged device step for all spanmetrics families. `arenas` /
     `tables` are role-aligned: (calls, hist_sums, hist_counts, sizes,
-    hist_buckets[, dd_zeros, dd_counts]) — each plane scatters into its
-    OWN role arena through its own indirection table."""
-    dd = len(arenas) == 7
+    hist_buckets[, dd_zeros, dd_counts][, moments]) — each plane
+    scatters into its OWN role arena through its own indirection
+    table. The dd / moments sidecars are tier-gated (either, both, or
+    neither may be present)."""
+    dd = bool(dd_rows)
+    mom = bool(mom_rows)
+    a_calls, a_hs, a_hc, a_sz, ab = arenas[:5]
+    t_calls, t_hs, t_hc, t_sz, t_hb = tables[:5]
     if dd:
-        a_calls, a_hs, a_hc, a_sz, ab, a_ddz, ad = arenas
-        t_calls, t_hs, t_hc, t_sz, t_hb, t_ddz, t_ddc = tables
-    else:
-        a_calls, a_hs, a_hc, a_sz, ab = arenas
-        t_calls, t_hs, t_hc, t_sz, t_hb = tables
+        a_ddz, ad = arenas[5], arenas[6]
+        t_ddz, t_ddc = tables[5], tables[6]
+    if mom:
+        am, t_mom = arenas[-1], tables[-1]
     w = jnp.asarray(weights, jnp.float32)
     v = jnp.asarray(dur_s, jnp.float32)
     a_calls = _add1(a_calls, t_calls, slots, w, page_shift)
@@ -329,25 +355,32 @@ def _fused_body(arenas, tables, slots, dur_s, sizes, weights,
     a_hc = _add1(a_hc, t_hc, slots, w, page_shift)
     a_sz = _add1(a_sz, t_sz, slots,
                  jnp.asarray(sizes, jnp.float32) * w, page_shift)
-    if not dd:
-        return a_calls, a_hs, a_hc, a_sz, ab
-    # DDSketch sidecar: plane may be a strict prefix of the series table
-    dd_slots = jnp.where(slots < dd_rows, slots, -1)
-    log_gamma = math.log(gamma)
-    nb = ad.shape[-1]
-    is_zero = v <= min_value
-    idx = jnp.ceil(jnp.log(jnp.maximum(v, min_value) / min_value) / log_gamma)
-    idx = jnp.clip(idx, 0, nb - 1).astype(jnp.int32)
-    ad = _hist_scatter(ad, t_ddc, dd_slots, idx,
-                       jnp.where(is_zero, 0.0, w), page_shift)
-    a_ddz = _add1(a_ddz, t_ddz, dd_slots,
-                  jnp.where(is_zero, w, 0.0), page_shift)
-    return a_calls, a_hs, a_hc, a_sz, ab, a_ddz, ad
+    out = (a_calls, a_hs, a_hc, a_sz, ab)
+    if dd:
+        # DDSketch sidecar: plane may be a strict prefix of the table
+        dd_slots = jnp.where(slots < dd_rows, slots, -1)
+        log_gamma = math.log(gamma)
+        nb = ad.shape[-1]
+        is_zero = v <= min_value
+        idx = jnp.ceil(jnp.log(jnp.maximum(v, min_value) / min_value)
+                       / log_gamma)
+        idx = jnp.clip(idx, 0, nb - 1).astype(jnp.int32)
+        ad = _hist_scatter(ad, t_ddc, dd_slots, idx,
+                           jnp.where(is_zero, 0.0, w), page_shift)
+        a_ddz = _add1(a_ddz, t_ddz, dd_slots,
+                      jnp.where(is_zero, w, 0.0), page_shift)
+        out += (a_ddz, ad)
+    if mom:
+        mom_slots = jnp.where(slots < mom_rows, slots, -1)
+        out += (_moments_scatter(am, t_mom, mom_slots, v, w, mom_meta,
+                                 page_shift),)
+    return out
 
 
 def fused_step(edges: tuple, gamma: float, min_value: float, dd_rows: int,
                page_shift: int, packed: bool, mesh_key: "tuple | None" = None,
-               mesh=None, series_shards: int = 1):
+               mesh=None, series_shards: int = 1, mom_rows: int = 0,
+               mom_meta: "tuple | None" = None):
     """The paged fused spanmetrics step, memoized per static meta.
 
     Signature (dd on):
@@ -372,10 +405,12 @@ def fused_step(edges: tuple, gamma: float, min_value: float, dd_rows: int,
     """
     edges = tuple(edges)
     key = ("fused", edges, float(gamma), float(min_value), int(dd_rows),
-           page_shift, bool(packed), mesh_key, int(series_shards))
+           page_shift, bool(packed), mesh_key, int(series_shards),
+           int(mom_rows), mom_meta)
 
     def build():
-        n_arenas = n_tables = 7 if dd_rows else 5
+        n_arenas = n_tables = 5 + (2 if dd_rows else 0) + \
+            (1 if mom_rows else 0)
 
         def step(*args):
             arenas = args[:n_arenas]
@@ -389,7 +424,7 @@ def fused_step(edges: tuple, gamma: float, min_value: float, dd_rows: int,
                 slots, dur_s, sizes, weights = rest
             return _fused_body(arenas, tables, slots, dur_s, sizes,
                                weights, edges, gamma, min_value, dd_rows,
-                               page_shift)
+                               page_shift, mom_rows, mom_meta)
 
         if mesh is None:
             return instrumented_jit(step, name="spanmetrics_fused_update",
@@ -427,11 +462,13 @@ def fused_step(edges: tuple, gamma: float, min_value: float, dd_rows: int,
                           for t, a in zip(tables, arenas))
             return _fused_body(arenas, ltabs, slots, dur_s,
                                sizes, weights, edges, gamma, min_value,
-                               dd_rows, page_shift)
+                               dd_rows, page_shift, mom_rows, mom_meta)
 
         arena_specs = (P("series"),) * 4 + (P("series", None),)
         if dd_rows:
             arena_specs += (P("series"), P("series", None))
+        if mom_rows:
+            arena_specs += (P("series", None),)
         table_specs = (P(),) * n_tables
         batch_specs = (P(),) if packed else (P(),) * 4
         fn = _shard_map(sharded, mesh=mesh,

@@ -102,9 +102,24 @@ def log2_hist_update(
     return dataclasses.replace(state, counts=counts)
 
 
+def _merge_check(kind: str, a_meta: tuple, b_meta: tuple,
+                 a_shape: tuple, b_shape: tuple) -> None:
+    """Merge-compatibility guard, uniform across every sketch merge.
+
+    A real ValueError (not an assert): merges happen on the frontend
+    combine path with inputs from OTHER processes/configs, and asserts
+    are stripped under `python -O` — a silent mismatched merge would
+    corrupt quantiles/cardinalities instead of failing the request."""
+    if a_meta != b_meta or a_shape != b_shape:
+        raise ValueError(
+            f"{kind}: incompatible sketches (meta {a_meta} vs {b_meta}, "
+            f"shape {a_shape} vs {b_shape})")
+
+
 def log2_hist_merge(a: Log2Histogram, b: Log2Histogram) -> Log2Histogram:
     """Combine = elementwise add (`metrics.go:52-58` Combine)."""
-    assert a.offset == b.offset
+    _merge_check("log2_hist_merge", ("offset", a.offset), ("offset", b.offset),
+                 a.counts.shape, b.counts.shape)
     return dataclasses.replace(a, counts=a.counts + b.counts)
 
 
@@ -202,6 +217,10 @@ def dd_place(state: DDSketch, sharding_1d, sharding_2d) -> DDSketch:
 
 
 def dd_merge(a: DDSketch, b: DDSketch) -> DDSketch:
+    _merge_check("dd_merge",
+                 ("gamma", a.gamma, "min_value", a.min_value),
+                 ("gamma", b.gamma, "min_value", b.min_value),
+                 a.counts.shape, b.counts.shape)
     return dataclasses.replace(a, counts=a.counts + b.counts, zeros=a.zeros + b.zeros)
 
 
@@ -267,6 +286,9 @@ def hll_update(state: HyperLogLog, series_ids: jax.Array, h1: jax.Array,
 
 
 def hll_merge(a: HyperLogLog, b: HyperLogLog) -> HyperLogLog:
+    _merge_check("hll_merge", ("precision", a.precision),
+                 ("precision", b.precision),
+                 a.registers.shape, b.registers.shape)
     return dataclasses.replace(a, registers=jnp.maximum(a.registers, b.registers))
 
 
@@ -340,6 +362,9 @@ def cms_update(state: CountMinSketch, series_ids: jax.Array, h1: jax.Array,
 
 
 def cms_merge(a: CountMinSketch, b: CountMinSketch) -> CountMinSketch:
+    _merge_check("cms_merge", ("depth", a.depth, "width", a.width),
+                 ("depth", b.depth, "width", b.width),
+                 a.table.shape, b.table.shape)
     return dataclasses.replace(a, table=a.table + b.table)
 
 

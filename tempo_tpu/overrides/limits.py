@@ -45,6 +45,12 @@ class GeneratorLimits:
     ingestion_time_range_slack_s: float = 30.0
     remote_write_headers: dict[str, str] = dataclasses.field(default_factory=dict)
     # spanmetrics knobs
+    # quantile sketch tier: "" = the process default
+    # (generator.spanmetrics.sketch); "dd" | "moments" | "both" override
+    # per tenant — a high-cardinality tenant can ride the ~15-float
+    # moments rows while others keep the DDSketch plane
+    sketch: str = ""
+    sketch_moments_k: int = 0               # 0 = process default (moments_k)
     histogram_buckets: tuple[float, ...] = ()
     intrinsic_dimensions: dict[str, bool] = dataclasses.field(default_factory=dict)
     dimensions: tuple[str, ...] = ()

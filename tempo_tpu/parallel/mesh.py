@@ -161,7 +161,8 @@ def sharded_spanmetrics_step(mesh: Mesh, edges: tuple, gamma: float,
 
 def sharded_serving_step(mesh: Mesh, edges: tuple, gamma: float,
                          min_value: float, capacity: int, dd_rows: int,
-                         packed: bool = False):
+                         packed: bool = False, mom_rows: int = 0,
+                         mom_meta: "tuple | None" = None):
     """The MESH-RESIDENT serving twin of `sharded_spanmetrics_step`:
     the fused spanmetrics update a `SpanMetricsProcessor` dispatches when
     the process serving mesh is on (`tempo_tpu.parallel.serving`).
@@ -190,26 +191,43 @@ def sharded_serving_step(mesh: Mesh, edges: tuple, gamma: float,
       mesh twin of `_fused_update_packed4`. Slot ids ride f32 exactly
       under the caller's capacity < 2^24 gate.
 
+    `mom_rows` / `mom_meta` = (k, lo, hi): the moments-sketch sidecar
+    plane (ops/moments.py) — rides the same slot→shard mapping as the
+    DDSketch plane; its state array appends AFTER the dd pair. Combine
+    on the data axis: the moment-sum columns psum like every counter,
+    the two bound columns pmax (see `moments_merge`).
+
     Returns jit(fn(states..., slots, dur_s, sizes, weights) -> states)
     — or jit(fn(states..., packed_matrix) -> states) when `packed`.
     """
+    from tempo_tpu.ops import moments as msk
+
     n_series_shards = mesh.shape["series"]
     data_shards = mesh.shape["data"]
-    if capacity % n_series_shards or (dd_rows and dd_rows % n_series_shards):
+    if capacity % n_series_shards or \
+            (dd_rows and dd_rows % n_series_shards) or \
+            (mom_rows and mom_rows % n_series_shards):
         raise ValueError(
-            f"serving mesh: state capacities ({capacity}, dd {dd_rows}) "
-            f"must divide by series_shards ({n_series_shards})")
+            f"serving mesh: state capacities ({capacity}, dd {dd_rows}, "
+            f"moments {mom_rows}) must divide by series_shards "
+            f"({n_series_shards})")
     shard_cap = capacity // n_series_shards
     dd_shard = dd_rows // n_series_shards if dd_rows else 0
+    mom_shard = mom_rows // n_series_shards if mom_rows else 0
+    n_sketch = (2 if dd_shard else 0) + (1 if mom_shard else 0)
 
     def step(calls_v, h_buckets, h_sums, h_counts, size_v, *rest):
+        sk = rest[:n_sketch]
+        dd_counts = dd_zeros = mom_data = None
+        if dd_shard:
+            dd_counts, dd_zeros = sk[0], sk[1]
+        if mom_shard:
+            mom_data = sk[-1]
+        rest = rest[n_sketch:]
         if packed:
-            dd_counts, dd_zeros = rest[:2] if dd_shard else (None, None)
-            mat = rest[-1]
+            mat = rest[0]
             slots = mat[0].astype(jnp.int32)
             dur_s, sizes, weights = mat[1], mat[2], mat[3]
-        elif dd_shard:
-            dd_counts, dd_zeros, slots, dur_s, sizes, weights = rest
         else:
             slots, dur_s, sizes, weights = rest
         my_shard = jax.lax.axis_index("series")
@@ -221,6 +239,11 @@ def sharded_serving_step(mesh: Mesh, edges: tuple, gamma: float,
             dd_keep = (slots >= 0) & (slots < dd_rows) & \
                 (slots // dd_shard == my_shard)
             local_dd = jnp.where(dd_keep, slots - my_shard * dd_shard, 0)
+        if mom_shard:
+            mom_keep = (slots >= 0) & (slots < mom_rows) & \
+                (slots // mom_shard == my_shard)
+            local_mom = jnp.where(mom_keep, slots - my_shard * mom_shard, -1)
+            mk, mlo, mhi = mom_meta
         if data_shards == 1:
             # series-only layout (the serving default): each shard owns
             # its slots OUTRIGHT, so the scatter lands straight in the
@@ -243,6 +266,11 @@ def sharded_serving_step(mesh: Mesh, edges: tuple, gamma: float,
                     sketches.DDSketch(dd_counts, dd_zeros, gamma, min_value),
                     local_dd, dur_s, mask=dd_keep, weights=weights)
                 out += (dd.counts, dd.zeros)
+            if mom_shard:
+                mom = msk.moments_update(
+                    msk.MomentsSketch(mom_data, mk, mlo, mhi),
+                    local_mom, dur_s, mask=mom_keep, weights=weights)
+                out += (mom.data,)
             return out
         # data-parallel layout: deltas from ZERO state so only the delta
         # psums over 'data' (the base state is replicated across data
@@ -265,14 +293,27 @@ def sharded_serving_step(mesh: Mesh, edges: tuple, gamma: float,
                 local_dd, dur_s, mask=dd_keep, weights=weights)
             deltas += [dd_d.counts, dd_d.zeros]
             base += [dd_counts, dd_zeros]
-        return tuple(b + jax.lax.psum(d, "data")
-                     for b, d in zip(base, deltas))
+        out = [b + jax.lax.psum(d, "data") for b, d in zip(base, deltas)]
+        if mom_shard:
+            # the moments delta: sum columns psum like every counter;
+            # the two bound columns combine with pmax (support maxes)
+            mom_d = msk.moments_update(
+                msk.MomentsSketch(z(mom_data), mk, mlo, mhi),
+                local_mom, dur_s, mask=mom_keep, weights=weights).data
+            summed = mom_data[..., :mk + 1] + \
+                jax.lax.psum(mom_d[..., :mk + 1], "data")
+            bounds = jnp.maximum(mom_data[..., mk + 1:],
+                                 jax.lax.pmax(mom_d[..., mk + 1:], "data"))
+            out.append(jnp.concatenate([summed, bounds], axis=-1))
+        return tuple(out)
 
-    n_states = 7 if dd_shard else 5
+    n_states = 5 + n_sketch
     state_specs = (P("series"), P("series", None), P("series"), P("series"),
                    P("series"))
     if dd_shard:
         state_specs += (P("series", None), P("series"))
+    if mom_shard:
+        state_specs += (P("series", None),)
     batch_specs = (P(None, "data"),) if packed else (P("data"),) * 4
     # check_rep=False: the base-scatter branch's outputs ARE replicated
     # over 'data' (the axis has size 1 there), but without a psum the

@@ -164,25 +164,30 @@ class ServingMesh:
 
     # -- write path --------------------------------------------------------
 
-    def fits_state(self, capacity: int, dd_rows: int) -> bool:
-        """Whether a (series table, sketch plane) pair can shard over
+    def fits_state(self, capacity: int, dd_rows: int,
+                   mom_rows: int = 0) -> bool:
+        """Whether a (series table, sketch planes) set can shard over
         this mesh (every shard needs an equal slot range)."""
         s = self.series_shards
-        return capacity % s == 0 and (not dd_rows or dd_rows % s == 0)
+        return capacity % s == 0 and (not dd_rows or dd_rows % s == 0) \
+            and (not mom_rows or mom_rows % s == 0)
 
     def serving_step(self, edges: tuple, gamma: float, min_value: float,
-                     capacity: int, dd_rows: int, packed: bool = False):
+                     capacity: int, dd_rows: int, packed: bool = False,
+                     mom_rows: int = 0, mom_meta: "tuple | None" = None):
         """The donated sharded fused spanmetrics step, memoized per
         hyperparameter set (the mesh itself is fixed per instance)."""
         key = (tuple(edges), float(gamma), float(min_value),
-               int(capacity), int(dd_rows), bool(packed))
+               int(capacity), int(dd_rows), bool(packed),
+               int(mom_rows), mom_meta)
         with self._lock:
             fn = self._steps.get(key)
             if fn is None:
                 from tempo_tpu.parallel.mesh import sharded_serving_step
                 fn = self._steps[key] = sharded_serving_step(
                     self.registry_mesh, tuple(edges), gamma, min_value,
-                    capacity, dd_rows, packed=packed)
+                    capacity, dd_rows, packed=packed, mom_rows=mom_rows,
+                    mom_meta=mom_meta)
             return fn
 
     def put_batch(self, *arrays):
@@ -304,15 +309,18 @@ def place_spanmetrics_state(proc, sm: "ServingMesh | None" = None) -> bool:
         # paged fused step is mesh-aware — there is no per-tenant dense
         # state to move (and no capacity-divisibility requirement)
         return False
+    from tempo_tpu.ops.moments import moments_place
     from tempo_tpu.ops.sketches import dd_place
     from tempo_tpu.registry import metrics as rm
 
     dd_rows = proc.dd.counts.shape[0] if proc.dd is not None else 0
-    if not sm.fits_state(proc.calls.table.capacity, dd_rows):
+    mom = getattr(proc, "mom", None)
+    mom_rows = mom.data.shape[0] if mom is not None else 0
+    if not sm.fits_state(proc.calls.table.capacity, dd_rows, mom_rows):
         _LOG.warning(
-            "serving mesh: capacity %d / sketch rows %d not divisible by "
-            "series_shards %d — processor stays single-device",
-            proc.calls.table.capacity, dd_rows, sm.series_shards)
+            "serving mesh: capacity %d / sketch rows %d/%d not divisible "
+            "by series_shards %d — processor stays single-device",
+            proc.calls.table.capacity, dd_rows, mom_rows, sm.series_shards)
         return False
     proc.calls.state = rm.place_state(proc.calls.state, sm.series_1d,
                                       sm.series_2d)
@@ -322,6 +330,8 @@ def place_spanmetrics_state(proc, sm: "ServingMesh | None" = None) -> bool:
                                       sm.series_2d)
     if proc.dd is not None:
         proc.dd = dd_place(proc.dd, sm.series_1d, sm.series_2d)
+    if mom is not None:
+        proc.mom = moments_place(mom, sm.series_2d)
     return True
 
 

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from tempo_tpu.obs import querystats
+from tempo_tpu.ops import moments as msk
 from tempo_tpu.traceql import ast as A
 from tempo_tpu.traceql.conditions import extract_conditions
 from tempo_tpu.traceql.eval import (NUM, Col, ColumnView, eval_expr,
@@ -41,6 +42,29 @@ HBUCKETS = 64
 # bucket b holds values in (2^(b-1), 2^b] nanoseconds; b=0 holds <=1ns
 _LABEL_BUCKET = "__bucket"
 _LABEL_META = "__meta_type"
+# moments tier (`spanmetrics.sketch: moments`, ops/moments.py): instead
+# of 64 `__bucket` series per group, quantile_over_time ships k+1 moment
+# series (label value "0".."k": count + Chebyshev log-moment sums, merge
+# = ADD) plus two support-bound series ("hi"/"lo": shifted running
+# maxes, merge = MAX) — ~15 series of plain tensor-adds per group, the
+# psum-only combine of the moments sketch
+_LABEL_MOMENT = "__moment"
+
+
+def _moment_bound_labels(labels) -> bool:
+    """True for the two max-merged support-bound series of a moments
+    quantile group (every other series in a combine sums)."""
+    for k, v in labels:
+        if k == _LABEL_MOMENT:
+            return v in ("hi", "lo")
+    return False
+
+
+def _moment_labels(labels) -> bool:
+    for k, _v in labels:
+        if k == _LABEL_MOMENT:
+            return True
+    return False
 
 
 def log2_bucket_np(values_ns: np.ndarray) -> np.ndarray:
@@ -67,6 +91,38 @@ def log2_quantile(q: float, buckets: np.ndarray) -> float:
     lo = 0.0 if b == 0 else 2.0 ** (b - 1)
     hi = 2.0 ** b
     return (lo + (hi - lo) * frac) / 1e9
+
+
+def _fold_cumulative(g: np.ndarray) -> np.ndarray:
+    """The per-series cumulative-count fold of a [steps, B] bucket grid
+    — factored out so `log2_quantiles_multi` provably runs it ONCE for
+    any number of requested q's (tests count invocations)."""
+    return np.cumsum(g, axis=1)
+
+
+def log2_quantiles_multi(qs, g: np.ndarray) -> np.ndarray:
+    """Every requested quantile of a [steps, HBUCKETS] grid from ONE
+    cumulative fold: returns [len(qs), steps] seconds. Exactly the
+    per-step `log2_quantile` math, vectorized over steps and evaluated
+    for all q's off the shared cumulative counts (a multi-param
+    `quantile_over_time(duration, .5, .9, .99)` used to refold the
+    summed grid once per parameter)."""
+    g = np.asarray(g, np.float64)
+    cum = _fold_cumulative(g)
+    total = cum[:, -1]
+    steps = np.arange(g.shape[0])
+    out = np.zeros((len(qs), g.shape[0]), np.float64)
+    for qi, q in enumerate(qs):
+        target = np.maximum(q * total, 1e-12)
+        b = np.minimum((cum < target[:, None]).sum(axis=1), HBUCKETS - 1)
+        prev = np.where(b > 0, cum[steps, np.maximum(b - 1, 0)], 0.0)
+        inbucket = g[steps, b]
+        frac = np.where(inbucket > 0, (target - prev) / np.maximum(
+            inbucket, 1e-300), 0.0)
+        lo = np.where(b == 0, 0.0, np.exp2(b - 1.0))
+        hi = np.exp2(b.astype(np.float64))
+        out[qi] = np.where(total > 0, (lo + (hi - lo) * frac) / 1e9, 0.0)
+    return out
 
 
 @dataclasses.dataclass
@@ -134,6 +190,26 @@ def _scatter_add3_impl(grid, slots, steps, buckets, w):
     return grid.at[slots, steps, buckets].add(w, mode="drop")
 
 
+def _scatter_moments_impl(mmt, mhi, mlo, slots, steps, z):
+    """ONE dispatch for the whole moments-tier observation: the clipped
+    log values `z` [n] ride a single H2D (vs shipping the [n, k+1]
+    basis matrix), the Chebyshev basis recurrence runs on device, and
+    all three grids (moment sums + the two support-bound planes) update
+    together. Grids are donated."""
+    from tempo_tpu.ops import moments as _msk
+    jnp_ = jax.numpy
+    c0 = (_msk.QUERY_LO + _msk.QUERY_HI) / 2.0
+    h0 = (_msk.QUERY_HI - _msk.QUERY_LO) / 2.0
+    s = jnp_.clip((z - c0) / h0, -1.0, 1.0)
+    basis = jnp_.stack(_msk.chebyshev_basis(s, _msk.QUERY_K), axis=-1)
+    cols = jnp_.arange(basis.shape[1], dtype=jnp_.int32)
+    mmt = mmt.at[slots[:, None], steps[:, None], cols[None, :]].add(
+        basis, mode="drop")
+    mhi = mhi.at[slots, steps].max(z - _msk.QUERY_LO, mode="drop")
+    mlo = mlo.at[slots, steps].max(_msk.QUERY_HI - z, mode="drop")
+    return mmt, mhi, mlo
+
+
 _scatter_add2 = instrumented_jit(_scatter_add2_impl,
                                  name="engine_scatter_add2",
                                  donate_argnums=0)
@@ -146,6 +222,9 @@ _scatter_max2 = instrumented_jit(_scatter_max2_impl,
 _scatter_add3 = instrumented_jit(_scatter_add3_impl,
                                  name="engine_scatter_add3",
                                  donate_argnums=0)
+_scatter_moments = instrumented_jit(_scatter_moments_impl,
+                                    name="engine_scatter_moments",
+                                    donate_argnums=(0, 1, 2))
 
 
 def _sched_scatter(fn, *args):
@@ -217,8 +296,15 @@ class MetricsEvaluator:
         self._exemplars: dict[int, list] = {}
         self._ex_total = 0
         k = self.m.kind
+        # moments query tier: quantile_over_time accumulates
+        # [series, steps, k+1] moment grids + two bound planes instead
+        # of the [series, steps, 64] log2 grid (histogram_over_time
+        # keeps buckets — its OUTPUT is the buckets)
+        self._moments = (k == A.MetricsKind.QUANTILE_OVER_TIME
+                         and msk.query_moments_active())
         self._hist = k in (A.MetricsKind.QUANTILE_OVER_TIME,
-                           A.MetricsKind.HISTOGRAM_OVER_TIME)
+                           A.MetricsKind.HISTOGRAM_OVER_TIME) \
+            and not self._moments
         self._is_compare = k == A.MetricsKind.COMPARE
         # `| rate()` with a single filter needs no second pass when the
         # pushdown covers it (optimize() engine_metrics.go:885)
@@ -243,7 +329,11 @@ class MetricsEvaluator:
             self._grids[name] = g
 
         k = self.m.kind
-        if self._hist:
+        if self._moments:
+            grow("mmt", 0.0, (msk.QUERY_K + 1,))
+            grow("mhi", 0.0)   # max(log v − QUERY_LO): 0 == no data
+            grow("mlo", 0.0)   # max(QUERY_HI − log v)
+        elif self._hist:
             grow("hist", 0.0, (HBUCKETS,))
         elif k in (A.MetricsKind.RATE, A.MetricsKind.COUNT_OVER_TIME):
             grow("count", 0.0)
@@ -311,7 +401,10 @@ class MetricsEvaluator:
             # duration intrinsics aggregate in SECONDS (reference converts
             # ns→s before the vector aggregators); histogram buckets keep ns
             # since log2 geometry is scale-consistent (labels divide by 1e9)
-            if not self._hist and _is_duration_attr(self.m.attr):
+            # — the moments grids keep ns the same way (the final solve
+            # divides by 1e9, mirroring log2_quantile)
+            if not self._hist and not self._moments \
+                    and _is_duration_attr(self.m.attr):
                 vals = vals / 1e9
 
         # pad update vectors to pow2 sizes: stable shapes → one jit cache
@@ -325,7 +418,23 @@ class MetricsEvaluator:
         jvals = (jnp.asarray(np.pad(vals.astype(np.float32), (0, pad)))
                  if vals is not None else None)
         k = self.m.kind
-        if self._hist:
+        if self._moments:
+            # ~15 floats per (series, step) instead of 64 buckets: ship
+            # the clipped log values ONCE ([n] f32 — not the [n, k+1]
+            # basis), compute the Chebyshev recurrence on device, and
+            # update moment sums + both support-bound planes in a
+            # single dispatch. Padding rows carry slot == capacity and
+            # drop on device (mode="drop"), like every other grid
+            # scatter here; their z value is arbitrary.
+            z = np.log(np.clip(vals, math.exp(msk.QUERY_LO),
+                               math.exp(msk.QUERY_HI))).astype(np.float32)
+            jz = jnp.asarray(np.pad(z, (0, pad),
+                                    constant_values=msk.QUERY_LO))
+            (self._grids["mmt"], self._grids["mhi"],
+             self._grids["mlo"]) = _sched_scatter(
+                _scatter_moments, self._grids["mmt"], self._grids["mhi"],
+                self._grids["mlo"], jslots, jsteps, jz)
+        elif self._hist:
             b = jnp.asarray(np.pad(log2_bucket_np(vals), (0, pad)))
             self._grids["hist"] = _sched_scatter(
                 _scatter_add3, self._grids["hist"], jslots, jsteps, b, ones)
@@ -449,6 +558,28 @@ class MetricsEvaluator:
         if nseries == 0:
             return out
         k = self.m.kind
+        if self._moments:
+            # one series per moment column (merge = add) + the two
+            # support bounds (merge = max): ≤ k+3 series per group vs
+            # up to 64 bucket series — the combine-payload shrink
+            mmt = np.asarray(self._grids["mmt"])[:nseries]
+            mhi = np.asarray(self._grids["mhi"])[:nseries]
+            mlo = np.asarray(self._grids["mlo"])[:nseries]
+            for i, key in enumerate(self.series.keys):
+                if not mmt[i, :, 0].any():
+                    continue
+                for j in range(msk.QUERY_K + 1):
+                    col = mmt[i, :, j]
+                    if col.any():
+                        out.append(TimeSeries(
+                            key + ((_LABEL_MOMENT, str(j)),),
+                            col.astype(np.float64),
+                            self._exemplars.get(i, []) if j == 0 else []))
+                out.append(TimeSeries(key + ((_LABEL_MOMENT, "hi"),),
+                                      mhi[i].astype(np.float64)))
+                out.append(TimeSeries(key + ((_LABEL_MOMENT, "lo"),),
+                                      mlo[i].astype(np.float64)))
+            return out
         if self._hist:
             hist = np.asarray(self._grids["hist"])[:nseries]
             for i, key in enumerate(self.series.keys):
@@ -608,7 +739,29 @@ class SeriesCombiner:
             if sm is not None and \
                     sum(len(x) for x in pend) * self.n_steps >= \
                     sm.cfg.combine_min_elements:
-                self._merge_mesh(sm, pend, op)
+                if self.kind == A.MetricsKind.QUANTILE_OVER_TIME:
+                    # moments tier: the whole __moment family peels onto
+                    # the host f64 fold — the bounds merge by MAX, and
+                    # the fractional moment sums would break the mesh
+                    # gate's exactness invariant (amax*cmax < 2^24 only
+                    # guarantees integer-count payloads; a fractional
+                    # sum rounds in f32 at ANY magnitude, making the
+                    # answer depend on which route the combine took).
+                    # The tier's combine win is the PAYLOAD shrink
+                    # (~15 series/group vs 64 bucket series), which the
+                    # host fold keeps; log2 bucket grids still ride the
+                    # in-mesh reduce below.
+                    mom = [[ts for ts in lst if _moment_labels(ts.labels)]
+                           for lst in pend]
+                    pend = [[ts for ts in lst
+                             if not _moment_labels(ts.labels)]
+                            for lst in pend]
+                    for lst in mom:
+                        if lst:
+                            self._merge_host(lst)
+                    pend = [lst for lst in pend if lst]
+                if pend:
+                    self._merge_mesh(sm, pend, op)
                 return
         for lst in pend:
             self._merge_host(lst)
@@ -616,6 +769,7 @@ class SeriesCombiner:
     def _merge_host(self, series: list) -> None:
         take_min = self.kind == A.MetricsKind.MIN_OVER_TIME
         take_max = self.kind == A.MetricsKind.MAX_OVER_TIME
+        quantile = self.kind == A.MetricsKind.QUANTILE_OVER_TIME
         for ts in series:
             cur = self._series.get(ts.key())
             if cur is None:
@@ -624,7 +778,10 @@ class SeriesCombiner:
             else:
                 if take_min:
                     cur.samples = np.minimum(cur.samples, ts.samples)
-                elif take_max:
+                elif take_max or (quantile
+                                  and _moment_bound_labels(ts.labels)):
+                    # moments support bounds combine like the sketch's
+                    # bound columns: running max, not sum
                     cur.samples = np.maximum(cur.samples, ts.samples)
                 else:
                     cur.samples = cur.samples + ts.samples
@@ -737,11 +894,28 @@ class SeriesCombiner:
         return list(self.series.values())
 
     def _quantile_series(self, qs: tuple, req: QueryRangeRequest) -> list[TimeSeries]:
-        # regroup bucket series by base labels → [steps, HBUCKETS] grids
+        # regroup by base labels: `__bucket` series → [steps, HBUCKETS]
+        # grids (the log2 tier), `__moment` series → [steps, k+3] moment
+        # rows (the moments tier; sketch-row layout of ops/moments.py)
         grids: dict[tuple, np.ndarray] = {}
+        moment_rows: dict[tuple, np.ndarray] = {}
         exemplars: dict[tuple, list] = {}
+        kc = msk.QUERY_K
         for ts in self.series.values():
             labels = dict(ts.labels)
+            if _LABEL_MOMENT in labels:
+                mv = labels.pop(_LABEL_MOMENT)
+                base = tuple(sorted(labels.items()))
+                rows = moment_rows.setdefault(
+                    base, np.zeros((req.n_steps, msk.n_cols(kc))))
+                if mv == "hi":
+                    rows[:, kc + 1] = np.maximum(rows[:, kc + 1], ts.samples)
+                elif mv == "lo":
+                    rows[:, kc + 2] = np.maximum(rows[:, kc + 2], ts.samples)
+                else:
+                    rows[:, int(mv)] += ts.samples
+                exemplars.setdefault(base, []).extend(ts.exemplars)
+                continue
             if _LABEL_BUCKET not in labels:
                 continue
             le = float(labels.pop(_LABEL_BUCKET))
@@ -752,12 +926,30 @@ class SeriesCombiner:
             exemplars.setdefault(base, []).extend(ts.exemplars)
         out = []
         for base, g in grids.items():
-            for qv in qs:
-                samples = np.fromiter(
-                    (log2_quantile(qv, g[s]) for s in range(req.n_steps)),
-                    np.float64, count=req.n_steps)
+            # ONE cumulative fold per series; every requested q reads
+            # off it (a 3-param quantile_over_time used to refold per q)
+            by_q = log2_quantiles_multi(qs, g)
+            for qi, qv in enumerate(qs):
                 labels = base + (("p", qv),)
-                out.append(TimeSeries(labels, samples, exemplars.get(base, [])))
+                out.append(TimeSeries(labels, by_q[qi],
+                                      exemplars.get(base, [])))
+        for base, rows in moment_rows.items():
+            # all q's per step come off ONE solved CDF (monotone in q);
+            # non-converged steps fall back to the support midpoint and
+            # count into tempo_moments_solver_fallback_total
+            vals, failed = msk.quantiles_for_rows(
+                rows, kc, msk.QUERY_LO, msk.QUERY_HI, qs)
+            if failed.any():
+                zmax = msk.QUERY_LO + rows[:, kc + 1]
+                zmin = msk.QUERY_HI - rows[:, kc + 2]
+                mid = np.exp((np.minimum(zmin, zmax)
+                              + np.maximum(zmin, zmax)) / 2.0)
+                vals = np.where(np.isnan(vals), mid[:, None], vals)
+            vals = vals / 1e9   # ns → seconds, like log2_quantile
+            for qi, qv in enumerate(qs):
+                labels = base + (("p", qv),)
+                out.append(TimeSeries(labels, vals[:, qi].astype(np.float64),
+                                      exemplars.get(base, [])))
         return out
 
 

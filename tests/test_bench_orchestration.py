@@ -6,8 +6,12 @@ whole round its TPU record (BENCH_r04: platform "cpu"). These tests drive
 the round-5 orchestrator through its fault-injection hooks — stages are
 stubbed (TEMPO_BENCH_STAGE_STUB), the probe can hang until a chosen epoch
 (TEMPO_BENCH_PROBE_HANG_UNTIL) and report a fake platform
-(TEMPO_BENCH_PROBE_FAKE) — asserting the mid-run recovery, permanent-
-failure, and healthy-startup paths without any accelerator.
+(TEMPO_BENCH_PROBE_FAKE) — asserting the healthy-startup, permanent-
+failure, and (post-BENCH_r05) first-failure-commits-to-cpu paths
+without any accelerator. The background re-probe machinery is gone:
+one bounded startup probe decides the run's platform, and the only
+accelerator retry left is re-running stages that individually failed
+on a probe-confirmed accelerator.
 """
 
 from __future__ import annotations
@@ -23,7 +27,7 @@ BENCH = os.path.join(os.path.dirname(os.path.dirname(
 
 
 def _run(hang_s: float | None = None, fake: str = "tpu",
-         probe_timeout: float = 3, reprobe_timeout: float = 6,
+         probe_timeout: float = 3,
          timeout: float = 120) -> tuple[dict, str]:
     env = dict(os.environ)
     env.update({
@@ -31,7 +35,6 @@ def _run(hang_s: float | None = None, fake: str = "tpu",
         "TEMPO_BENCH_STAGE_STUB": "1",
         "TEMPO_BENCH_PROBE_FAKE": fake,
         "TEMPO_BENCH_PROBE_TIMEOUT_S": str(probe_timeout),
-        "TEMPO_BENCH_REPROBE_TIMEOUT_S": str(reprobe_timeout),
     })
     if hang_s is not None:
         env["TEMPO_BENCH_PROBE_HANG_UNTIL"] = str(time.time() + hang_s)
@@ -49,13 +52,20 @@ def test_healthy_startup_probe_uses_accelerator():
     assert "re-running" not in err          # nothing captured on cpu
 
 
-def test_probe_recovers_mid_run_rereuns_cpu_stages():
-    # startup probes (2 x 3s) fail; the background probe finds the fake
-    # accelerator ~10s in; every cpu-captured stage must be re-run on it
+def test_probe_failure_commits_to_cpu_without_retry():
+    # BENCH_r05 postmortem: two back-to-back 360s startup timeouts burned
+    # 12 minutes before the CPU fallback started. A failed FIRST probe now
+    # commits the whole run to CPU: no startup retry, no background
+    # probes — even though the tunnel here recovers 10s in.
+    t0 = time.time()
     line, err = _run(hang_s=10)
-    assert line["extra"]["platform"] == "tpu"
-    assert set(line["extra"]["stage_platform"].values()) == {"tpu"}
-    assert "background probe found tpu" in err
+    assert line["extra"]["platform"] == "cpu"
+    assert set(line["extra"]["stage_platform"].values()) == {"cpu"}
+    assert "committing to cpu" in err
+    assert "background probe found" not in err
+    assert "background probe timed out" not in err
+    # one probe timeout (3s) + stub stages, not 2x timeouts + re-probes
+    assert time.time() - t0 < 60
 
 
 def test_probe_never_recovers_keeps_cpu_numbers():
@@ -64,6 +74,8 @@ def test_probe_never_recovers_keeps_cpu_numbers():
     assert set(line["extra"]["stage_platform"].values()) == {"cpu"}
     # the bench still emitted a full record (rc 0, headline value present)
     assert line["value"] == 1.0
+    # and spent only ONE probe timeout learning the tunnel was wedged
+    assert "committing to cpu" in err
 
 
 def test_confirmed_cpu_platform_stops_reprobing():

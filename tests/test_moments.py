@@ -1,0 +1,551 @@
+"""Moments sketch tier (ops/moments.py + the spanmetrics/TraceQL wiring).
+
+Covers: the device sketch (update/merge/zero semantics, merge guards
+across ALL sketches), the maxent solver (accuracy on lognormal/bimodal,
+monotone-in-q, degenerate inputs, cache + fallback accounting), the
+spanmetrics tier knob (dense/paged parity, dd bit-identity, eviction
+hygiene, per-tenant overrides, config warnings), the serving-mesh fused
+step, and the TraceQL quantile_over_time moments axis (evaluator →
+combiner → final, bound-series max merge, the one-fold multi-q
+satellite).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from tempo_tpu.ops import moments as M
+from tempo_tpu.ops import sketches
+
+
+# ---------------------------------------------------------------------------
+# device sketch
+# ---------------------------------------------------------------------------
+
+def test_moments_update_mask_weights_and_drop():
+    st = M.moments_init(4, k=8)
+    vals = np.array([0.5, 2.0, 1.0, 3.0], np.float32)
+    st = M.moments_update(st, np.array([0, 0, -1, 1]), vals,
+                          mask=np.array([True, True, True, False]),
+                          weights=np.array([1.0, 3.0, 1.0, 1.0]))
+    d = np.asarray(st.data)
+    assert d[0, 0] == pytest.approx(4.0)     # weighted count 1 + 3
+    assert d[1].sum() == 0.0                 # masked row dropped
+    assert d[2].sum() == 0.0                 # negative slot dropped
+    # bounds: shifted maxes of log(0.5), log(2.0)
+    assert d[0, st.k + 1] == pytest.approx(np.log(2.0) - st.lo, rel=1e-5)
+    assert d[0, st.k + 2] == pytest.approx(st.hi - np.log(0.5), rel=1e-5)
+
+
+def test_moments_merge_matches_single_pass():
+    rng = np.random.default_rng(0)
+    x = rng.lognormal(-2, 0.7, 512).astype(np.float32)
+    whole = M.moments_update(M.moments_init(2), np.zeros(512, np.int32), x)
+    a = M.moments_update(M.moments_init(2), np.zeros(256, np.int32), x[:256])
+    b = M.moments_update(M.moments_init(2), np.zeros(256, np.int32), x[256:])
+    merged = M.moments_merge(a, b)
+    np.testing.assert_allclose(np.asarray(merged.data)[0],
+                               np.asarray(whole.data)[0], rtol=1e-4)
+
+
+def test_moments_zero_slots_resets_to_empty():
+    st = M.moments_update(M.moments_init(4), np.array([1, 2]),
+                          np.array([0.1, 0.2], np.float32))
+    st = M.moments_zero_slots(st, np.array([1]))
+    d = np.asarray(st.data)
+    assert d[1].sum() == 0.0 and d[2].sum() > 0.0
+
+
+def test_merge_guards_raise_value_error_across_all_sketches():
+    # moments: k / domain / shape mismatches
+    with pytest.raises(ValueError, match="moments_merge"):
+        M.moments_merge(M.moments_init(4, k=8), M.moments_init(4, k=12))
+    with pytest.raises(ValueError, match="moments_merge"):
+        M.moments_merge(M.moments_init(4, min_value=1e-6),
+                        M.moments_init(4, min_value=1e-3))
+    # log2: offset and shape
+    with pytest.raises(ValueError, match="log2_hist_merge"):
+        sketches.log2_hist_merge(sketches.log2_hist_init(4, offset=0),
+                                 sketches.log2_hist_init(4, offset=32))
+    with pytest.raises(ValueError, match="log2_hist_merge"):
+        sketches.log2_hist_merge(sketches.log2_hist_init(4),
+                                 sketches.log2_hist_init(8))
+    # dd: gamma/min_value geometry
+    with pytest.raises(ValueError, match="dd_merge"):
+        sketches.dd_merge(sketches.dd_init(4, rel_err=0.01),
+                          sketches.dd_init(4, rel_err=0.02))
+    # hll: precision
+    with pytest.raises(ValueError, match="hll_merge"):
+        sketches.hll_merge(sketches.hll_init(4, precision=12),
+                           sketches.hll_init(4, precision=14))
+    # cms: width
+    with pytest.raises(ValueError, match="cms_merge"):
+        sketches.cms_merge(sketches.cms_init(4, width=1024),
+                           sketches.cms_init(4, width=2048))
+
+
+# ---------------------------------------------------------------------------
+# maxent solver
+# ---------------------------------------------------------------------------
+
+def _row_for(x: np.ndarray, k: int = 12) -> tuple:
+    st = M.moments_update(M.moments_init(1, k=k),
+                          np.zeros(len(x), np.int32),
+                          np.asarray(x, np.float32))
+    return np.asarray(st.data)[0], st
+
+
+def test_solver_accuracy_lognormal_and_bimodal():
+    rng = np.random.default_rng(7)
+    workloads = {
+        "lognormal": rng.lognormal(np.log(0.1), 0.6, 30_000),
+        "bimodal": np.concatenate([
+            rng.lognormal(np.log(0.05), 0.6, 15_000),
+            rng.lognormal(np.log(0.8), 0.5, 15_000)]),
+    }
+    for name, x in workloads.items():
+        row, st = _row_for(x)
+        qs = [0.5, 0.9, 0.99]
+        got = M.solve_quantiles(row, st.k, st.lo, st.hi, qs)
+        assert got is not None, name
+        exact = np.quantile(x, qs)
+        rel = np.abs(got - exact) / exact
+        # value error where the density is smooth; rank error (the
+        # sketch's actual guarantee, Gan et al.) where it is not —
+        # in a bimodal trough every sketch's value error is unbounded
+        xs = np.sort(x)
+        rank = np.abs(np.searchsorted(xs, got) / len(xs) - np.asarray(qs))
+        assert np.minimum(rel, rank).max() <= 0.05, (name, rel, rank)
+
+
+def test_solver_monotone_in_q():
+    rng = np.random.default_rng(1)
+    row, st = _row_for(rng.lognormal(-3, 1.2, 5000))
+    qs = np.linspace(0.01, 0.99, 25)
+    got = M.solve_quantiles(row, st.k, st.lo, st.hi, qs)
+    assert got is not None
+    assert (np.diff(got) >= -1e-12).all()
+
+
+def test_solver_degenerate_rows():
+    # single repeated value: exact answer, no maxent needed
+    row, st = _row_for(np.full(100, 0.25))
+    got = M.solve_quantiles(row, st.k, st.lo, st.hi, [0.1, 0.5, 0.9])
+    np.testing.assert_allclose(got, 0.25, rtol=1e-3)
+    # empty row: None (callers render 0 like the bucket sketches)
+    assert M.solve_quantiles(np.zeros(st.k + 3), st.k, st.lo, st.hi,
+                             [0.5]) is None
+
+
+def test_solver_cache_and_fallback_accounting():
+    M.reset_solver_cache()
+    rng = np.random.default_rng(2)
+    row, st = _row_for(rng.lognormal(-2, 0.5, 1000))
+    assert M.solve_quantiles(row, st.k, st.lo, st.hi, [0.5]) is not None
+    s0, h0 = M.solves_total, M.cache_hits_total
+    assert M.solve_quantiles(row, st.k, st.lo, st.hi, [0.9]) is not None
+    assert M.solves_total == s0 and M.cache_hits_total == h0 + 1
+    # an infeasible moment vector (corrupted sums) must fail closed:
+    # None + fallback counter, never an exception
+    bad = row.copy()
+    bad[1:st.k + 1] = np.array([50.0, -50.0] * (st.k // 2)) * row[0]
+    f0 = M.fallbacks_total
+    assert M.solve_quantiles(bad, st.k, st.lo, st.hi, [0.5]) is None
+    assert M.fallbacks_total == f0 + 1
+
+
+def test_quantiles_for_rows_batch_flags():
+    rng = np.random.default_rng(3)
+    row, st = _row_for(rng.lognormal(-2, 0.5, 500))
+    rows = np.stack([row, np.zeros_like(row)])
+    vals, failed = M.quantiles_for_rows(rows, st.k, st.lo, st.hi, [0.5, 0.9])
+    assert not failed.any()          # empty row is NOT a failure
+    assert vals[1].sum() == 0.0      # …it renders 0 like bucket sketches
+    assert vals[0, 0] < vals[0, 1]
+
+
+# ---------------------------------------------------------------------------
+# spanmetrics tier
+# ---------------------------------------------------------------------------
+
+def _mk_world(paged: bool, sketch: str, clock=None, k: int = 12):
+    from tempo_tpu.generator.processors.spanmetrics import (
+        SpanMetricsConfig, SpanMetricsProcessor)
+    from tempo_tpu.registry import pages as device_pages
+    from tempo_tpu.registry.registry import ManagedRegistry, RegistryOverrides
+
+    clock = clock or [1000.0]
+    pool = device_pages.PagePool(device_pages.PagePoolConfig(
+        enabled=True, page_rows=16, arena_slots=512)) if paged else None
+    with device_pages.use(pool):
+        reg = ManagedRegistry("t", RegistryOverrides(
+            max_active_series=64, stale_duration_s=50.0),
+            now=lambda: clock[0])
+        proc = SpanMetricsProcessor(reg, SpanMetricsConfig(
+            use_scheduler=False, sketch=sketch, moments_k=k,
+            sketch_max_series=32))
+    return clock, reg, proc
+
+
+def _push(reg, proc, durs, op="op", weights=None):
+    from tempo_tpu.model.span_batch import SpanBatchBuilder
+    b = SpanBatchBuilder(reg.interner)
+    for d in durs:
+        b.append(trace_id=bytes(16), span_id=bytes(8), name=op,
+                 service="svc", kind=2, status_code=0,
+                 start_unix_nano=10**18,
+                 end_unix_nano=10**18 + int(float(d) * 1e9))
+    proc.push_batch(b.build(), sample_weights=weights)
+
+
+def test_moments_tier_paged_dense_bit_identical():
+    rng = np.random.default_rng(5)
+    results = {}
+    for paged in (False, True):
+        _, reg, proc = _mk_world(paged, "moments")
+        r2 = np.random.default_rng(5)
+        for op in ("a", "b"):
+            _push(reg, proc, r2.lognormal(-2, 0.6, 100), op=op)
+        results[paged] = (proc.quantile(0.9),
+                          sorted((s.name, s.labels, s.value)
+                                 for s in reg.collect(1)
+                                 if s.value == s.value))
+    assert results[False] == results[True]
+
+
+def test_moments_tier_accuracy_and_state_shrink():
+    rng = np.random.default_rng(6)
+    durs = rng.lognormal(np.log(0.1), 0.8, 3000)
+    _, reg_m, proc_m = _mk_world(False, "moments")
+    _, reg_d, proc_d = _mk_world(False, "dd")
+    _push(reg_m, proc_m, durs)
+    _push(reg_d, proc_d, durs)
+    for q in (0.5, 0.9, 0.99):
+        est = next(iter(proc_m.quantile(q).values()))
+        exact = float(np.quantile(durs, q))
+        assert abs(est - exact) / exact < 0.05, q
+    # ≥10x state shrink vs the DDSketch plane (ISSUE gate; ~90x here)
+    assert proc_d.device_state_bytes() >= 10 * proc_m.device_state_bytes()
+
+
+def test_both_tier_dd_plane_bit_identical_to_dd_tier():
+    rng = np.random.default_rng(7)
+    durs = rng.lognormal(-2, 0.7, 500)
+    _, reg_d, proc_d = _mk_world(False, "dd")
+    _, reg_b, proc_b = _mk_world(False, "both")
+    _push(reg_d, proc_d, durs)
+    _push(reg_b, proc_b, durs)
+    assert (np.asarray(proc_d.dd.counts) ==
+            np.asarray(proc_b.dd.counts)).all()
+    assert (np.asarray(proc_d.dd.zeros) ==
+            np.asarray(proc_b.dd.zeros)).all()
+
+
+def test_both_tier_falls_back_to_dd_per_series():
+    rng = np.random.default_rng(8)
+    _, reg, proc = _mk_world(False, "both")
+    _push(reg, proc, rng.lognormal(-2, 0.5, 200))
+    slots = proc.calls.table.active_slots()
+    vals = np.full(slots.size, np.nan)
+    got = proc._sketch_fallback(0.9, slots, vals,
+                                np.ones(slots.size, bool))
+    dd_vals = np.asarray(sketches.dd_quantile(proc.dd, 0.9))[slots]
+    np.testing.assert_allclose(got, dd_vals)
+
+
+def test_moments_only_fallback_uses_classic_histogram():
+    rng = np.random.default_rng(9)
+    _, reg, proc = _mk_world(False, "moments")
+    _push(reg, proc, rng.lognormal(-2, 0.5, 200))
+    slots = proc.calls.table.active_slots()
+    got = proc._sketch_fallback(0.9, slots, np.full(slots.size, np.nan),
+                                np.ones(slots.size, bool))
+    assert np.isfinite(got).all() and (got > 0).all()
+
+
+def test_evicted_slot_reuse_does_not_inherit_moments_history():
+    for paged in (False, True):
+        clock, reg, proc = _mk_world(paged, "moments")
+        _push(reg, proc, [5.0] * 50, op="old")     # slow series
+        clock[0] += 1000.0
+        assert reg.purge_stale() == 1
+        _push(reg, proc, [0.001] * 50, op="new")   # fast series, reused slot
+        got = proc.quantile(0.99)
+        (labels, est), = got.items()
+        assert dict(labels)["span_name"] == "new"
+        assert est < 0.01, (paged, est)            # no 5s contamination
+
+
+def test_weighted_pushes_upscale_moments():
+    # HT weights: half the stream at weight 2 ≈ the full stream
+    rng = np.random.default_rng(10)
+    durs = rng.lognormal(-2, 0.6, 2000)
+    _, reg_a, proc_a = _mk_world(False, "moments")
+    _, reg_b, proc_b = _mk_world(False, "moments")
+    _push(reg_a, proc_a, durs)
+    _push(reg_b, proc_b, durs[::2], weights=np.full(1000, 2.0, np.float32))
+    qa = next(iter(proc_a.quantile(0.9).values()))
+    qb = next(iter(proc_b.quantile(0.9).values()))
+    assert abs(qa - qb) / qa < 0.1
+
+
+def test_per_tenant_sketch_override():
+    from tempo_tpu.generator.generator import Generator
+    from tempo_tpu.overrides import Overrides
+
+    o = Overrides()
+    o.set_tenant_patch("m-tenant", {"generator": {
+        "sketch": "moments", "sketch_moments_k": 8}})
+    g = Generator(overrides=o)
+    proc = g.instance("m-tenant").processors["span-metrics"]
+    assert proc.mom is not None and proc.mom.k == 8 and proc.dd is None
+    proc2 = g.instance("other").processors["span-metrics"]
+    assert proc2.dd is not None and proc2.mom is None
+
+
+def test_unknown_tier_falls_back_to_dd_with_warning(caplog):
+    import logging
+    with caplog.at_level(logging.WARNING, logger="tempo_tpu.spanmetrics"):
+        _, _reg, proc = _mk_world(False, "tdigest")
+    assert proc.dd is not None and proc.mom is None
+    assert any("unknown sketch tier" in r.message for r in caplog.records)
+
+
+def test_config_check_sketch_bounds():
+    from tempo_tpu.app.config import load_config
+
+    good = load_config(text="generator:\n  spanmetrics:\n    sketch: moments\n")
+    assert not [w for w in good.check() if "sketch" in w]
+    bad = load_config(text="generator:\n  spanmetrics:\n    sketch: nope\n"
+                           "    moments_k: 40\n")
+    warns = bad.check()
+    assert any("spanmetrics.sketch" in w for w in warns)
+    assert any("moments_k" in w for w in warns)
+
+
+def test_obs_families_render():
+    from tempo_tpu.obs.jaxruntime import RUNTIME
+    text = RUNTIME.render()
+    for fam in ("tempo_moments_solves_total",
+                "tempo_moments_solver_fallback_total",
+                "tempo_moments_solve_cache_hits_total",
+                "tempo_moments_solve_seconds_total"):
+        assert fam in text, fam
+
+
+def test_scheduler_coalesced_route_matches_direct():
+    # the packed4 coalescer path must carry the moments plane exactly
+    # like the direct dispatch (merged windows, padded slot -1 rows)
+    from tempo_tpu import sched
+    from tempo_tpu.sched import DeviceScheduler, SchedConfig
+
+    rng_seed = 13
+    results = {}
+    for use_sched in (False, True):
+        _, reg, proc = _mk_world(False, "moments")
+        proc.cfg = dataclasses.replace(proc.cfg, use_scheduler=use_sched)
+        sc = DeviceScheduler(SchedConfig(), start_worker=False) \
+            if use_sched else None
+        with sched.use(sc):
+            rng = np.random.default_rng(rng_seed)
+            for op in ("a", "b"):
+                _push(reg, proc, rng.lognormal(-2, 0.5, 64), op=op)
+            sched.flush()    # drain queued windows before the reads
+            results[use_sched] = (
+                proc.quantile(0.9),
+                sorted((s.name, s.labels, s.value)
+                       for s in reg.collect(1) if s.value == s.value))
+    assert results[False] == results[True]
+
+
+# ---------------------------------------------------------------------------
+# serving mesh
+# ---------------------------------------------------------------------------
+
+def test_mesh_serving_step_with_moments_matches_single_device():
+    from tempo_tpu.parallel import serving
+
+    results = {}
+    for shards in (1, 2):
+        sm = serving.ServingMesh(serving.MeshConfig(
+            enabled=True, devices=shards, series_shards=shards))
+        with serving.use(sm):
+            _, reg, proc = _mk_world(False, "moments")
+            rng = np.random.default_rng(11)
+            for op in ("a", "b"):
+                _push(reg, proc, rng.lognormal(-2, 0.5, 64), op=op)
+            results[shards] = (
+                proc.quantile(0.9),
+                sorted((s.name, s.labels, s.value)
+                       for s in reg.collect(1) if s.value == s.value))
+    assert results[1] == results[2]
+
+
+def test_paged_mesh_step_with_moments_matches_dense():
+    # the paged fused step's shard_map variant with a moments arena:
+    # arenas shard page-aligned over 'series', the moments plane rides
+    # its own localized pseudo page table — answers must match the
+    # dense single-device world exactly
+    from tempo_tpu.parallel import serving
+    from tempo_tpu.registry import pages as device_pages
+
+    sm = serving.ServingMesh(serving.MeshConfig(
+        enabled=True, devices=2, series_shards=2))
+    with serving.use(sm):
+        pool = device_pages.PagePool(device_pages.PagePoolConfig(
+            enabled=True, page_rows=16, arena_slots=512))
+        clock = [1000.0]
+        with device_pages.use(pool):
+            from tempo_tpu.generator.processors.spanmetrics import (
+                SpanMetricsConfig, SpanMetricsProcessor)
+            from tempo_tpu.registry.registry import (ManagedRegistry,
+                                                     RegistryOverrides)
+            reg = ManagedRegistry("t", RegistryOverrides(
+                max_active_series=64), now=lambda: clock[0])
+            proc = SpanMetricsProcessor(reg, SpanMetricsConfig(
+                use_scheduler=False, sketch="moments",
+                sketch_max_series=32))
+        rng = np.random.default_rng(14)
+        for op in ("a", "b"):
+            _push(reg, proc, rng.lognormal(-2, 0.5, 64), op=op)
+        mesh_result = (proc.quantile(0.9),
+                       sorted((s.name, s.labels, s.value)
+                              for s in reg.collect(1)
+                              if s.value == s.value))
+    _, reg_d, proc_d = _mk_world(False, "moments")
+    rng = np.random.default_rng(14)
+    for op in ("a", "b"):
+        _push(reg_d, proc_d, rng.lognormal(-2, 0.5, 64), op=op)
+    dense_result = (proc_d.quantile(0.9),
+                    sorted((s.name, s.labels, s.value)
+                           for s in reg_d.collect(1)
+                           if s.value == s.value))
+    assert mesh_result == dense_result
+
+
+# ---------------------------------------------------------------------------
+# TraceQL quantile_over_time moments axis
+# ---------------------------------------------------------------------------
+
+def _ts(labels, samples):
+    from tempo_tpu.traceql.engine_metrics import TimeSeries
+    return TimeSeries(tuple(labels), np.asarray(samples, np.float64))
+
+
+def test_combiner_moment_bounds_merge_by_max():
+    from tempo_tpu.traceql import ast as A
+    from tempo_tpu.traceql.engine_metrics import (_LABEL_MOMENT,
+                                                  SeriesCombiner)
+
+    comb = SeriesCombiner(A.MetricsKind.QUANTILE_OVER_TIME, 3)
+    base = (("svc", "a"),)
+    comb.add_all([_ts(base + ((_LABEL_MOMENT, "0"),), [1, 2, 3]),
+                  _ts(base + ((_LABEL_MOMENT, "hi"),), [5, 1, 2])])
+    comb.add_all([_ts(base + ((_LABEL_MOMENT, "0"),), [1, 1, 1]),
+                  _ts(base + ((_LABEL_MOMENT, "hi"),), [2, 4, 1])])
+    got = comb.series
+    np.testing.assert_allclose(
+        got[base + ((_LABEL_MOMENT, "0"),)].samples, [2, 3, 4])   # sum
+    np.testing.assert_allclose(
+        got[base + ((_LABEL_MOMENT, "hi"),)].samples, [5, 4, 2])  # max
+
+
+def test_quantile_over_time_multi_q_single_fold(monkeypatch):
+    """Satellite: 3 quantile params must fold the summed grid ONCE."""
+    from tempo_tpu.traceql import engine_metrics as em
+
+    calls = {"n": 0}
+    orig = em._fold_cumulative
+
+    def counting(g):
+        calls["n"] += 1
+        return orig(g)
+
+    monkeypatch.setattr(em, "_fold_cumulative", counting)
+    comb = em.SeriesCombiner(
+        __import__("tempo_tpu.traceql.ast", fromlist=["ast"]).MetricsKind
+        .QUANTILE_OVER_TIME, 4)
+    base = (("svc", "a"),)
+    rng = np.random.default_rng(0)
+    series = [_ts(base + ((em._LABEL_BUCKET, 2.0 ** b / 1e9),),
+                  rng.integers(0, 10, 4)) for b in range(20, 30)]
+    comb.add_all(series)
+    req = em.QueryRangeRequest(
+        query="{ } | quantile_over_time(duration, .5, .9, .99)",
+        start_ns=0, end_ns=4 * 10**9, step_ns=10**9)
+    out = comb.final(req)
+    assert len(out) == 3                      # one series per q
+    assert calls["n"] == 1                    # ONE fold for all three
+    # and the multi-q helper matches the scalar reference math
+    g = np.zeros((4, em.HBUCKETS))
+    for ts in series:
+        b = int(round(np.log2(dict(ts.labels)[em._LABEL_BUCKET] * 1e9)))
+        g[:, b] += ts.samples
+    for ts in out:
+        qv = dict(ts.labels)["p"]
+        ref = [em.log2_quantile(qv, g[s]) for s in range(4)]
+        np.testing.assert_allclose(ts.samples, ref)
+
+
+def test_quantile_over_time_moments_axis_end_to_end():
+    from tempo_tpu.traceql.engine_metrics import (MetricsEvaluator,
+                                                  QueryRangeRequest,
+                                                  SeriesCombiner,
+                                                  _LABEL_MOMENT,
+                                                  metrics_kind)
+    from tempo_tpu.traceql.memview import view_from_traces
+
+    rng = np.random.default_rng(12)
+    t0 = 1_700_000_000
+    traces = []
+    durs = []
+    for _ in range(3000):
+        tid = rng.bytes(16)
+        start = int((t0 + float(rng.random()) * 50) * 1e9)
+        d = int(rng.lognormal(np.log(4e7), 0.9))
+        durs.append(d)
+        traces.append((tid, [{
+            "trace_id": tid, "span_id": rng.bytes(8), "name": "op",
+            "service": "svc", "kind": 2, "status_code": 0,
+            "start_unix_nano": start, "end_unix_nano": start + d}]))
+    q = "{ } | quantile_over_time(duration, .5, .9, .99)"
+    req = QueryRangeRequest(query=q, start_ns=int(t0 * 1e9),
+                            end_ns=int((t0 + 60) * 1e9),
+                            step_ns=int(60e9))
+    view = view_from_traces(traces)
+    with M.use_query_tier("moments"):
+        ev = MetricsEvaluator(req)
+        ev.observe(view)
+        job = ev.results()
+        # job-level payload is moment series, not 64-bucket series
+        assert all(_LABEL_MOMENT in dict(s.labels) for s in job)
+        assert len(job) <= M.QUERY_K + 3
+        comb = SeriesCombiner(metrics_kind(q), req.n_steps)
+        comb.add_all(job)
+        final = {dict(s.labels)["p"]: float(s.samples[0])
+                 for s in comb.final(req)}
+    exact = {qv: float(np.quantile(durs, qv)) / 1e9
+             for qv in (0.5, 0.9, 0.99)}
+    xs = np.sort(np.asarray(durs, np.float64)) / 1e9
+    for qv, est in final.items():
+        rel = abs(est - exact[qv]) / exact[qv]
+        rank = abs(np.searchsorted(xs, est) / len(xs) - qv)
+        assert min(rel, rank) < 0.05, (qv, est, exact[qv], rel, rank)
+    # monotone across the requested quantiles
+    assert final[0.5] <= final[0.9] <= final[0.99]
+    # identical data split across two evaluators merges to ≈ the same
+    # answer (the psum-only combine property)
+    with M.use_query_tier("moments"):
+        comb2 = SeriesCombiner(metrics_kind(q), req.n_steps)
+        for half in (traces[:len(traces) // 2],
+                     traces[len(traces) // 2:]):
+            ev = MetricsEvaluator(req)
+            ev.observe(view_from_traces(half))
+            comb2.add_all(ev.results())
+        final2 = {dict(s.labels)["p"]: float(s.samples[0])
+                  for s in comb2.final(req)}
+    for qv in final:
+        assert abs(final[qv] - final2[qv]) / final[qv] < 0.02

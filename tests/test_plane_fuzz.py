@@ -14,6 +14,7 @@ the whole module under a minute in CI).
 
 from __future__ import annotations
 
+import math
 import os
 import random
 
@@ -285,3 +286,138 @@ def test_fuzz_paged_vs_dense_differential():
     pool = worlds[0][2]
     assert pool.allocated_total > pool.total_pages() - pool.free_pages(), \
         f"seed={SEED}: fuzz script never recycled a page (weak run)"
+
+
+# -- moments-vs-exact differential arm ---------------------------------------
+#
+# The quantile-accuracy twin of the paged-vs-dense arm: random WEIGHTED
+# op scripts (pushes with Horvitz-Thompson-style weights, purges, and
+# evict-then-reuse of slots) against moments-tier processors in BOTH
+# layouts. Gates: (1) paged and dense moments worlds stay bit-identical,
+# (2) every live series' quantile answers stay inside the tier's error
+# bound versus an exactly-tracked weighted distribution — including
+# series whose slot was recycled after a purge (stale history leaking
+# into a reused row is exactly what this arm would catch), and (3) the
+# solver never falls back in steady state.
+
+def _mx_make_world(paged: bool):
+    from tempo_tpu.generator.processors.spanmetrics import (
+        SpanMetricsConfig, SpanMetricsProcessor)
+    from tempo_tpu.registry import pages as device_pages
+    from tempo_tpu.registry.registry import ManagedRegistry, RegistryOverrides
+
+    clock = [1000.0]
+    pool = device_pages.PagePool(device_pages.PagePoolConfig(
+        enabled=True, page_rows=16, arena_slots=512)) if paged else None
+    with device_pages.use(pool):
+        reg = ManagedRegistry(
+            "m", RegistryOverrides(max_active_series=64,
+                                   stale_duration_s=50.0),
+            now=lambda: clock[0])
+        proc = SpanMetricsProcessor(reg, SpanMetricsConfig(
+            use_scheduler=False, sketch="moments", sketch_max_series=32))
+    return clock, reg, proc
+
+
+def _mx_weighted_quantile(samples: list, q: float) -> float:
+    vals = np.array([v for v, _ in samples])
+    wts = np.array([w for _, w in samples])
+    order = np.argsort(vals)
+    cum = np.cumsum(wts[order])
+    i = int(np.searchsorted(cum, q * cum[-1], side="left"))
+    return float(vals[order][min(i, len(vals) - 1)])
+
+
+def test_fuzz_moments_vs_exact_differential():
+    from tempo_tpu.model.span_batch import SpanBatchBuilder
+    from tempo_tpu.ops import moments as M
+
+    n_ops = max(int(os.environ.get("TEMPO_FUZZ_CASES", 40)) // 2, 12)
+    script = random.Random(SEED + 4)
+    worlds = [_mx_make_world(paged) for paged in (True, False)]
+    exact: dict[str, list] = {}       # op name -> [(duration, weight)]
+    fb0 = M.fallbacks_total
+
+    def check():
+        for q in (0.5, 0.99):
+            per_world = [w[2].quantile(q) for w in worlds]
+            assert per_world[0] == per_world[1], \
+                f"seed={SEED} q={q}: paged != dense"
+            for labels, est in per_world[0].items():
+                op = dict(labels)["span_name"]
+                samples = exact.get(op)
+                if not samples or len(samples) < 16:
+                    continue
+                ex = _mx_weighted_quantile(samples, q)
+                vals = np.sort(np.array([v for v, _ in samples]))
+                rel = abs(est - ex) / max(ex, 1e-12)
+                rank = abs(np.searchsorted(vals, est) / len(vals) - q)
+                # tier bound at volume; sampling-noise slack below it
+                # (the empirical quantile of a 100-point multi-scale
+                # mixture is itself ~1/sqrt(n) uncertain). Corruption —
+                # stale history in a reused slot, cross-layout drift —
+                # shows up as GROSS error either way.
+                tol = max(0.06, 2.0 / math.sqrt(len(samples)))
+                assert min(rel, rank) <= tol, \
+                    f"seed={SEED} op={op} q={q}: est={est} exact={ex}"
+
+    for step in range(n_ops):
+        op = script.choice(["push", "push", "push", "purge", "check",
+                            "idle"])
+        seed = script.randrange(1 << 30)
+        dt = script.choice([0.0, 5.0, 60.0])
+        for clock, reg, proc in worlds:
+            clock[0] += dt
+        if op == "push":
+            rng = np.random.default_rng(seed)
+            name = f"op-{script.randrange(6)}"
+            n = script.choice([32, 64, 128])
+            scale = script.choice([0.01, 0.1, 1.0])
+            durs = rng.lognormal(np.log(scale), 0.7, n)
+            wts = (rng.integers(1, 4, n).astype(np.float32)
+                   if script.random() < 0.5 else np.ones(n, np.float32))
+            exact.setdefault(name, []).extend(zip(durs.tolist(),
+                                                  wts.tolist()))
+            for clock, reg, proc in worlds:
+                b = SpanBatchBuilder(reg.interner)
+                for d in durs:
+                    b.append(trace_id=bytes(16), span_id=bytes(8),
+                             name=name, service="svc", kind=2,
+                             status_code=0, start_unix_nano=10**18,
+                             end_unix_nano=10**18 + int(d * 1e9))
+                proc.push_batch(b.build(), sample_weights=wts)
+        elif op == "purge":
+            evicted = [w[1].purge_stale() for w in worlds]
+            assert evicted[0] == evicted[1], f"seed={SEED} step={step}"
+            if evicted[0]:
+                # drop exact tracking for the ops that aged out (their
+                # device rows were zeroed; a re-push starts both fresh)
+                proc = worlds[0][2]
+                live = {dict(proc.calls.labels_of(int(s)))["span_name"]
+                        for s in proc.calls.table.active_slots()}
+                for name in list(exact):
+                    if name not in live:
+                        del exact[name]
+        elif op == "check":
+            check()
+    # deterministic evict-reuse coda: age everything out, repopulate the
+    # SAME op names (paged world recycles freed pages, dense reuses
+    # slots) — answers must reflect ONLY the new stream
+    for clock, reg, proc in worlds:
+        clock[0] += 1000.0
+        assert reg.purge_stale() >= 0
+    exact.clear()
+    rng = np.random.default_rng(SEED + 5)
+    durs = rng.lognormal(np.log(0.02), 0.4, 128)
+    exact["op-0"] = [(d, 1.0) for d in durs.tolist()]
+    for clock, reg, proc in worlds:
+        b = SpanBatchBuilder(reg.interner)
+        for d in durs:
+            b.append(trace_id=bytes(16), span_id=bytes(8), name="op-0",
+                     service="svc", kind=2, status_code=0,
+                     start_unix_nano=10**18,
+                     end_unix_nano=10**18 + int(d * 1e9))
+        proc.push_batch(b.build())
+    check()
+    assert M.fallbacks_total == fb0, \
+        f"seed={SEED}: solver fell back during the fuzz run"
