@@ -2129,11 +2129,157 @@ def bench_moments() -> dict:
 
 # --- orchestrator ----------------------------------------------------------
 
+def bench_paged_fused() -> dict:
+    """Pallas ragged-page fused kernel (ISSUE 11): composed XLA scatters
+    vs the single-pass Pallas kernel on the coalescer's packed
+    `[roles, bucket]` shape, across bucket sizes {256, 4096, 65536}.
+
+    On a real TPU the accept gate is >= 2x fused-update throughput for
+    the Pallas tier. On CPU containers Mosaic cannot lower, so the gate
+    is interpret-mode parity on a small shape (collect bit-identity
+    against the composed-scatter path) and the composed-scatter numbers
+    are still recorded per bucket as the baseline the next TPU run
+    compares against.
+    """
+    import statistics
+
+    import jax
+
+    from tempo_tpu.generator.processors.spanmetrics import (
+        SpanMetricsConfig, SpanMetricsProcessor)
+    from tempo_tpu.model.span_batch import SpanBatchBuilder
+    from tempo_tpu.obs.jaxruntime import JIT_COMPILES
+    from tempo_tpu.registry import pages as device_pages
+    from tempo_tpu.registry.registry import ManagedRegistry, RegistryOverrides
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    cap, page_rows = 1024, 256
+    buckets = (256, 4096, 65536)
+    rng = np.random.default_rng(11)
+
+    def world(kernel, small=False):
+        c, pr = (64, 16) if small else (cap, page_rows)
+        pool = device_pages.PagePool(device_pages.PagePoolConfig(
+            enabled=True, page_rows=pr, arena_slots=c))
+        with device_pages.use(pool):
+            reg = ManagedRegistry(
+                "bench", RegistryOverrides(max_active_series=c),
+                now=time.monotonic)
+            proc = SpanMetricsProcessor(reg, SpanMetricsConfig(
+                use_scheduler=False, sketch="dd", sketch_max_series=c,
+                sketch_rel_err=0.02, kernel=kernel,
+                pallas_interpret=(kernel == "pallas" and not on_tpu)))
+            # back every series once so the bench mats hit live pages
+            b = SpanBatchBuilder(reg.interner)
+            for i in range(c):
+                b.append(trace_id=bytes(16), span_id=bytes(8),
+                         name=f"op-{i}", service="svc", kind=2,
+                         status_code=0, start_unix_nano=10**18,
+                         end_unix_nano=10**18 + 10**6)
+            proc.push_batch(b.build())
+        return reg, proc
+
+    def mat_for(bucket, c):
+        m = np.empty((4, bucket), np.float32)
+        m[0] = rng.integers(0, c, bucket)
+        m[1] = rng.lognormal(-3, 1.5, bucket)
+        m[2] = rng.integers(100, 5000, bucket)
+        m[3] = 1.0
+        return m
+
+    def arm(kernel):
+        reg, proc = world(kernel)
+        per_bucket = {}
+        compiles0 = JIT_COMPILES.value((proc._sched_kernel,))
+        for bucket in buckets:
+            mats = [mat_for(bucket, cap) for _ in range(3)]
+            proc._paged_dispatch_packed4(mats[0])          # warm
+            iters = 10 if (on_tpu or kernel == "xla") else 1
+            times = []
+            for _ in range(3):
+                t0 = time.time()
+                for i in range(iters):
+                    proc._paged_dispatch_packed4(mats[i % len(mats)])
+                with reg.state_lock:
+                    jax.block_until_ready(proc._paged_planes()[0].data)
+                times.append((time.time() - t0) / iters)
+            per_bucket[bucket] = bucket / statistics.median(times)
+        steady = JIT_COMPILES.value((proc._sched_kernel,)) - compiles0 \
+            - len(buckets)  # one trace per bucket shape is the warm cost
+        return reg, proc, per_bucket, steady
+
+    _, _, xla_rates, xla_steady = arm("xla")
+    out = {("paged_fused_xla_%d_spans_per_sec" % b): r
+           for b, r in xla_rates.items()}
+    out["paged_fused_steady_state_compiles"] = int(max(xla_steady, 0))
+    if on_tpu:
+        _, _, pal_rates, pal_steady = arm("pallas")
+        out.update({("paged_fused_pallas_%d_spans_per_sec" % b): r
+                    for b, r in pal_rates.items()})
+        speedup = min(pal_rates[b] / xla_rates[b] for b in buckets)
+        out["paged_fused_pallas_x"] = speedup
+        out["paged_fused_steady_state_compiles"] += int(max(pal_steady, 0))
+        out["paged_fused_accept_ok"] = bool(
+            speedup >= 2.0 and out["paged_fused_steady_state_compiles"] == 0)
+        return out
+    # CPU: interpret-mode parity gate on a small shape. world(small=True)
+    # backs all 64 budget series as (kind=2, status=0), so the first 40
+    # parity spans reuse those combos with varied durations — live-slot
+    # accumulation through the kernel — while the rest carry combos the
+    # spent series budget rejects, exercising the -1 discard path
+    # (pallas: trash-page redirect) identically in both worlds.
+    worlds = [world(k, small=True) for k in ("pallas", "xla")]
+
+    def parity_batch(reg):
+        b = SpanBatchBuilder(reg.interner)
+        for i in range(48):
+            reuse = i < 40
+            b.append(trace_id=bytes(16), span_id=bytes(8),
+                     name=f"op-{i % 13}", service="svc",
+                     kind=2 if reuse else i % 6,
+                     status_code=0 if reuse else 1 + i % 2,
+                     start_unix_nano=10**18,
+                     end_unix_nano=10**18 + 10**5 * (i + 1))
+        return b.build()
+
+    for reg, proc in worlds:
+        proc.push_batch(parity_batch(reg))
+    collects = [sorted((s.name, s.labels, s.value)
+                       for s in w[0].collect(1)) for w in worlds]
+    # parity per the kernel-tier numerics contract (pallas_kernels.py
+    # module docstring): count/bucket planes bit-identical, float-sum
+    # planes to f32 reduction-order tolerance (MXU tree order vs scatter
+    # sort order)
+    parity, max_sum_rel = True, 0.0
+    for (na, la, va), (nb, lb, vb) in zip(*collects):
+        if (na, la) != (nb, lb):
+            parity = False
+            break
+        if na.endswith(("_sum", "_size_total")):
+            rel = abs(va - vb) / max(abs(va), 1e-9)
+            max_sum_rel = max(max_sum_rel, rel)
+            parity = parity and rel <= 1e-6
+        else:
+            parity = parity and va == vb
+    parity = parity and len(collects[0]) == len(collects[1])
+    # guard against a vacuous gate: the reused spans must have landed on
+    # live slots (64 backing calls + 40 accumulated parity calls)
+    calls_total = sum(v for n, _, v in collects[0]
+                      if n == "traces_spanmetrics_calls_total")
+    out["paged_fused_pallas_x"] = None
+    out["paged_fused_parity_calls"] = calls_total
+    out["paged_fused_parity_max_sum_rel"] = max_sum_rel
+    out["paged_fused_interpret_parity_ok"] = bool(
+        parity and calls_total == 64 + 40)
+    out["paged_fused_accept_ok"] = bool(out["paged_fused_interpret_parity_ok"])
+    return out
+
+
 STAGES = {"e2e": bench_e2e_ingest, "kernel": bench_kernel,
           "query": bench_query, "obs": bench_obs, "sched": bench_sched,
           "saturation": bench_saturation, "multichip": bench_multichip,
           "pages": bench_pages, "moments": bench_moments,
-          "soak": bench_soak}
+          "paged_fused": bench_paged_fused, "soak": bench_soak}
 
 
 def _cpu_env(env: dict) -> dict:
@@ -2466,6 +2612,25 @@ def main() -> int:
             "pages_steady_state_compiles"),
         "pages_collect_bitident": results.get("pages_collect_bitident"),
         "pages_accept_ok": results.get("pages_accept_ok"),
+        # pallas ragged-page fused kernel (ISSUE 11): composed-scatter
+        # baseline per packed bucket size + the pallas speedup (real TPU)
+        # or interpret-mode parity (CPU containers)
+        "paged_fused_xla_256_spans_per_sec": round(
+            results["paged_fused_xla_256_spans_per_sec"], 1)
+        if "paged_fused_xla_256_spans_per_sec" in results else None,
+        "paged_fused_xla_4096_spans_per_sec": round(
+            results["paged_fused_xla_4096_spans_per_sec"], 1)
+        if "paged_fused_xla_4096_spans_per_sec" in results else None,
+        "paged_fused_xla_65536_spans_per_sec": round(
+            results["paged_fused_xla_65536_spans_per_sec"], 1)
+        if "paged_fused_xla_65536_spans_per_sec" in results else None,
+        "paged_fused_pallas_x": round(results["paged_fused_pallas_x"], 2)
+        if results.get("paged_fused_pallas_x") is not None else None,
+        "paged_fused_interpret_parity_ok": results.get(
+            "paged_fused_interpret_parity_ok"),
+        "paged_fused_steady_state_compiles": results.get(
+            "paged_fused_steady_state_compiles"),
+        "paged_fused_accept_ok": results.get("paged_fused_accept_ok"),
     }
     if errors:
         extra["errors"] = errors

@@ -1,8 +1,11 @@
-"""Device-kernel shootout: XLA scatter vs Pallas MXU one-hot matmul.
+"""Device-kernel shootout: XLA scatter vs Pallas MXU one-hot matmul,
+plus the paged-fused line (composed scatters vs the Pallas ragged-page
+kernel on the packed [roles, bucket] coalescer shape).
 
 Run on real TPU:  python -u benchmarks/bench_kernels.py
 (Leave env untouched; the axon relay serves the chip. Prints one JSON line
-per formulation.)
+per formulation. On CPU the paged_fused line gates on interpret-mode
+parity instead of speed — Mosaic cannot lower to CPU.)
 """
 
 from __future__ import annotations
@@ -209,6 +212,118 @@ def main() -> None:
         },
         "platform": jax.devices()[0].platform,
     }))
+
+    # paged fused family update (ISSUE 11): composed XLA scatters vs the
+    # single-pass Pallas ragged-page kernel on the coalescer's packed
+    # [roles, bucket] shape. The composed path re-gathers the page-table
+    # indirection once PER ROLE (7 scatters here: calls, latency
+    # sum/count, size, latency grid, dd grid, dd zeros); the Pallas
+    # kernel walks the stacked tables once per span block. TPU gate:
+    # pallas >= 2x. CPU: Mosaic cannot lower — gate is interpret-mode
+    # parity on a small shape, composed numbers recorded as baseline.
+    import statistics as _st
+
+    from tempo_tpu.ops import pages as op_pages
+
+    page_rows, cap = 256, 4096
+    lpages = cap // page_rows
+    n_phys = lpages + 2                  # + trash page + slack
+    gamma_pf, nb_pf = 1.05, 512
+    rows = n_phys * page_rows
+    n_hist = len(EDGES) + 1
+
+    def pf_arenas():
+        # distinct buffers: the step donates every arena (a shared
+        # zeros buffer would be donated twice and XLA rejects it)
+        return tuple(jnp.zeros(rows, jnp.float32) for _ in range(4)) + (
+            jnp.zeros((rows, n_hist), jnp.float32),
+            jnp.zeros(rows, jnp.float32),
+            jnp.zeros((rows, nb_pf), jnp.float32))
+
+    # every logical page backed (phys 0 = reserved trash)
+    table = jnp.asarray(np.arange(1, lpages + 1, dtype=np.int32))
+    tabs = (table,) * 7
+    prng = np.random.default_rng(3)
+
+    def pf_mat(bucket):
+        m = np.empty((4, bucket), np.float32)
+        m[0] = prng.integers(0, cap, bucket)
+        m[1] = prng.lognormal(-3, 1.5, bucket)
+        m[2] = prng.integers(100, 5000, bucket)
+        m[3] = 1.0
+        return m
+
+    def pf_arm(kernel, buckets, interp=False, iters=10):
+        step = op_pages.fused_step(
+            EDGES, gamma_pf, 1e-6, cap, page_rows.bit_length() - 1,
+            packed=True, kernel=kernel, interpret=interp)
+        out = {}
+        for bucket in buckets:
+            mats = [jnp.asarray(pf_mat(bucket)) for _ in range(3)]
+            arenas = pf_arenas()
+            arenas = step(*arenas, *tabs, mats[0])       # warm trace
+            times = []
+            for _ in range(3):
+                t0 = time.time()
+                for i in range(iters):
+                    arenas = step(*arenas, *tabs, mats[i % 3])
+                jax.block_until_ready(arenas[0])
+                times.append((time.time() - t0) / iters)
+            out[bucket] = bucket / _st.median(times)
+        return out
+
+    pf_buckets = (256, 4096, 65536)
+    xla_rates = pf_arm("xla", pf_buckets)
+    extra = {f"xla_{b}_spans_per_sec": round(r, 1)
+             for b, r in xla_rates.items()}
+    if on_tpu:
+        pal_rates = pf_arm("pallas", pf_buckets)
+        extra.update({f"pallas_{b}_spans_per_sec": round(r, 1)
+                      for b, r in pal_rates.items()})
+        speedup = min(pal_rates[b] / xla_rates[b] for b in pf_buckets)
+        print(json.dumps({"metric": "paged_fused",
+                          "value": round(speedup, 2),
+                          "unit": "x_pallas_vs_composed_scatter",
+                          "extra": extra, "platform": "tpu"}))
+        print(json.dumps({"check": "paged_fused_pallas_2x",
+                          "ok": bool(speedup >= 2.0)}))
+    else:
+        # parity gate, tiny shape (interpret is pure Python)
+        small_pr, small_cap, small_nb = 8, 32, 32
+        srows = (small_cap // small_pr + 2) * small_pr
+        stable = (jnp.asarray(
+            np.arange(1, small_cap // small_pr + 1, dtype=np.int32)),) * 7
+        sm = np.empty((4, 64), np.float32)
+        sm[0] = prng.integers(-1, small_cap, 64)
+        sm[1] = prng.lognormal(-3, 1.5, 64)
+        sm[2] = prng.integers(100, 5000, 64)
+        sm[3] = prng.integers(1, 4, 64)
+        smat = jnp.asarray(sm)
+
+        def small_arenas():
+            return tuple(jnp.zeros(srows, jnp.float32)
+                         for _ in range(4)) + (
+                jnp.zeros((srows, n_hist), jnp.float32),
+                jnp.zeros(srows, jnp.float32),
+                jnp.zeros((srows, small_nb), jnp.float32))
+
+        def small_step(kernel, interp):
+            return op_pages.fused_step(
+                EDGES, gamma_pf, 1e-6, small_cap,
+                small_pr.bit_length() - 1, packed=True, kernel=kernel,
+                interpret=interp)
+
+        a_x = small_step("xla", False)(*small_arenas(), *stable, smat)
+        a_p = small_step("pallas", True)(*small_arenas(), *stable, smat)
+        parity = all(
+            np.allclose(np.asarray(x), np.asarray(p), rtol=1e-6, atol=1e-7)
+            for x, p in zip(a_x, a_p))
+        print(json.dumps({"metric": "paged_fused",
+                          "value": 0.0,
+                          "unit": "x_pallas_vs_composed_scatter",
+                          "extra": extra, "platform": "cpu"}))
+        print(json.dumps({"check": "paged_fused_interpret_parity",
+                          "ok": bool(parity)}))
 
 
 if __name__ == "__main__":

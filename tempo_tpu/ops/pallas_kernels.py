@@ -1,40 +1,96 @@
-"""Pallas TPU kernels for the sketch-update plane — and why XLA wins here.
+"""Pallas TPU kernels for the sketch-update plane.
 
 The hot op of this framework is a masked segment scatter-add: N spans fold
 into S series of {count, duration-sum, size, log2/DD histogram buckets}.
-Two device formulations exist:
+Three device formulations exist, and WHICH one wins depends on whether
+the state is dense or paged:
 
 1. **XLA scatter** (`ops/sketches.py` / `registry/metrics.py`,
    `.at[slots, ...].add`): XLA:TPU lowers batched scatters to a sort +
    segmented reduction. Measured on a real v5e chip this sustains
    ~3.7e9 spans/s through the FULL fused spanmetrics step (bench.py) —
-   370x the north-star target.
-2. **MXU one-hot matmul** (this module): each span block builds a one-hot
-   slot matrix and a feature matrix (count|dur|size|hist-onehot), and the
-   partial state is `onehotᵀ @ features` — a dense [S, F] accumulation on
-   the systolic array across a sequential grid over span blocks. This is
-   the canonical "scatter as matmul" TPU trick; it pays S*F*N FLOPs for a
-   job that is information-theoretically O(N*F), so it only wins when S is
-   tiny. `benchmarks/bench_kernels.py` measures both on the real chip.
+   370x the north-star target. On DENSE state this is the production
+   default and the measured winner.
+2. **MXU one-hot matmul** (`fused_spanmetrics_matmul`): each span block
+   builds a one-hot slot matrix and a feature matrix
+   (count|dur|size|hist-onehot), and the partial state is
+   `onehotᵀ @ features` — a dense [S, F] accumulation on the systolic
+   array across a sequential grid over span blocks. This is the
+   canonical "scatter as matmul" TPU trick; it pays S*F*N FLOPs for a
+   job that is information-theoretically O(N*F), so it only wins when S
+   is tiny. Measured on a real v5e-1 (262144 spans, 4096 series, 16
+   features): XLA scatter 81.4M spans/s, MXU matmul 81.6M spans/s —
+   parity on the fresh-delta shape, which is why dense state stays on
+   XLA.
+3. **Paged ragged fused update** (`paged_fused_update`, this PR): the
+   paged layout (`registry/pages.py`) changed the shape of the problem.
+   There the composed-scatter path (`ops/pages.py` `_fused_body`) issues
+   SEVEN-to-EIGHT separate scatters per ingest batch — calls, latency
+   sum, latency count, size, the latency histogram grid, the DDSketch
+   grid + zeros, the moments row — and EVERY one re-gathers the same
+   page-table indirection and pays its own sort + segmented reduction
+   over the same slot vector. The information content of the batch did
+   not grow eight-fold; the dispatch overhead did. This kernel is the
+   "Ragged Paged Attention" formulation of the update (PAPERS.md): the
+   per-role page tables ride as SCALAR-PREFETCH operands, the grid walks
+   the logical pages of the series table, each grid step translates the
+   page ONCE through the prefetched tables (data-dependent BlockSpec
+   index maps — the RPA trick), accumulates every role's delta for that
+   page in one VMEM-resident `onehotᵀ @ [all features]` MXU pass, and
+   the pipeline writes each touched page back to its arena exactly once.
+   Unbacked / discard slots redirect to the pool's reserved trash page
+   (physical page 0, never allocated, predicated to stay zero), which
+   keeps the dense `-1 drops` semantics without host-side filtering.
 
-Measured on a real v5e-1 (262144 spans, 4096 series, 16 features,
-`benchmarks/bench_kernels.py`): XLA scatter 81.4M spans/s, this Pallas
-MXU kernel 81.6M spans/s — parity on the fresh-delta shape, while the
-production in-place multi-plane update (bench.py, donated buffers) runs
-at 3.7G spans/s through XLA. The kernel is kept (a) as the measured
-justification for the XLA default, (b) as the template for future dense
-kernels (a complete grid/BlockSpec/accumulator Pallas program per
-/opt/skills/guides/pallas_guide.md), and (c) because it fuses the whole
-feature plane into one MXU pass, which wins when the feature dim grows.
+Numerics contract of the paged kernel (gated by the plane-fuzz
+differential arm in tests/test_plane_fuzz.py):
+
+- Integer-count planes — calls, latency bucket grid, latency count,
+  DDSketch grid + zeros — are BIT-IDENTICAL to the composed-scatter
+  path for unit and integer HT weights (f32 integer sums are exact below
+  2^24 regardless of association), so `quantile()` off the DDSketch
+  plane is bit-identical between kernel tiers.
+- Float-sum planes (latency sum, size sum, moment sums, fractional
+  weights) agree to f32 reduction-order tolerance (~1e-6 relative): the
+  MXU reduces in tree order, the scatter in sort order.
+- The optional compact-state tier (`compact=True`) stores counts and
+  bucket grids as int32 (each dispatch's per-cell delta rounded to
+  nearest — exact for integer weights, ≤0.5 absolute per touched cell
+  per dispatch otherwise) and the latency sum as a bf16 Kahan PAIR
+  (running sum + compensation, ~1% relative tolerance documented in the
+  runbook "Choosing the update kernel"). The default `sketch: dd` f32
+  tier stays bit-identical as above.
+
+Measured (benchmarks/bench_kernels.py `paged_fused` line / bench.py
+`paged_fused` stage), alongside the dense numbers above: on this repo's
+CPU-only containers the line gates on interpret-mode parity, not speed
+(Mosaic cannot lower to CPU) — r06 container run: interpret parity OK,
+composed-scatter baseline 0.72M / 0.65M / 0.94M spans/s at packed
+bucket sizes 256 / 4096 / 65536 through the full 7-scatter paged step
+(one contended CPU core; for scale, the same class of container runs
+the DENSE fused step at multi-M spans/s — the per-role indirection
+re-gather is exactly the gap this kernel exists to close). The ≥2x fused-update
+target over composed scatters on the packed `[roles, bucket]` shape is
+a real-TPU gate and is recorded by the same bench line when an
+accelerator is reachable at bench time.
+
+The dense MXU kernel is kept (a) as the measured justification for the
+dense-XLA default, (b) as the grid/BlockSpec/accumulator template this
+paged kernel grew from (per /opt/skills/guides/pallas_guide.md), and
+(c) because it fuses the whole feature plane into one MXU pass — the
+property the paged kernel inherits.
 """
 
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_SLOT_DROPS = True  # slots < 0 contribute nothing (padding mask)
 
@@ -125,3 +181,232 @@ def fused_spanmetrics_scatter(slots, dur_s, sizes, weights, *,
                               side="left")
     out = out.at[s, 3 + bucket].add(w, mode="drop")
     return out
+
+
+# ---------------------------------------------------------------------------
+# the paged ragged fused update (ROADMAP item 2 / "Ragged Paged Attention")
+# ---------------------------------------------------------------------------
+
+def _round_i32(x):
+    """Compact-tier integer projection: nearest int of the accumulated
+    f32 delta — exact for unit/integer HT weights."""
+    return jnp.round(x).astype(jnp.int32)
+
+
+def paged_fused_update(tables, slots, vals, arenas, *, page_rows: int,
+                       edges: tuple, gamma: float, min_value: float,
+                       dd_rows: int, mom_rows: int,
+                       mom_meta: "tuple | None",
+                       compact: bool = False, interpret: bool = False,
+                       span_block: int = 512):
+    """One Pallas pass updating the whole spanmetrics plane family.
+
+    Arguments (all shapes static under jit):
+      tables  [R, P] int32 — per-role page tables stacked and padded to
+              the series table's logical page count P with -1 (unbacked).
+              Physical page 0 is the pool's reserved trash page; no real
+              page ever maps there.
+      slots   [N] int32 — logical series slots; negative = discard.
+      vals    [3, N] f32 — (dur_s, size_bytes, weights) rows.
+      arenas  role-aligned plane arenas, the `ops.pages._fused_body`
+              order: (calls, hist_sums, hist_counts, sizes, hist_buckets
+              [, dd_zeros, dd_counts][, moments]). All share the same row
+              count (pool arenas are sized process-wide).
+
+    Static meta mirrors `ops.pages.fused_step`: `edges` (latency
+    histogram), `gamma`/`min_value` (DDSketch), `dd_rows`/`mom_rows`
+    (sketch-plane slot limits, 0 = tier off), `mom_meta` = (k, lo, hi).
+    `compact` expects int32 count arenas + a [rows, 2] bf16 Kahan-pair
+    sums arena (see module docstring). Returns the updated arenas
+    (aliased in-place on TPU via input_output_aliases).
+
+    Grid = one step per LOGICAL page of the series table. Each step
+    reads every role's physical page for this logical page from the
+    scalar-prefetched tables (one page-table walk), accumulates all
+    roles' deltas in a single [page_rows, F_total] VMEM scratch via one
+    one-hot MXU contraction per span chunk, and writes each role's page
+    back once through the pipelined BlockSpec (unbacked roles redirect
+    to the trash page and write it back unchanged).
+    """
+    n_roles = len(arenas)
+    dd = dd_rows > 0
+    mom = mom_rows > 0
+    want = 5 + (2 if dd else 0) + (1 if mom else 0)
+    if n_roles != want:   # real error, not assert: -O must not strip it
+        raise ValueError(
+            f"paged_fused_update: {n_roles} arenas for dd_rows={dd_rows} "
+            f"mom_rows={mom_rows} (want {want})")
+    n = slots.shape[0]
+    p_pages = tables.shape[1]
+    # span-chunk size: the largest divisor of n up to span_block (gcd —
+    # coalescer buckets are pow-2 multiples of a configurable floor, so
+    # a non-pow-2 floor like 96 must shrink the chunk, not crash)
+    blk = math.gcd(n, span_block) if n > span_block else n
+    n_chunks = n // blk
+    edges = tuple(float(e) for e in edges)
+    n_hist = len(edges) + 1
+    shift = page_rows.bit_length() - 1
+    if page_rows != 1 << shift:
+        raise ValueError(f"page_rows {page_rows} must be a power of two")
+
+    # feature-plane layout of the single accumulation scratch
+    c_calls, c_hsum, c_hcnt, c_size = 0, 1, 2, 3
+    s_hist = slice(4, 4 + n_hist)
+    f_total = 4 + n_hist
+    if dd:
+        nb_dd = arenas[6].shape[-1]
+        c_ddz = f_total
+        s_dd = slice(f_total + 1, f_total + 1 + nb_dd)
+        f_total += 1 + nb_dd
+    if mom:
+        mk, mlo, mhi = mom_meta
+        s_mom = slice(f_total, f_total + mk + 1)
+        f_total += mk + 1
+    log_gamma = math.log(gamma) if dd else 1.0
+
+    def kernel(tables_ref, slots_ref, vals_ref, *refs):
+        ins = refs[:n_roles]
+        outs = refs[n_roles:2 * n_roles]
+        acc_ref, bounds_ref = refs[2 * n_roles:]
+        t = pl.program_id(0)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        bounds_ref[...] = jnp.zeros_like(bounds_ref)
+
+        def chunk(c, carry):
+            base = c * blk
+            sl = slots_ref[pl.ds(base, blk)]
+            dur = vals_ref[0, pl.ds(base, blk)]
+            size = vals_ref[1, pl.ds(base, blk)]
+            w = vals_ref[2, pl.ds(base, blk)]
+            lp = lax.shift_right_arithmetic(sl, shift)
+            off = lax.bitwise_and(sl, page_rows - 1)
+            inpage = (sl >= 0) & (lp == t)
+            row_ids = lax.broadcasted_iota(jnp.int32, (blk, page_rows), 1)
+            onehot = jnp.where((row_ids == off[:, None]) & inpage[:, None],
+                               1.0, 0.0)
+            # latency histogram bucket (static edges unroll, like the
+            # dense kernel — pallas cannot capture traced constants)
+            hbucket = jnp.zeros((blk,), jnp.int32)
+            for e in edges:
+                hbucket = hbucket + (dur > e).astype(jnp.int32)
+            hist_ids = lax.broadcasted_iota(jnp.int32, (blk, n_hist), 1)
+            feats = [w[:, None], (dur * w)[:, None], w[:, None],
+                     (size * w)[:, None],
+                     jnp.where(hist_ids == hbucket[:, None], w[:, None],
+                               0.0)]
+            if dd:
+                ddm = jnp.where(sl < dd_rows, 1.0, 0.0) * w
+                is_zero = dur <= min_value
+                idx = jnp.ceil(
+                    jnp.log(jnp.maximum(dur, min_value) / min_value)
+                    / log_gamma)
+                idx = jnp.clip(idx, 0, nb_dd - 1).astype(jnp.int32)
+                dd_ids = lax.broadcasted_iota(jnp.int32, (blk, nb_dd), 1)
+                feats.append(jnp.where(is_zero, ddm, 0.0)[:, None])
+                feats.append(jnp.where(
+                    dd_ids == idx[:, None],
+                    jnp.where(is_zero, 0.0, ddm)[:, None], 0.0))
+            if mom:
+                from tempo_tpu.ops.moments import moments_basis
+                mm = jnp.where(sl < mom_rows, 1.0, 0.0)
+                z, basis = moments_basis(dur, mk, mlo, mhi)
+                feats.append(basis * (w * mm)[:, None])
+                # support bounds ride a masked segment-max, not the
+                # matmul: both columns are non-negative with 0 == empty,
+                # so the zero fill is the max identity
+                sel = (row_ids == off[:, None]) & inpage[:, None] \
+                    & (sl < mom_rows)[:, None]
+                b1 = jnp.where(sel, jnp.maximum(z - mlo, 0.0)[:, None], 0.0)
+                b2 = jnp.where(sel, jnp.maximum(mhi - z, 0.0)[:, None], 0.0)
+                bounds_ref[:, 0] = jnp.maximum(bounds_ref[:, 0],
+                                               jnp.max(b1, axis=0))
+                bounds_ref[:, 1] = jnp.maximum(bounds_ref[:, 1],
+                                               jnp.max(b2, axis=0))
+            fmat = jnp.concatenate(feats, axis=1)
+            # the whole plane family in ONE MXU contraction per chunk;
+            # HIGHEST precision — bf16 contraction drift is unacceptable
+            # for count-exact metrics (same constraint as the dense
+            # kernel above)
+            acc_ref[...] += lax.dot_general(
+                onehot, fmat, dimension_numbers=(((0,), (0,)), ((), ())),
+                precision=lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32)
+            return carry
+
+        lax.fori_loop(0, n_chunks, chunk, 0)
+
+        def combined(r, delta_cols):
+            """in + delta under the role arena's storage rule."""
+            ref = ins[r]
+            if compact and ref.dtype == jnp.int32:
+                return ref[...] + _round_i32(delta_cols)
+            return ref[...] + delta_cols
+
+        def write(r, new):
+            # unbacked role page → the index map redirected every ref to
+            # the trash page; write it back unchanged so it stays zero
+            valid = tables_ref[r, t] > 0
+            outs[r][...] = jnp.where(valid, new, ins[r][...])
+
+        write(0, combined(0, acc_ref[:, c_calls]))
+        if compact:
+            # bf16 Kahan pair: stored (sum, compensation); the f32 page
+            # delta folds in with the classic compensated step
+            s = ins[1][:, 0].astype(jnp.float32)
+            comp = ins[1][:, 1].astype(jnp.float32)
+            y = acc_ref[:, c_hsum] + comp
+            tot = s + y
+            comp_new = y - (tot - s)
+            write(1, jnp.stack([tot, comp_new],
+                               axis=1).astype(ins[1].dtype))
+        else:
+            write(1, combined(1, acc_ref[:, c_hsum]))
+        write(2, combined(2, acc_ref[:, c_hcnt]))
+        write(3, combined(3, acc_ref[:, c_size]))
+        write(4, combined(4, acc_ref[:, s_hist]))
+        if dd:
+            write(5, combined(5, acc_ref[:, c_ddz]))
+            write(6, combined(6, acc_ref[:, s_dd]))
+        if mom:
+            r = n_roles - 1
+            old = ins[r][...]
+            new = old.at[:, :mk + 1].add(acc_ref[:, s_mom])
+            new = new.at[:, mk + 1].set(
+                jnp.maximum(old[:, mk + 1], bounds_ref[:, 0]))
+            new = new.at[:, mk + 2].set(
+                jnp.maximum(old[:, mk + 2], bounds_ref[:, 1]))
+            write(r, new)
+
+    def spec(r, arena):
+        if arena.ndim == 1:
+            return pl.BlockSpec(
+                (page_rows,),
+                lambda t, tr, r=r: (jnp.maximum(tr[r, t], 0),))
+        return pl.BlockSpec(
+            (page_rows, arena.shape[1]),
+            lambda t, tr, r=r: (jnp.maximum(tr[r, t], 0), 0))
+
+    arena_specs = [spec(r, a) for r, a in enumerate(arenas)]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(p_pages,),
+        in_specs=[
+            pl.BlockSpec((n,), lambda t, tr: (0,)),
+            pl.BlockSpec((3, n), lambda t, tr: (0, 0)),
+            *arena_specs,
+        ],
+        out_specs=list(arena_specs),
+        scratch_shapes=[
+            pltpu.VMEM((page_rows, f_total), jnp.float32),
+            pltpu.VMEM((page_rows, 2), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arenas],
+        # inputs are (tables, slots, vals, *arenas): arena i aliases out i
+        input_output_aliases={3 + i: i for i in range(n_roles)},
+        interpret=interpret,
+    )(tables, jnp.asarray(slots, jnp.int32), vals, *arenas)
+    return tuple(out)

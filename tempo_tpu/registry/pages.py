@@ -49,7 +49,7 @@ import numpy as np
 
 _LOG = logging.getLogger("tempo_tpu.pages")
 
-_DTYPE_BYTES = {"float32": 4, "int32": 4}
+_DTYPE_BYTES = {"float32": 4, "int32": 4, "bfloat16": 2}
 
 
 @dataclasses.dataclass
@@ -127,7 +127,14 @@ class _Arena:
             data = jax.device_put(
                 data, NamedSharding(pool.mesh.registry_mesh, spec))
         self.data = data
-        self.free: list[int] = list(range(self.n_pages - 1, -1, -1))
+        # physical page 0 is RESERVED as the trash page: the Pallas
+        # fused kernel's data-dependent BlockSpec index maps must name a
+        # real block for unbacked logical pages, and redirecting them to
+        # a page no tenant can ever own (written back unchanged, so it
+        # stays zero) keeps the dense "-1 drops" semantics without a
+        # host-side filter. The XLA kernels never see it: page tables
+        # only hold allocated ids (all ≥ 1) or -1.
+        self.free: list[int] = list(range(self.n_pages - 1, 0, -1))
         self.owners: list[str | None] = [None] * self.n_pages
 
     @property
@@ -167,7 +174,9 @@ class PagePool:
             sm = None
         self.mesh = sm
         shards = sm.series_shards if sm is not None else 1
-        pages = -(-cfg.arena_slots // cfg.page_rows)  # ceil
+        # +1: physical page 0 is the reserved trash page (see _Arena) —
+        # `arena_slots` keeps meaning USABLE rows per plane role
+        pages = -(-cfg.arena_slots // cfg.page_rows) + 1
         if pages % shards:
             pages += shards - pages % shards  # page-aligned shard ranges
         self._arena_pages = pages
@@ -217,8 +226,10 @@ class PagePool:
     # -- accounting --------------------------------------------------------
 
     def total_pages(self) -> int:
+        """USABLE pages across arenas (the reserved trash page of each
+        arena is not allocatable and not counted)."""
         with self.lock:
-            return sum(a.n_pages for a in self.arenas.values())
+            return sum(a.n_pages - 1 for a in self.arenas.values())
 
     def free_pages(self) -> int:
         with self.lock:
@@ -241,7 +252,8 @@ class PagePool:
         with self.lock:
             arenas = [{
                 "role": a.role, "dtype": a.dtype, "width": a.width,
-                "pages": a.n_pages, "free": len(a.free),
+                "pages": a.n_pages - 1, "reserved": 1,
+                "free": len(a.free),
                 "page_bytes": a.page_bytes,
                 "bytes": a.page_bytes * a.n_pages,
             } for a in self.arenas.values()]
@@ -500,9 +512,10 @@ def _arena_rows(field):
 
 RUNTIME.gauge_func(
     "tempo_pages_total",
-    lambda: _arena_rows(lambda a: a.n_pages),
-    help="Device pages per arena kind (absent families when the page "
-         "pool is off)", labels=_ARENA_LABELS)
+    lambda: _arena_rows(lambda a: a.n_pages - 1),
+    help="Usable device pages per arena kind (absent families when the "
+         "page pool is off; excludes each arena's reserved trash page)",
+    labels=_ARENA_LABELS)
 RUNTIME.gauge_func(
     "tempo_pages_free",
     lambda: _arena_rows(lambda a: len(a.free)),

@@ -151,7 +151,10 @@ class _MetricBase:
 
 
 class Counter(_MetricBase):
-    def __init__(self, registry, name, label_names, capacity):
+    def __init__(self, registry, name, label_names, capacity,
+                 compact: bool = False):
+        # `compact` is the paged-layout int32/bf16 state tier; the dense
+        # layout has no compact storage — PagedCounter honors it
         super().__init__(registry, name, label_names, capacity)
         self.state = m.counter_init(capacity)
 
@@ -231,7 +234,8 @@ class Histogram(_MetricBase):
     """Classic histogram family → `_count`/`_sum`/`_bucket{le=...}` series."""
 
     def __init__(self, registry, name, label_names, capacity,
-                 edges: tuple[float, ...] = DEFAULT_HISTOGRAM_EDGES):
+                 edges: tuple[float, ...] = DEFAULT_HISTOGRAM_EDGES,
+                 compact: bool = False):
         super().__init__(registry, name, label_names, capacity)
         self.state = m.histogram_init(capacity, edges)
 
@@ -377,9 +381,10 @@ class ManagedRegistry:
                     paged.PagedHistogram, paged.PagedNativeHistogram)
         return (Counter, Gauge, Histogram, NativeHistogram)
 
-    def new_counter(self, name: str, label_names: Sequence[str]) -> Counter:
+    def new_counter(self, name: str, label_names: Sequence[str],
+                    compact: bool = False) -> Counter:
         c = self._family_types()[0](self, name, label_names,
-                                    self._capacity_share())
+                                    self._capacity_share(), compact=compact)
         self._metrics[name] = c
         return c
 
@@ -390,9 +395,11 @@ class ManagedRegistry:
         return g
 
     def new_histogram(self, name: str, label_names: Sequence[str],
-                      edges: tuple[float, ...] = DEFAULT_HISTOGRAM_EDGES) -> Histogram:
+                      edges: tuple[float, ...] = DEFAULT_HISTOGRAM_EDGES,
+                      compact: bool = False) -> Histogram:
         h = self._family_types()[2](self, name, label_names,
-                                    self._capacity_share(), edges)
+                                    self._capacity_share(), edges,
+                                    compact=compact)
         self._metrics[name] = h
         return h
 

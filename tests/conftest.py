@@ -45,6 +45,12 @@ def _reset_device_scheduler():
     from tempo_tpu.registry import pages
 
     pages.reset()
+    # the pallas kernel-tier fallback warns ONCE per process per reason
+    # (the contract test_pallas_kernels.py::test_cpu_fallback_single_warning
+    # enforces); re-arm it so every test observes its own first warning
+    from tempo_tpu.ops import pages as ops_pages
+
+    ops_pages.reset_kernel_warnings()
     # the TraceQL quantile query tier follows the spanmetrics sketch
     # config at App build; reset so a moments-tier App doesn't leak
     # moment grids into later tests' evaluators

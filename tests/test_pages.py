@@ -441,7 +441,10 @@ def test_pool_status_and_obs_families_render():
         c.inc(["a"])
         st = pool.status()
         assert st["allocated_total"] == 1
-        assert st["arenas"][0]["pages"] == pool._arena_pages
+        # status reports USABLE pages: every arena reserves physical
+        # page 0 as the pallas kernel's trash page
+        assert st["arenas"][0]["pages"] == pool._arena_pages - 1
+        assert st["arenas"][0]["reserved"] == 1
         assert st["top_tenant_bytes"][0]["tenant"] == "t9"
         text = RUNTIME.render()
         assert "tempo_pages_free" in text

@@ -1,6 +1,14 @@
-"""Pallas MXU kernel vs XLA scatter: identical state deltas."""
+"""Pallas kernels vs XLA scatter: identical state deltas.
+
+Two families under test: the dense MXU one-hot kernel (historical
+template) and the paged ragged fused kernel (ISSUE 11) — the latter in
+interpreter mode on SMALL shapes only (interpret is pure Python and
+slow; these are the tier-1 parity + fallback-contract gates, the speed
+gates live in benchmarks/bench_kernels.py on a real TPU)."""
 
 from __future__ import annotations
+
+import logging
 
 import jax.numpy as jnp
 import numpy as np
@@ -34,3 +42,266 @@ def test_matmul_kernel_matches_scatter(seed):
     # masked rows contributed nothing
     total_w = w[slots >= 0].sum()
     np.testing.assert_allclose(float(jnp.sum(a[:, 0])), total_w, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# paged ragged fused kernel (interpret-mode smoke + fallback contract)
+# ---------------------------------------------------------------------------
+
+PAGE_ROWS = 8
+PAGE_SHIFT = 3
+N_PHYS = 6          # physical pages per arena, page 0 = trash
+DD_GAMMA = 1.1
+DD_MIN = 1e-6
+DD_NB = 32
+MOM_META = (4, float(np.log(1e-6)), float(np.log(1e5)))
+
+
+def _arenas(dd=True, mom=True):
+    rows = N_PHYS * PAGE_ROWS
+    n_hist = len(EDGES) + 1
+    out = [jnp.zeros(rows, jnp.float32) for _ in range(4)]
+    out.append(jnp.zeros((rows, n_hist), jnp.float32))
+    if dd:
+        out.append(jnp.zeros(rows, jnp.float32))
+        out.append(jnp.zeros((rows, DD_NB), jnp.float32))
+    if mom:
+        out.append(jnp.zeros((rows, MOM_META[0] + 3), jnp.float32))
+    return tuple(out)
+
+
+def _tables(n_roles, lpages=4):
+    # logical pages 0..2 backed by phys 1..3 (page 0 reserved as trash),
+    # logical page 3 deliberately UNBACKED
+    t = np.full(lpages, -1, np.int32)
+    t[:3] = [1, 2, 3]
+    return tuple(jnp.asarray(t) for _ in range(n_roles))
+
+
+def _batch(seed, n=32, lpages=4):
+    rng = np.random.default_rng(seed)
+    cap = lpages * PAGE_ROWS
+    mat = np.empty((4, n), np.float32)
+    mat[0] = rng.integers(-1, cap, n)           # incl. discards
+    mat[1] = rng.lognormal(-3, 1.5, n)
+    mat[2] = rng.integers(100, 5000, n)
+    mat[3] = rng.integers(1, 4, n)              # integer HT weights
+    return mat
+
+
+@pytest.mark.parametrize("dd,mom", [(True, True), (True, False),
+                                    (False, True)])
+def test_paged_pallas_matches_composed_scatter(dd, mom):
+    from tempo_tpu.ops import pages as op
+
+    dd_rows = 2 * PAGE_ROWS if dd else 0     # strict prefix of the table
+    mom_rows = 3 * PAGE_ROWS if mom else 0
+    meta = dict(edges=EDGES, gamma=DD_GAMMA, min_value=DD_MIN,
+                dd_rows=dd_rows, page_shift=PAGE_SHIFT, packed=True,
+                mom_rows=mom_rows, mom_meta=MOM_META if mom else None)
+    xla = op.fused_step(**dict(meta, kernel="xla"))
+    pal = op.fused_step(**dict(meta, kernel="pallas", interpret=True))
+    n_roles = 5 + (2 if dd else 0) + (1 if mom else 0)
+    a_x, a_p = _arenas(dd, mom)[:n_roles], _arenas(dd, mom)[:n_roles]
+    tabs = _tables(n_roles)
+    for seed in range(3):
+        mat = _batch(seed)
+        a_x = xla(*a_x, *tabs, mat)
+        a_p = pal(*a_p, *tabs, mat)
+    for r, (x, p) in enumerate(zip(a_x, a_p)):
+        # integer-count planes bit-identical (integer weights); float
+        # sums to f32 reduction-order tolerance (module docstring)
+        if r in (1, 3) or (mom and r == n_roles - 1):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(p),
+                                       rtol=1e-5, atol=1e-6,
+                                       err_msg=f"role {r}")
+        else:
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(p),
+                                          err_msg=f"role {r}")
+        # the trash page and the never-allocated phys pages stayed zero
+        assert not np.asarray(p)[:PAGE_ROWS].any(), f"role {r} trash"
+        assert not np.asarray(p)[4 * PAGE_ROWS:].any(), f"role {r} free"
+
+
+def test_paged_pallas_vec_route_matches_packed():
+    from tempo_tpu.ops import pages as op
+
+    meta = dict(edges=EDGES, gamma=DD_GAMMA, min_value=DD_MIN,
+                dd_rows=2 * PAGE_ROWS, page_shift=PAGE_SHIFT,
+                mom_rows=0, mom_meta=None, kernel="pallas",
+                interpret=True)
+    packed = op.fused_step(**dict(meta, packed=True))
+    vec = op.fused_step(**dict(meta, packed=False))
+    a1, a2 = _arenas(True, False), _arenas(True, False)
+    tabs = _tables(7)
+    mat = _batch(7)
+    a1 = packed(*a1, *tabs, mat)
+    a2 = vec(*a2, *tabs, mat[0].astype(np.int32), mat[1], mat[2], mat[3])
+    for x, p in zip(a1, a2):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(p))
+
+
+def test_paged_pallas_unbacked_and_discards_drop():
+    from tempo_tpu.ops import pages as op
+
+    step = op.fused_step(edges=EDGES, gamma=DD_GAMMA, min_value=DD_MIN,
+                         dd_rows=0, page_shift=PAGE_SHIFT, packed=True,
+                         kernel="pallas", interpret=True)
+    arenas = _arenas(False, False)
+    tabs = _tables(5)
+    n = 16
+    mat = np.zeros((4, n), np.float32)
+    # half discards, half aimed at the UNBACKED logical page 3
+    mat[0, :8] = -1
+    mat[0, 8:] = 3 * PAGE_ROWS + np.arange(8)
+    mat[1] = 0.5
+    mat[2] = 100.0
+    mat[3] = 1.0
+    out = step(*arenas, *tabs, mat)
+    for r, a in enumerate(out):
+        assert not np.asarray(a).any(), f"role {r} should be untouched"
+
+
+def _paged_processor(kernel, interpret=False, tenant="t"):
+    from tempo_tpu.generator.processors.spanmetrics import (
+        SpanMetricsConfig, SpanMetricsProcessor)
+    from tempo_tpu.registry import pages as device_pages
+    from tempo_tpu.registry.registry import ManagedRegistry, RegistryOverrides
+
+    pool = device_pages.PagePool(device_pages.PagePoolConfig(
+        enabled=True, page_rows=16, arena_slots=512))
+    with device_pages.use(pool):
+        reg = ManagedRegistry(tenant,
+                              RegistryOverrides(max_active_series=64),
+                              now=lambda: 1000.0)
+        proc = SpanMetricsProcessor(reg, SpanMetricsConfig(
+            use_scheduler=False, sketch_max_series=32, sketch_rel_err=0.05,
+            kernel=kernel, pallas_interpret=interpret))
+    return reg, proc
+
+
+def test_cpu_fallback_single_warning(caplog):
+    """The per-PR fallback contract: selecting `kernel: pallas` on a
+    backend that cannot lower Mosaic falls back to the composed-scatter
+    path with EXACTLY ONE process-wide warning (re-armed per test by the
+    conftest reset), and dispatch behaves identically to `kernel: xla`."""
+    import jax
+
+    assert jax.default_backend() != "tpu"  # conftest pins CPU
+    with caplog.at_level(logging.WARNING, logger="tempo_tpu.pages"):
+        reg_a, proc_a = _paged_processor("pallas")
+        reg_b, proc_b = _paged_processor("pallas", tenant="t2")
+    warns = [r for r in caplog.records
+             if "pallas" in r.getMessage() and "falling back" in r.getMessage()]
+    assert len(warns) == 1, [r.getMessage() for r in warns]
+    assert proc_a._kernel_tier == "xla" and proc_b._kernel_tier == "xla"
+    # the devtime/coalescer label reflects the RESOLVED tier, so the
+    # cost model never attributes xla dispatches to a pallas regime
+    assert proc_a._sched_kernel == "spanmetrics_fused_update"
+
+    # and the resolved path is exactly the xla tier: same state bytes
+    from tempo_tpu.model.span_batch import SpanBatchBuilder
+    reg_x, proc_x = _paged_processor("xla", tenant="t3")
+    for reg, proc in ((reg_a, proc_a), (reg_x, proc_x)):
+        b = SpanBatchBuilder(reg.interner)
+        for i in range(5):
+            b.append(trace_id=bytes(16), span_id=bytes(8), name=f"op{i}",
+                     service="s", kind=2, status_code=0,
+                     start_unix_nano=10**18,
+                     end_unix_nano=10**18 + 10**7 * (i + 1))
+        proc.push_batch(b.build())
+    sa = sorted((s.name, s.labels, s.value) for s in reg_a.collect(1))
+    sx = sorted((s.name, s.labels, s.value) for s in reg_x.collect(1))
+    assert sa == sx
+
+
+def test_sched_route_pallas_parity_and_ledger_label():
+    """The sched-coalesced route on the pallas tier: merged windows ride
+    the same paged pallas step under the kernel-tier numerics contract
+    (counts bit-identical, float sums to f32 reduction-order tolerance),
+    and the devtime ledger keys the dispatches under the tier's OWN
+    kernel name so the cost model / WindowTuner never mixes regimes."""
+    import time
+
+    from tempo_tpu import sched
+    from tempo_tpu.model.span_batch import SpanBatchBuilder
+    from tempo_tpu.obs import devtime
+    from tempo_tpu.sched import DeviceScheduler, SchedConfig
+
+    def world(kernel):
+        from tempo_tpu.generator.processors.spanmetrics import (
+            SpanMetricsConfig, SpanMetricsProcessor)
+        from tempo_tpu.registry import pages as device_pages
+        from tempo_tpu.registry.registry import (ManagedRegistry,
+                                                 RegistryOverrides)
+
+        pool = device_pages.PagePool(device_pages.PagePoolConfig(
+            enabled=True, page_rows=16, arena_slots=512))
+        with device_pages.use(pool):
+            reg = ManagedRegistry("t", RegistryOverrides(max_active_series=64),
+                                  now=lambda: 1000.0)
+            proc = SpanMetricsProcessor(reg, SpanMetricsConfig(
+                use_scheduler=True, sketch_max_series=32,
+                sketch_rel_err=0.05, kernel=kernel,
+                pallas_interpret=(kernel == "pallas")))
+        return reg, proc
+
+    devtime.reset()
+    outs = {}
+    for kernel in ("pallas", "xla"):
+        sc = DeviceScheduler(SchedConfig(batch_window_ms=5.0),
+                             start_worker=True)
+        try:
+            with sched.use(sc):
+                reg, proc = world(kernel)
+                for i in range(3):
+                    b = SpanBatchBuilder(reg.interner)
+                    for j in range(9):
+                        b.append(trace_id=bytes(16), span_id=bytes(8),
+                                 name=f"op{(i + j) % 5}", service="s",
+                                 kind=2, status_code=0,
+                                 start_unix_nano=10**18,
+                                 end_unix_nano=10**18 + 10**6 * (j + 1))
+                    proc.push_batch(b.build())
+                sc.flush()
+                outs[kernel] = sorted((s.name, s.labels, s.value)
+                                      for s in reg.collect(1))
+        finally:
+            sc.stop()
+    # counts/buckets exact, float sums to the documented f32
+    # reduction-order tolerance (MXU tree order vs scatter sort order)
+    from test_plane_fuzz import _kt_compare
+    _kt_compare(outs["pallas"], outs["xla"], "sched route")
+    kernels = {k[0] for k in devtime.LEDGER.snapshot()}
+    assert "spanmetrics_fused_update_pallas" in kernels
+    assert "spanmetrics_fused_update" in kernels
+
+
+def test_resolve_kernel_matrix(caplog):
+    """Tier resolution: every unlowerable combination falls back to xla
+    (one warning each), the lowerable ones keep pallas."""
+    from tempo_tpu.ops import pages as op
+
+    with caplog.at_level(logging.WARNING, logger="tempo_tpu.pages"):
+        assert op.resolve_kernel("xla") == "xla"
+        assert op.resolve_kernel("pallas", paged=False) == "xla"
+        assert op.resolve_kernel("pallas", mesh_active=True) == "xla"
+        assert op.resolve_kernel("pallas") == "xla"          # CPU backend
+        assert op.resolve_kernel("pallas", interpret=True) == "pallas"
+    msgs = [r.getMessage() for r in caplog.records
+            if "falling back" in r.getMessage()]
+    assert len(msgs) == 3           # one per distinct reason
+    # repeated resolution stays silent (warn-once contract)
+    n = len(caplog.records)
+    op.resolve_kernel("pallas", mesh_active=True)
+    assert len(caplog.records) == n
+
+
+def test_interpret_tier_selected_on_cpu(caplog):
+    """`pallas_interpret` (the debug/CI parity knob) keeps the pallas
+    tier live on CPU — no fallback, no warning."""
+    with caplog.at_level(logging.WARNING, logger="tempo_tpu.pages"):
+        _, proc = _paged_processor("pallas", interpret=True)
+    assert proc._kernel_tier == "pallas"
+    assert proc._sched_kernel == "spanmetrics_fused_update_pallas"
+    assert not [r for r in caplog.records if "falling back" in r.getMessage()]
