@@ -2275,11 +2275,365 @@ def bench_paged_fused() -> dict:
     return out
 
 
+def _fleet_spawn(args: list[str], env: dict | None = None,
+                 wait_ready_s: float = 120.0):
+    from tempo_tpu.fleet.worker import spawn_worker
+    return spawn_worker(args, env=env, wait_ready_s=wait_ready_s,
+                        cwd=os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fleet_reap(procs) -> None:
+    from tempo_tpu.fleet.worker import reap_workers
+    reap_workers(procs)
+
+
+def bench_fleet() -> dict:
+    """Multi-host generator fleet (ISSUE 12): (a) single-process
+    checkpoint→restart→restore round-trips registry state bit-identically
+    through the object-store backend; (b) 2 real generator processes
+    under soak-style load — killing one mid-soak recovers reads/writes
+    with zero sketch-state loss (post-handoff collect()/quantile()
+    bit-identical for dd/count kinds vs an uninterrupted single-process
+    oracle) and the 2-process aggregate ingest beats one process."""
+    import socket
+    import urllib.request
+
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.fleet import STATS
+    from tempo_tpu.fleet import checkpoint as ck
+    from tempo_tpu.generator.generator import Generator
+    from tempo_tpu.generator.instance import GeneratorConfig
+    from tempo_tpu.overrides import Overrides
+    from tempo_tpu.overrides.limits import Limits
+
+    out: dict = {}
+    n_spans = 2048
+    payload = _make_otlp_payload(n_spans, seed=7)
+    # 12 names that split ~evenly across 2 members' token arcs (short
+    # sequential suffixes cluster under fnv1a — "fleet-t0..5" all landed
+    # on one member, making the two-process arm degenerate)
+    tenants = [f"fleet-tenant-{i:03d}" for i in range(12)]
+
+    def _limits() -> Limits:
+        lim = Limits()
+        lim.generator.processors = ("span-metrics",)
+        lim.generator.max_active_series = 2048
+        lim.generator.ingestion_time_range_slack_s = 0.0
+        lim.generator.collection_interval_s = 3600.0
+        lim.generator.sketch = "dd"      # integer grids: exact post-merge
+        return lim
+
+    def _mkgen(iid: str) -> Generator:
+        return Generator(GeneratorConfig(), instance_id=iid,
+                         overrides=Overrides(defaults=_limits()))
+
+    def _collect(gen: Generator, tenant: str) -> dict:
+        inst = gen.instance(tenant)
+        inst.drain()
+        return {(s.name, s.labels): s.value
+                for s in inst.registry.collect(ts_ms=1)
+                if not s.is_stale_marker}
+
+    # ---- (a) checkpoint → restart → restore through the backend ---------
+    with tempfile.TemporaryDirectory() as tmp:
+        be = LocalBackend(os.path.join(tmp, "store"))
+        g1 = _mkgen("bench-restart")
+        for t in tenants[:2]:
+            for _ in range(4):
+                g1.push_otlp(t, payload)
+        want = {t: _collect(g1, t) for t in tenants[:2]}
+        want_q = {t: g1.instance(t).processors["span-metrics"].quantile(0.99)
+                  for t in tenants[:2]}
+        b0, s0 = STATS["checkpoint_bytes"], STATS["checkpoint_seconds"]
+        t0 = time.time()
+        for t in tenants[:2]:
+            blob = ck.snapshot_instance(g1.instance(t))
+            ck.write_checkpoint(be, "fleet-checkpoints", t, blob,
+                                ck.checkpoint_name(time.time(), "bench"))
+        out["fleet_checkpoint_wall_s"] = round(time.time() - t0, 4)
+        out["fleet_checkpoint_bytes"] = STATS["checkpoint_bytes"] - b0
+        out["fleet_checkpoint_seconds"] = round(
+            STATS["checkpoint_seconds"] - s0, 4)
+        g2 = _mkgen("bench-restart")     # the "restarted" process
+        listed = ck.list_checkpoints(be, "fleet-checkpoints")
+        for t, names in listed.items():
+            for name in names:
+                ck.restore_instance(
+                    g2.instance(t),
+                    ck.read_checkpoint(be, "fleet-checkpoints", t, name))
+        roundtrip = all(_collect(g2, t) == want[t] for t in tenants[:2]) \
+            and all(g2.instance(t).processors["span-metrics"].quantile(0.99)
+                    == want_q[t] for t in tenants[:2])
+        out["fleet_restart_roundtrip_bitident"] = bool(roundtrip)
+
+    # ---- (b) 2-process fleet: throughput scale-out + kill mid-soak ------
+    procs: list = []
+    parent_kv = None
+    try:
+        kvp = _fleet_spawn(["--kv-only"])
+        procs.append(kvp)
+        kv_url = f"http://127.0.0.1:{kvp.ready['port']}"
+        ports = []
+        for _ in range(2):
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                ports.append(s.getsockname()[1])
+        tmp = tempfile.mkdtemp(prefix="bench-fleet-")
+        cfgs = []
+        for i, port in enumerate(ports):
+            path = os.path.join(tmp, f"member{i}.yaml")
+            with open(path, "w") as f:
+                f.write(f"""
+target: metrics-generator
+instance_id: member-{i}
+server: {{http_listen_port: {port}}}
+ring_kv_url: {kv_url}
+heartbeat_interval_s: 1.0
+heartbeat_timeout_s: 5.0
+usage_stats_enabled: false
+storage:
+  backend: local
+  local_path: {tmp}/blocks
+  wal_path: {tmp}/wal{i}
+fleet: {{enabled: true, rebalance_interval_s: 0.5}}
+distributor: {{generator_placement: tenant}}
+generator:
+  processors: [span-metrics]
+overrides_defaults:
+  generator:
+    processors: [span-metrics]
+    max_active_series: 2048
+    ingestion_time_range_slack_s: 0.0
+    collection_interval_s: 3600.0
+    sketch: dd
+""")
+            cfgs.append(path)
+        shared_store = LocalBackend(os.path.join(tmp, "blocks"))
+
+        member_a = _fleet_spawn(["--config", cfgs[0]])
+        procs.append(member_a)
+
+        from tempo_tpu.ring import Ring
+        from tempo_tpu.ring.kv import RemoteKVStore
+        from tempo_tpu.rpc import RemoteGeneratorClient
+        from tempo_tpu.fleet.placement import tenant_token
+        parent_kv = RemoteKVStore(kv_url, poll_interval_s=0.25)
+        ring = Ring(kv=parent_kv, key="generator", replication_factor=1,
+                    heartbeat_timeout_s=5.0)
+        clients: dict[str, RemoteGeneratorClient] = {}
+
+        def _owner_client(tenant: str):
+            inst = ring.owner_of(tenant_token(tenant))
+            if inst is None:
+                return None, None
+            cl = clients.get(inst.addr)
+            if cl is None:
+                cl = clients[inst.addr] = RemoteGeneratorClient(
+                    inst.addr, timeout_s=30.0)
+            return inst.id, cl
+
+        acked: dict[str, int] = {t: 0 for t in tenants}
+        attempted: dict[str, int] = {t: 0 for t in tenants}
+        ack_lock = threading.Lock()
+
+        def _push_loop(my_tenants: list[str], stop_at: float) -> int:
+            spans = 0
+            i = 0
+            while time.time() < stop_at:
+                t = my_tenants[i % len(my_tenants)]
+                i += 1
+                _iid, cl = _owner_client(t)
+                if cl is None:
+                    time.sleep(0.2)
+                    continue
+                with ack_lock:
+                    attempted[t] += 1
+                try:
+                    got = cl.push_otlp(t, payload)
+                except Exception:
+                    time.sleep(0.2)      # owner moving/dead: re-resolve
+                    continue
+                spans += got
+                with ack_lock:
+                    acked[t] += 1
+            return spans
+
+        def _arm(duration_s: float) -> float:
+            stop_at = time.time() + duration_s
+            half = len(tenants) // 2
+            halves = [tenants[:half], tenants[half:]]
+            got = [0, 0]
+            th = [threading.Thread(
+                target=lambda k=k: got.__setitem__(
+                    k, _push_loop(halves[k], stop_at)))
+                for k in range(2)]
+            t0 = time.time()
+            for t in th:
+                t.start()
+            for t in th:
+                t.join()
+            return sum(got) / (time.time() - t0)
+
+        # single-process arm: member A owns every tenant
+        single_sps = _arm(6.0)
+        out["fleet_single_proc_spans_per_sec"] = round(single_sps, 1)
+
+        # scale out: member B joins; wait for the ring to carry both
+        member_b = _fleet_spawn(["--config", cfgs[1]])
+        procs.append(member_b)
+        deadline = time.time() + 20
+        while time.time() < deadline and len(ring) < 2:
+            time.sleep(0.2)
+        # ring ids are "generator/<instance_id>" (App._iid)
+        owners = {t: _owner_client(t)[0] for t in tenants}
+        out["fleet_two_proc_owner_split"] = \
+            [sum(1 for o in owners.values()
+                 if o and o.endswith(f"member-{i}")) for i in (0, 1)]
+        time.sleep(1.5)                  # let handoffs of phase-1 state run
+        _arm(4.0)    # warmup: B's first pushes JIT-compile its push path
+        two_sps = _arm(6.0)
+        out["fleet_two_proc_spans_per_sec"] = round(two_sps, 1)
+        out["fleet_scaleout_x"] = round(two_sps / max(single_sps, 1e-9), 3)
+
+        # kill mid-soak: background pushers, SIGTERM one member that
+        # owns tenants, keep pushing — reads/writes must recover
+        victim_i = 1 if out["fleet_two_proc_owner_split"][1] else 0
+        victim = member_b if victim_i == 1 else member_a
+        survivor = member_a if victim_i == 1 else member_b
+        survivor_port = ports[0] if victim_i == 1 else ports[1]
+        stop_at = time.time() + 11.0
+        th = [threading.Thread(target=_push_loop,
+                               args=([t], stop_at)) for t in tenants]
+        for t in th:
+            t.start()
+        time.sleep(3.0)
+        victim.terminate()               # graceful: drains + checkpoints
+        victim.wait(timeout=30)
+        for t in th:
+            t.join()
+        # survivor converges: owns every tenant, consumed every blob
+        deadline = time.time() + 30
+        recovered = False
+        while time.time() < deadline:
+            held = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{survivor_port}/status",
+                timeout=10).read())["fleet"]
+            if held["held_tenants"] >= sum(1 for t in tenants if acked[t]) \
+                    and not ck.list_checkpoints(shared_store,
+                                                "fleet-checkpoints"):
+                recovered = True
+                break
+            time.sleep(0.5)
+        out["fleet_handoff_recovered"] = recovered
+
+        # zero-sketch-loss gate: survivor state vs uninterrupted oracle
+        oracle = _mkgen("bench-oracle")
+        pushed = {t: 0 for t in tenants}
+
+        def _oracle_at(t: str, n: int) -> dict:
+            while pushed[t] < n:
+                oracle.push_otlp(t, payload)
+                pushed[t] += 1
+            return _collect(oracle, t)
+
+        def _counts_match(got: dict, want: dict) -> bool:
+            return set(got) == set(want) and all(
+                got[k] == v for k, v in want.items()
+                if not k[0].endswith("_sum"))
+
+        count_ident = True
+        quant_ident = True
+        sum_max_rel = 0.0
+        for t in tenants:
+            if not acked[t]:
+                continue
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{survivor_port}"
+                f"/internal/generator/collect?ts_ms=1",
+                headers={"X-Scope-OrgID": t})
+            got_doc = json.loads(urllib.request.urlopen(
+                req, timeout=30).read())
+            got = {(s["name"], tuple(tuple(kv) for kv in s["labels"])):
+                   s["value"] for s in got_doc["samples"]}
+            # ack-loss window: a push the member committed whose HTTP
+            # response was then lost (timeout / SIGTERM teardown) counts
+            # in survivor state but not in acked — search the bounded
+            # [acked, attempted] range for the committed replay count so
+            # the bit-identity gate stays exact without flaking
+            want = _oracle_at(t, acked[t])
+            for n in range(acked[t] + 1, attempted[t] + 1):
+                if _counts_match(got, want):
+                    break
+                want = _oracle_at(t, n)
+            if set(got) != set(want):
+                count_ident = False
+                miss = sorted(set(want) - set(got))[:3]
+                extra = sorted(set(got) - set(want))[:3]
+                out.setdefault("fleet_count_mismatches", []).append(
+                    {"tenant": t, "missing_series": [str(k) for k in miss],
+                     "extra_series": [str(k) for k in extra]})
+                continue
+            for k, v in want.items():
+                if k[0].endswith("_sum"):
+                    rel = abs(got[k] - v) / max(abs(v), 1e-12)
+                    sum_max_rel = max(sum_max_rel, rel)
+                elif got[k] != v:
+                    count_ident = False
+                    mm = out.setdefault("fleet_count_mismatches", [])
+                    if len(mm) < 6:
+                        mm.append({"tenant": t, "series": str(k),
+                                   "got": got[k], "want": v})
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{survivor_port}"
+                f"/internal/generator/quantile?q=0.99",
+                headers={"X-Scope-OrgID": t})
+            qdoc = json.loads(urllib.request.urlopen(req, timeout=30).read())
+            got_q = {tuple(tuple(kv) for kv in e["labels"]): e["value"]
+                     for e in qdoc["quantiles"]}
+            want_q = oracle.instance(t).processors["span-metrics"] \
+                .quantile(0.99)
+            if got_q != want_q:
+                quant_ident = False
+        out["fleet_zero_loss_counts_bitident"] = count_ident
+        out["fleet_zero_loss_quantile_bitident"] = quant_ident
+        out["fleet_sum_max_rel"] = sum_max_rel
+        out["fleet_pushes_acked"] = sum(acked.values())
+        out["fleet_pushes_attempted"] = sum(attempted.values())
+    except Exception as e:               # partial results beat none
+        out["fleet_error"] = f"{type(e).__name__}: {e}"
+    finally:
+        if parent_kv is not None:
+            parent_kv.shutdown()
+        _fleet_reap(procs)
+
+    # the >=1.7x aggregate-ingest gate needs cores for 2 members + the
+    # pushing parent + the oracle; on a <4-core container the ratio is
+    # recorded but gates like the multichip stage: correctness only
+    # (the raw 1.7x target applies where the topology actually fits)
+    cores = os.cpu_count() or 1
+    out["fleet_host_cores"] = cores
+    out["fleet_scaleout_target_x"] = 1.7 if cores >= 4 else None
+    scale_ok = out["fleet_scaleout_target_x"] is None or \
+        out.get("fleet_scaleout_x", 0) >= out["fleet_scaleout_target_x"]
+    out["fleet_accept_ok"] = bool(
+        out.get("fleet_restart_roundtrip_bitident")
+        and out.get("fleet_handoff_recovered")
+        and out.get("fleet_zero_loss_counts_bitident")
+        and out.get("fleet_zero_loss_quantile_bitident")
+        # sums are f32-add-order class, not bit-exact — but a merge bug
+        # that double-adds or drops _sum rows (counts unaffected) shows
+        # up here, so zero-loss must gate it too (observed ~2.5e-7)
+        and out.get("fleet_sum_max_rel", 1.0) <= 1e-5
+        and scale_ok)
+    return out
+
+
 STAGES = {"e2e": bench_e2e_ingest, "kernel": bench_kernel,
           "query": bench_query, "obs": bench_obs, "sched": bench_sched,
           "saturation": bench_saturation, "multichip": bench_multichip,
           "pages": bench_pages, "moments": bench_moments,
-          "paged_fused": bench_paged_fused, "soak": bench_soak}
+          "paged_fused": bench_paged_fused, "soak": bench_soak,
+          "fleet": bench_fleet}
 
 
 def _cpu_env(env: dict) -> dict:
@@ -2631,6 +2985,27 @@ def main() -> int:
         "paged_fused_steady_state_compiles": results.get(
             "paged_fused_steady_state_compiles"),
         "paged_fused_accept_ok": results.get("paged_fused_accept_ok"),
+        # generator fleet (ISSUE 12): restart round-trip, 2-process
+        # scale-out, kill-one-mid-soak recovery with zero sketch loss
+        "fleet_restart_roundtrip_bitident": results.get(
+            "fleet_restart_roundtrip_bitident"),
+        "fleet_checkpoint_bytes": results.get("fleet_checkpoint_bytes"),
+        "fleet_checkpoint_seconds": results.get("fleet_checkpoint_seconds"),
+        "fleet_single_proc_spans_per_sec": results.get(
+            "fleet_single_proc_spans_per_sec"),
+        "fleet_two_proc_spans_per_sec": results.get(
+            "fleet_two_proc_spans_per_sec"),
+        "fleet_scaleout_x": results.get("fleet_scaleout_x"),
+        "fleet_two_proc_owner_split": results.get(
+            "fleet_two_proc_owner_split"),
+        "fleet_handoff_recovered": results.get("fleet_handoff_recovered"),
+        "fleet_zero_loss_counts_bitident": results.get(
+            "fleet_zero_loss_counts_bitident"),
+        "fleet_zero_loss_quantile_bitident": results.get(
+            "fleet_zero_loss_quantile_bitident"),
+        "fleet_sum_max_rel": results.get("fleet_sum_max_rel"),
+        "fleet_error": results.get("fleet_error"),
+        "fleet_accept_ok": results.get("fleet_accept_ok"),
     }
     if errors:
         extra["errors"] = errors

@@ -394,6 +394,37 @@ def dashboards() -> dict[str, dict]:
                 p("Tuning active (1 = cost model driving windows)",
                   "tempo_sched_tuning_active", kind="stat"),
             ]),
+        "tempo-tpu-fleet.json": dash(
+            "Tempo-TPU / Generator fleet",
+            "Multi-host generator fleet (tempo_tpu.fleet): ring"
+            " membership, tenant placement balance, and the"
+            " checkpoint/restore handoff protocol (runbook: 'Operating"
+            " a generator fleet')",
+            [
+                p("Ring members", "tempo_ring_members",
+                  legend="{{ring}}"),
+                p("Ownership fraction by instance (generator ring)",
+                  'tempo_ring_ownership_ratio{ring="generator"}',
+                  unit="percentunit", legend="{{instance}}"),
+                p("Oldest member heartbeat age (s)",
+                  "tempo_ring_member_heartbeat_age_seconds",
+                  legend="{{ring}}"),
+                p("Checkpoints /h (handoffs + shutdown snapshots)",
+                  _rate("tempo_fleet_checkpoints_total", win="1h")),
+                p("Checkpoint MB/s written",
+                  "sum(rate(tempo_fleet_checkpoint_bytes_total[5m]))"
+                  " / 1e6"),
+                p("Checkpoint wall s/s (drain+gather+encode+write)",
+                  _rate("tempo_fleet_checkpoint_seconds_total")),
+                p("Restores /h (boot + handoff receives)",
+                  _rate("tempo_fleet_checkpoint_restores_total",
+                        win="1h")),
+                p("Handoffs /h (tenants moved off this process)",
+                  _rate("tempo_fleet_handoffs_total", win="1h")),
+                p("Generator spans /s by tenant (placement view)",
+                  _rate("tempo_metrics_generator_spans_received_total",
+                        "tenant"), legend="{{tenant}}"),
+            ]),
     }
 
 

@@ -285,6 +285,12 @@ class Handler(BaseHTTPRequestHandler):
         self._reply_retry(429, getattr(e, "retry_after_s", 1.0))
 
     def _push(self, tenant: str) -> None:
+        if self.app.distributor is None:
+            # e.g. a metrics-generator fleet member: spans arrive over
+            # the RPC plane (/internal/generator/*) from a distributor
+            # process, not the public OTLP surface
+            return self._err(404, "no distributor module in target "
+                                  f"{self.app.cfg.target!r}")
         body = self._ingest_body()
         if body is None:
             return
@@ -456,6 +462,41 @@ class Handler(BaseHTTPRequestHandler):
             return self._reply(200, _json_bytes(
                 {"tagValues": self.app.ingester.tag_values(
                     tenant, q["name"], int(q.get("limit", 1000)))}))
+        if path == "/internal/generator/collect":
+            # fleet verification surface: this member's registry samples
+            # for one tenant at a caller-fixed timestamp (harnesses
+            # compare members' post-handoff state against an oracle).
+            # peek (never create — a fresh empty instance would
+            # resurrect a just-handed-off tenant) + the try_track fence
+            # so a concurrent handoff can't release the pages mid-gather
+            gen = self.app.generator
+            inst = None if gen is None else gen.peek_instance(tenant)
+            if inst is None or not inst.try_track():
+                return self._reply(200, _json_bytes({"samples": []}))
+            try:
+                # drain barrier only (no remote-write side effect):
+                # queued device batches must land in the collected state
+                inst.drain()
+                samples = inst.registry.collect(ts_ms=int(q.get("ts_ms", 0)))
+            finally:
+                inst.untrack()
+            return self._reply(200, _json_bytes({"samples": [
+                {"name": s.name, "labels": list(s.labels), "value": s.value}
+                for s in samples if not s.is_stale_marker]}))
+        if path == "/internal/generator/quantile":
+            gen = self.app.generator
+            inst = None if gen is None else gen.peek_instance(tenant)
+            if inst is None or not inst.try_track():
+                return self._reply(200, _json_bytes({"quantiles": []}))
+            try:
+                proc = inst.processors.get("span-metrics")
+                if proc is None:
+                    return self._reply(200, _json_bytes({"quantiles": []}))
+                got = proc.quantile(float(q.get("q", 0.99)))
+            finally:
+                inst.untrack()
+            return self._reply(200, _json_bytes({"quantiles": [
+                {"labels": list(k), "value": v} for k, v in got.items()]}))
         self._err(404, f"unknown internal path {path}")
 
     def _trace_by_id(self, tenant: str, hexid: str,
@@ -617,8 +658,36 @@ class Handler(BaseHTTPRequestHandler):
             # paged and dense — also tempo_registry_state_bytes on
             # /metrics
             "registry_state_bytes": self._registry_state_status(),
+            # ring membership views this process holds (runbook
+            # "Operating a generator fleet"): per-member health,
+            # ownership fraction, heartbeat age
+            "rings": self._rings_status(),
+            # fleet controller state (None = fleet mode off)
+            "fleet": self._fleet_status(),
         }
         self._reply(200, _json_bytes(body))
+
+    def _rings_status(self) -> dict:
+        out = {}
+        for name, ring in getattr(self.app, "rings", {}).items():
+            own = ring.ownership()
+            out[name] = {
+                "members": [
+                    {"id": i.id, "addr": i.addr, "state": i.state,
+                     "healthy": ring.healthy(i),
+                     "heartbeat_age_s":
+                         round(max(0.0, ring.now() - i.heartbeat_ts), 3)
+                         if i.heartbeat_ts > 0 else None,
+                     "ownership_ratio": round(own.get(i.id, 0.0), 4)}
+                    for i in ring.instances()],
+                "oldest_heartbeat_age_s":
+                    round(ring.oldest_heartbeat_age(), 3),
+            }
+        return out
+
+    def _fleet_status(self) -> "dict | None":
+        fc = getattr(self.app, "fleet", None)
+        return None if fc is None else fc.status()
 
     def _pages_status(self) -> "dict | None":
         from tempo_tpu.registry import pages

@@ -117,6 +117,11 @@ class App:
         # reads merge, convergence via heartbeat republish)
         from tempo_tpu.ring.kv import make_kv
         self.kv, self.kv_host = make_kv(self.cfg.ring_kv_url)
+        # named ring views this process holds (ingester/generator/...),
+        # tracked for the /status rings block and the tempo_ring_*
+        # gauges — populated as modules wire up
+        self.rings: dict[str, Ring] = {}
+        self.fleet = None
         # ONE obs registry per App: every module registers its families
         # here and /metrics renders it (plus the process-wide JAX runtime
         # registry) — the single source of truth for self-telemetry
@@ -169,6 +174,29 @@ class App:
             "tempo_self_tracer_dropped_spans_total", tracer_dropped,
             help="Self-tracing spans lost to buffer overflow or failed "
                  "OTLP exports (silent span loss is an alerting signal)")
+        # ring membership/placement families (fleet satellite): rows
+        # appear as rings wire up; the families are registered eagerly
+        # so the dashboards/alerts drift gate always sees the names
+        self.obs.gauge_func(
+            "tempo_ring_members",
+            lambda: [((n,), float(len(r))) for n, r in self.rings.items()],
+            help="Registered instances per ring this process watches",
+            labels=("ring",))
+        self.obs.gauge_func(
+            "tempo_ring_ownership_ratio",
+            lambda: [((n, iid), frac) for n, r in self.rings.items()
+                     for iid, frac in r.ownership().items()],
+            help="Fraction of the token space each instance owns (RF1 "
+                 "placement share; a balanced N-member ring reads ~1/N)",
+            labels=("ring", "instance"))
+        self.obs.gauge_func(
+            "tempo_ring_member_heartbeat_age_seconds",
+            lambda: [((n,), r.oldest_heartbeat_age())
+                     for n, r in self.rings.items()],
+            help="Age of the STALEST active member heartbeat per ring — "
+                 "the TempoRingMemberStale signal (0 = empty ring or "
+                 "heartbeats disabled)",
+            labels=("ring",))
         # the serving-surface histograms are registered eagerly so the
         # drift gate sees them before any request arrives; the HTTP
         # handler and gRPC server observe through these App handles (one
@@ -363,6 +391,19 @@ class App:
                                    instance_id=iid, registry=self.obs,
                                    now=self.now)
         self._join_ring("generator", iid)
+        if self.cfg.fleet.enabled:
+            # the fleet controller's own view of the generator ring:
+            # membership changes (and heartbeat expiry) drive the
+            # drain/checkpoint/restore protocol against the backend
+            from tempo_tpu.backend import raw
+            from tempo_tpu.fleet.controller import FleetController
+            # keep the checkpoint prefix out of store-side tenant
+            # enumeration (a poller would otherwise index it as a tenant)
+            raw.RESERVED_ROOTS.add(self.cfg.fleet.checkpoint_prefix)
+            fring = self._shared_ring("generator", 1)
+            self.fleet = FleetController(
+                self.generator, fring, iid, self.backend, self.backend,
+                cfg=self.cfg.fleet, now=self.now)
 
     def _peer_clients(self, kind: str):
         """Remote peers from static config → (clients, populated ring).
@@ -379,12 +420,27 @@ class App:
         for iid, url in addrs.items():
             ring.register(InstanceDesc(id=iid, addr=url, state=ACTIVE,
                                        tokens=_instance_tokens(iid, 128)))
+        self._track_ring(kind.rstrip("s"), ring)
         return clients, ring
 
+    def _track_ring(self, name: str, ring: Ring) -> Ring:
+        """Record a ring view for /status + the tempo_ring_* gauges
+        (first view per name wins — they share the same KV state)."""
+        self.rings.setdefault(name, ring)
+        return ring
+
     def _shared_ring(self, key: str, rf: int) -> Ring:
-        return Ring(kv=self.kv, key=key, replication_factor=rf,
-                    heartbeat_timeout_s=self.cfg.heartbeat_timeout_s,
-                    now=self.now)
+        """ONE Ring view per KV key: fleet + distributor + querier all
+        watch the same membership, and each extra view would register
+        its own kv.watch_key and re-deserialize/re-sort the token state
+        on every heartbeat publish."""
+        got = self.rings.get(key)
+        if got is not None and got.kv is self.kv and got.rf == rf:
+            return got
+        return self._track_ring(key, Ring(
+            kv=self.kv, key=key, replication_factor=rf,
+            heartbeat_timeout_s=self.cfg.heartbeat_timeout_s,
+            now=self.now))
 
     def _init_distributor(self) -> None:
         if self.cfg.peers.ingesters:
@@ -395,9 +451,10 @@ class App:
             iring = self._shared_ring("ingester", self.cfg.distributor.rf)
             ing_clients = RingClientPool(iring, "ingesters")
         else:
-            iring = Ring(kv=self.kv, key="ingester",
-                         replication_factor=self.cfg.distributor.rf,
-                         now=self.now)
+            iring = self._track_ring("ingester", Ring(
+                kv=self.kv, key="ingester",
+                replication_factor=self.cfg.distributor.rf,
+                now=self.now))
             ing_clients = {self._iid("ingester"): self.ingester} \
                 if self.ingester else {}
         if self.cfg.peers.generators:
@@ -406,8 +463,9 @@ class App:
             gring = self._shared_ring("generator", 1)
             gen_clients = RingClientPool(gring, "generators")
         else:
-            gring = Ring(kv=self.kv, key="generator", replication_factor=1,
-                         now=self.now) if self.generator else None
+            gring = self._track_ring("generator", Ring(
+                kv=self.kv, key="generator", replication_factor=1,
+                now=self.now)) if self.generator else None
             gen_clients = ({self._iid("generator"): self.generator}
                            if self.generator else None)
         self.distributor = Distributor(
@@ -577,17 +635,13 @@ class App:
                 interval_s=self.cfg.usage_stats_interval_s, now=self.now)
             self.usage_reporter.set_stat("target", self.cfg.target)
             self.usage_reporter.start()
-        def heartbeat():
-            while not self._stop.wait(self.cfg.heartbeat_interval_s):
-                for lc in self._lifecyclers:
-                    try:
-                        lc.heartbeat()
-                    except Exception:
-                        # KV transiently unreachable: a missed beat is
-                        # recoverable, a dead heartbeat thread is not —
-                        # peers would mark this instance unhealthy forever
-                        pass
-        threading.Thread(target=heartbeat, daemon=True).start()
+        # each lifecycler heartbeats on its own jittered background loop
+        # (ring.Lifecycler.start_heartbeat); a failed publish is retried
+        # next beat — peers only mark us unhealthy after the timeout
+        for lc in self._lifecyclers:
+            lc.start_heartbeat(self.cfg.heartbeat_interval_s)
+        if self.fleet is not None:
+            self.fleet.start()
         self.ready = True
 
     def shutdown(self) -> None:
@@ -617,6 +671,10 @@ class App:
             self.distributor.forwarders.shutdown()  # drain queued tees
         if self.ingester:
             self.ingester.shutdown()
+        if self.fleet is not None:
+            # BEFORE generator shutdown: the drain + shutdown checkpoints
+            # must see the instances (restart-without-data-loss path)
+            self.fleet.shutdown()
         if self.generator:
             self.generator.shutdown()
         if self.frontend:

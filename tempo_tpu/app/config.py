@@ -17,6 +17,7 @@ import yaml
 from tempo_tpu.db.compactor import CompactorConfig
 from tempo_tpu.db.poller import PollerConfig
 from tempo_tpu.distributor.distributor import DistributorConfig
+from tempo_tpu.fleet import FleetConfig
 from tempo_tpu.frontend.frontend import FrontendConfig
 from tempo_tpu.generator.instance import GeneratorConfig
 from tempo_tpu.generator.processors.localblocks import LocalBlocksConfig
@@ -137,6 +138,11 @@ class Config:
     # DDSketch plane alone). Default off (dense layout); see runbook
     # "Sizing the page pool"
     pages: PagePoolConfig = dataclasses.field(default_factory=PagePoolConfig)
+    # generator fleet (tempo_tpu.fleet): N generator processes dividing
+    # the tenant space over the ring, with checkpoint/restore through
+    # the storage backend and live rebalancing on membership change.
+    # Default off; see runbook "Operating a generator fleet"
+    fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     overrides_defaults: Limits = dataclasses.field(default_factory=Limits)
     per_tenant_override_config: str = ""   # runtime-config file path
     compaction_interval_s: float = 30.0
@@ -248,6 +254,29 @@ class Config:
                 "state; see runbook 'Choosing the update kernel' for the "
                 "tier's documented tolerances")
         warnings.extend(self.mesh.check())
+        warnings.extend(self.fleet.check())
+        if self.distributor.generator_placement not in ("trace", "tenant"):
+            warnings.append(
+                f"distributor.generator_placement "
+                f"{self.distributor.generator_placement!r} unknown: use "
+                "'trace' (spans spread over the whole generator ring) or "
+                "'tenant' (a tenant's entire stream routes to its ring "
+                "owner — required for fleet mode) — serve time falls "
+                "back to 'trace'")
+        if self.fleet.enabled and self.server.http_listen_port == 0 \
+                and not self.instance_id:
+            warnings.append(
+                "fleet.enabled with an ephemeral http port needs an "
+                "explicit instance_id: the derived <target>-<host>-<port> "
+                "ring id would collide between two :0 members on one "
+                "host")
+        if self.fleet.enabled and \
+                self.distributor.generator_placement != "tenant":
+            warnings.append(
+                "fleet.enabled needs distributor.generator_placement: "
+                "'tenant' on every distributor: trace-spread routing "
+                "would scatter one tenant's series across members and "
+                "reads/checkpoints would each see a fraction")
         if self.pages.enabled:
             # only the series-table capacity must split into whole pages;
             # the spanmetrics sketch plane rounds ITSELF up to page

@@ -105,9 +105,17 @@ def block_keypath(block_id: str, tenant: str) -> KeyPath:
     return KeyPath.for_block(block_id, tenant)
 
 
+# top-level store directories that are NOT tenants: the fleet's
+# checkpoint prefix shares the backend root with tenant block dirs (a
+# custom fleet.checkpoint_prefix registers itself here at App build) —
+# without this filter every store poller would treat the prefix as a
+# tenant and index-builders would write into it
+RESERVED_ROOTS: set[str] = {"fleet-checkpoints"}
+
+
 def tenants(r: RawReader) -> list[str]:
     """Tenant enumeration = top-level listing (`tempodb/backend/backend.go` Tenants)."""
-    return r.list(KeyPath(()))
+    return [t for t in r.list(KeyPath(())) if t not in RESERVED_ROOTS]
 
 
 def blocks(r: RawReader, tenant: str) -> list[str]:

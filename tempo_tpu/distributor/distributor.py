@@ -50,6 +50,13 @@ class GeneratorClient(Protocol):
 class DistributorConfig:
     rf: int = 3
     generator_rf: int = 1            # generator forwarding is RF1
+    # generator-tee placement: "trace" spreads a tenant's spans over the
+    # whole generator ring by trace token (the single-logical-generator
+    # shape); "tenant" hashes the TENANT onto the ring so its entire
+    # stream lands on the owning member — the fleet topology
+    # (tempo_tpu.fleet), where each member holds complete per-tenant
+    # series/sketch state that can checkpoint and move
+    generator_placement: str = "trace"
     # per-tenant forwarder configs: {tenant: [{name, endpoint, filter}, ...]}
     # (`modules/distributor/forwarder` per-tenant tee)
     forwarders: dict = dataclasses.field(default_factory=dict)
@@ -491,13 +498,38 @@ class Distributor:
                 else:
                     client.push_otlp(tenant, payload_for(items))
 
-            try:
-                do_batch(self.generator_ring, tokens,
-                         list(range(n_traces)), send_gen,
-                         rf=self.cfg.generator_rf)
-            except RuntimeError:
-                self.metrics["push_failures_total"] += 1
+            self._send_generator_tee(tenant, tokens, n_traces, send_gen)
         return errs
+
+    def _send_generator_tee(self, tenant: str, tokens: np.ndarray,
+                            n_items: int, send_fn) -> None:
+        """Route one generator-tee batch; failures count, never raise.
+
+        Default placement ("trace"): per-trace tokens spread one tenant
+        over the whole ring via `do_batch`. Fleet mode ("tenant"): the
+        WHOLE batch goes to the tenant's single ring owner resolved with
+        `Ring.owner_of` — the same hash AND the same health-spillover
+        walk the fleet ownership watch uses, so routing and checkpoint
+        placement agree even while a member is dead-but-registered
+        (heartbeat expiry with no leave()): `do_batch`'s replica walk
+        does not skip unhealthy instances, which would black-hole the
+        dead member's tenants until its descriptor was removed."""
+        if self.cfg.generator_placement == "tenant":
+            from tempo_tpu.fleet.placement import tenant_token
+            inst = self.generator_ring.owner_of(tenant_token(tenant))
+            if inst is None:
+                self.metrics["push_failures_total"] += 1
+                return
+            try:
+                send_fn(inst, list(range(n_items)))
+            except Exception:   # best-effort tee: client/transport errors
+                self.metrics["push_failures_total"] += 1
+            return
+        try:
+            do_batch(self.generator_ring, tokens, list(range(n_items)),
+                     send_fn, rf=self.cfg.generator_rf)
+        except RuntimeError:
+            self.metrics["push_failures_total"] += 1
 
     # -- decode-once staged tee --------------------------------------------
 
@@ -722,11 +754,7 @@ class Distributor:
                                        view.row_indices().tolist()),
                     trusted=True)
 
-        try:
-            do_batch(self.generator_ring, tokens, list(range(n_traces)),
-                     send_gen, rf=self.cfg.generator_rf)
-        except RuntimeError:
-            self.metrics["push_failures_total"] += 1
+        self._send_generator_tee(tenant, tokens, n_traces, send_gen)
         return errs
 
     def _push_spans(self, tenant, spans, size_bytes, raw_otlp,
@@ -880,11 +908,7 @@ class Distributor:
             spans = [s for i in items for s in groups[i][1]]
             client.push_otlp(tenant, encode_spans_otlp(spans))
 
-        try:
-            do_batch(self.generator_ring, tokens, list(range(len(groups))),
-                     send, rf=self.cfg.generator_rf)
-        except RuntimeError:
-            self.metrics["push_failures_total"] += 1
+        self._send_generator_tee(tenant, tokens, len(groups), send)
 
     def _discard(self, reason: str, n: int) -> None:
         self.discarded[reason] = self.discarded.get(reason, 0) + n

@@ -1,0 +1,134 @@
+"""Multi-host generator fleet: N processes as ONE logical metrics-generator.
+
+The reference's "millions of users" topology (PAPER.md layer 1) is a
+fleet of generators dividing the tenant space over a dskit ring. This
+package is that topology for the device-state world:
+
+- **Placement** (`placement.py`): tenants hash onto the existing
+  generator `ring.Ring` (RF1 with spillover past unhealthy members);
+  the distributor routes a tenant's whole span stream to the owning
+  process, and a membership watch recomputes ownership on
+  join/leave/heartbeat-expiry.
+- **Checkpoint/restore** (`checkpoint.py`): a tenant's device state —
+  backed pages per plane role + page table + series-table interner +
+  sketch metadata — snapshots to the object-store backend as one small
+  mergeable blob (the paged layout made the snapshot cheap, the moments
+  tier made the merge an elementwise add). Restore rebuilds
+  `PageBacking` slots through the normal series-table allocation path
+  and scatter-MERGES rows (add for count planes, add+max for moments
+  bounds), guarded by the existing ValueError-raising sketch merge
+  checks.
+- **Drain/handoff** (`controller.py`): on ownership change the losing
+  process drains its sched queue for the tenant, checkpoints, and drops
+  the instance; the gaining process restores and merges any in-flight
+  deltas checkpointed during the transfer window. Shutdown checkpoints
+  + boot restores give single-host restart-without-data-loss for free.
+- **Worker** (`worker.py`): the process entry (`python -m
+  tempo_tpu.fleet.worker --config fleet.yaml`) plus a standalone /kv
+  CAS server for harnesses that outlive any fleet member.
+
+Only this module is imported by `app.config` — keep it free of jax and
+of the heavy siblings (lazy attribute exports below).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """The `fleet:` config block (generator targets only)."""
+
+    enabled: bool = False
+    # ownership re-check cadence: the membership watch fires on KV
+    # updates, but heartbeat EXPIRY is a clock event no KV write
+    # announces — the controller re-walks held tenants this often
+    rebalance_interval_s: float = 2.0
+    # snapshot every held tenant to the backend on shutdown (the
+    # restart-without-data-loss half of the protocol)
+    checkpoint_on_shutdown: bool = True
+    # consume checkpoints addressed to this member on boot and on
+    # ownership gain (restore + merge)
+    restore_on_boot: bool = True
+    # object-store prefix the checkpoint blobs live under
+    checkpoint_prefix: str = "fleet-checkpoints"
+
+    def check(self) -> list[str]:
+        problems = []
+        if self.rebalance_interval_s <= 0:
+            problems.append(
+                f"fleet.rebalance_interval_s ({self.rebalance_interval_s}) "
+                "must be > 0: the ownership watch would spin")
+        if not self.checkpoint_prefix or "/" in self.checkpoint_prefix:
+            problems.append(
+                f"fleet.checkpoint_prefix {self.checkpoint_prefix!r} must "
+                "be a single non-empty path segment")
+        return ["fleet: " + p for p in problems] if problems else []
+
+
+# ---------------------------------------------------------------------------
+# obs: fleet checkpoint families in the process-wide runtime registry
+# (registered here — the one module every deployment imports — so the
+# dashboards/alerts drift gate sees them even on non-fleet targets)
+# ---------------------------------------------------------------------------
+
+# mutated by checkpoint.py / controller.py under their own locks; plain
+# int/float adds are atomic enough for counters
+STATS = {
+    "checkpoint_bytes": 0,
+    "checkpoint_seconds": 0.0,
+    "checkpoints": 0,
+    "restores": 0,
+    "restore_merged_series": 0,
+    "restore_dropped_series": 0,
+    "handoffs": 0,
+}
+
+from tempo_tpu.obs.jaxruntime import RUNTIME  # noqa: E402
+
+RUNTIME.counter_func(
+    "tempo_fleet_checkpoint_bytes_total",
+    lambda: [((), float(STATS["checkpoint_bytes"]))],
+    help="Bytes of tenant device-state checkpoints written to the "
+         "object store (runbook 'Operating a generator fleet')")
+RUNTIME.counter_func(
+    "tempo_fleet_checkpoint_seconds_total",
+    lambda: [((), float(STATS["checkpoint_seconds"]))],
+    help="Wall seconds spent cutting tenant checkpoints (drain + "
+         "gather + encode + backend write)")
+RUNTIME.counter_func(
+    "tempo_fleet_checkpoints_total",
+    lambda: [((), float(STATS["checkpoints"]))],
+    help="Tenant checkpoints written (handoffs + shutdown snapshots)")
+RUNTIME.counter_func(
+    "tempo_fleet_checkpoint_restores_total",
+    lambda: [((), float(STATS["restores"]))],
+    help="Tenant checkpoints restored-and-merged into this process "
+         "(boot restores + handoff receives)")
+RUNTIME.counter_func(
+    "tempo_fleet_handoffs_total",
+    lambda: [((), float(STATS["handoffs"]))],
+    help="Tenants this process drained, checkpointed, and released "
+         "because ring ownership moved elsewhere")
+
+
+def __getattr__(name: str):
+    """Lazy exports: the heavy halves import jax/generator machinery."""
+    if name in ("TenantPlacement", "tenant_token"):
+        from tempo_tpu.fleet import placement
+        return getattr(placement, name)
+    if name in ("snapshot_instance", "restore_instance",
+                "CheckpointMismatch", "write_checkpoint",
+                "list_checkpoints", "read_checkpoint", "delete_checkpoint"):
+        from tempo_tpu.fleet import checkpoint
+        return getattr(checkpoint, name)
+    if name == "FleetController":
+        from tempo_tpu.fleet.controller import FleetController
+        return FleetController
+    raise AttributeError(name)
+
+
+__all__ = ["FleetConfig", "FleetController", "TenantPlacement", "STATS",
+           "tenant_token", "snapshot_instance", "restore_instance",
+           "CheckpointMismatch"]

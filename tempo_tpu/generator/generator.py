@@ -10,10 +10,13 @@ serve the frontend's recent-window metrics reads.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import threading
 import time
 from typing import Callable, Sequence
+
+import numpy as np
 
 from tempo_tpu.generator.instance import GeneratorConfig, GeneratorInstance
 from tempo_tpu.model.span_batch import SpanBatchBuilder
@@ -102,10 +105,106 @@ class Generator:
                     tenant, cfg, now=self.now)
             return inst
 
+    def tenants(self) -> list[str]:
+        """Tenants with a live instance in this process (fleet watch)."""
+        with self._lock:
+            return list(self.instances)
+
+    def peek_instance(self, tenant: str) -> "GeneratorInstance | None":
+        """The tenant's live instance, or None — never creates one (the
+        verification surfaces must not resurrect a just-handed-off
+        tenant as a fresh empty instance)."""
+        with self._lock:
+            return self.instances.get(tenant)
+
+    def pop_instance(self, tenant: str) -> "GeneratorInstance | None":
+        """Detach a tenant instance WITHOUT releasing its device state
+        (fleet handoff step 1: later pushes create a fresh instance
+        while the popped one is fenced + checkpointed; call
+        `release_instance_pages` once the snapshot is cut). Marks the
+        instance detached under its push lock so `_tracked_push` entries
+        that resolved it but have not yet registered in-flight re-route
+        to a fresh instance instead of scattering into the snapshot."""
+        with self._lock:
+            inst = self.instances.pop(tenant, None)
+        if inst is not None:
+            with inst._push_cv:
+                inst.detached = True
+        return inst
+
+    def reattach_instance(self, tenant: str,
+                          inst: "GeneratorInstance") -> bool:
+        """Undo `pop_instance` after a failed handoff checkpoint: put the
+        instance back and lift its detached fence — unless a straggler
+        push already built a replacement (then the caller must keep the
+        popped instance and retry its checkpoint out-of-band; two live
+        instances for one tenant would fork the series space). The
+        fence lifts only AFTER the instance is back in the map, so a
+        handler spinning in `_tracked_push` can never scatter into an
+        instance that stays detached."""
+        with self._lock:
+            if tenant in self.instances:
+                return False
+            self.instances[tenant] = inst
+        with inst._push_cv:
+            inst.detached = False
+            inst._push_cv.notify_all()
+        return True
+
+    @contextlib.contextmanager
+    def _tracked_push(self, tenant: str):
+        """Atomic instance-resolve + in-flight registration vs
+        `pop_instance`: without this, a handler thread could resolve the
+        instance, lose the CPU before entering `track_push`, and scatter
+        an acked push into an instance the fleet handoff already fenced
+        (`wait_pushes_idle` saw zero in-flight) and snapshotted — losing
+        the data and, for paged tenants, leaking freshly-allocated pages
+        into the detached backing. Detached instances are re-resolved;
+        the replacement accretes the push and is checkpointed by the
+        next fleet tick."""
+        while True:
+            inst = self.instance(tenant)
+            if inst.try_track():
+                break
+        try:
+            yield inst
+        finally:
+            inst.untrack()
+
+    def release_instance_pages(self, inst: "GeneratorInstance") -> None:
+        """Release a popped instance's device state. Dense planes are
+        per-instance garbage once unreferenced; paged tenants must
+        return their pages to the pool or the arena leaks the tenant
+        forever (pages are zeroed on free, so slot reuse starts clean)."""
+        if inst.registry.pages is None:
+            return
+        reg = inst.registry
+        with reg.state_lock:
+            seen: dict[int, object] = {}
+            for mt in reg._metrics.values():
+                seen[id(mt.table)] = mt.table
+            for table in seen.values():
+                if table.backing is None:
+                    continue
+                for plane, _limit in table.backing.planes:
+                    plane.free_lpages(np.flatnonzero(plane.page_map >= 0))
+
+    def remove_instance(self, tenant: str) -> "GeneratorInstance | None":
+        """pop + release in one step (shutdown/test convenience; the
+        fleet handoff uses the two halves around its checkpoint cut)."""
+        inst = self.pop_instance(tenant)
+        if inst is not None:
+            self.release_instance_pages(inst)
+        return inst
+
     # -- write (PushSpans RPC analog; the distributor's GeneratorClient) ---
 
     def push_spans(self, tenant: str, spans: Sequence[dict]) -> None:
-        inst = self.instance(tenant)
+        with self._tracked_push(tenant) as inst:
+            self._push_spans(inst, spans)
+
+    def _push_spans(self, inst: GeneratorInstance,
+                    spans: Sequence[dict]) -> None:
         b = SpanBatchBuilder(inst.registry.interner)
         for s in spans:
             b.append(
@@ -135,25 +234,26 @@ class Generator:
         wire input."""
         from tempo_tpu.model.otlp_batch import batch_from_otlp
 
-        inst = self.instance(tenant)
-        got = inst.push_otlp_staged(data, trusted=trusted)
-        if got is not None:
-            return got
-        need_span, need_res = inst.needs_attr_columns()
-        sb, sizes = batch_from_otlp(data, inst.registry.interner,
-                                    return_sizes=True,
-                                    include_span_attrs=need_span,
-                                    include_res_attrs=need_res,
-                                    trusted=trusted)
-        inst.push_batch(sb, span_sizes=sizes)
-        return sb.n
+        with self._tracked_push(tenant) as inst:
+            got = inst.push_otlp_staged(data, trusted=trusted)
+            if got is not None:
+                return got
+            need_span, need_res = inst.needs_attr_columns()
+            sb, sizes = batch_from_otlp(data, inst.registry.interner,
+                                        return_sizes=True,
+                                        include_span_attrs=need_span,
+                                        include_res_attrs=need_res,
+                                        trusted=trusted)
+            inst.push_batch(sb, span_sizes=sizes)
+            return sb.n
 
     def push_otlp_recs(self, tenant: str, raw: bytes, recs) -> int | None:
         """In-process distributor tee: scan records (any ring-sharded
         subset) + the ORIGINAL payload — no re-parse, no re-encode.
         Returns span count or None when this tenant needs the full
         staging path (caller sends payload bytes instead)."""
-        return self.instance(tenant).push_otlp_recs(raw, recs)
+        with self._tracked_push(tenant) as inst:
+            return inst.push_otlp_recs(raw, recs)
 
     # -- decode-once staged tee (distributor StagedIngest views) -----------
 
@@ -175,7 +275,8 @@ class Generator:
         decode-once staging (`model.otlp_batch.StagedView`). Returns the
         span count, or None when this instance cannot consume the view
         (foreign interner) — the caller falls back to payload bytes."""
-        return self.instance(tenant).push_staged_view(view)
+        with self._tracked_push(tenant) as inst:
+            return inst.push_staged_view(view)
 
     # -- reads (frontend generator_query_range hook) -----------------------
 
@@ -260,11 +361,24 @@ class Generator:
             insts = list(self.instances.values())
         total = 0
         for inst in insts:
-            if not inst.registry.overrides.disable_collection:
-                t0 = time.perf_counter()
-                total += inst.collect_and_push()
-                self.collect_duration.observe(time.perf_counter() - t0)
-            inst.tick()
+            # in-flight fence vs the fleet handoff: a detached instance is
+            # being (or was) checkpointed — collecting it after
+            # release_instance_pages gathers zeros through the unbacked
+            # page table and remote-writes spurious counter resets; the
+            # new owner republishes the restored values instead. Holding
+            # the track makes a concurrent pop_instance's
+            # wait_pushes_idle wait for this gather before the snapshot
+            # cut frees pages (a timed-out fence aborts + retries).
+            if not inst.try_track():
+                continue
+            try:
+                if not inst.registry.overrides.disable_collection:
+                    t0 = time.perf_counter()
+                    total += inst.collect_and_push()
+                    self.collect_duration.observe(time.perf_counter() - t0)
+                inst.tick()
+            finally:
+                inst.untrack()
         return total
 
     def start(self) -> None:

@@ -63,6 +63,57 @@ class GeneratorInstance:
         self.spans_received = 0
         self.spans_filtered_slack = 0
         self._last_purge = 0.0
+        # in-flight push tracking (fleet handoff barrier): a checkpoint
+        # cut must not race an acked-but-still-scattering push
+        self._pushes_inflight = 0
+        self._push_cv = threading.Condition()
+        # set under _push_cv by Generator.pop_instance: handler threads
+        # that resolved this instance but have not yet registered
+        # in-flight must re-resolve instead of scattering into a fenced
+        # snapshot
+        self.detached = False
+
+    def drain(self) -> None:
+        """The collection/snapshot barrier: flush the device scheduler
+        and every processor's ingest pipeline so all updates accepted
+        before this call are IN device state. Shared by the collection
+        tick, the fleet checkpoint cut, and the verification surfaces —
+        a drift between them silently breaks snapshot consistency."""
+        from tempo_tpu import sched
+        sched.flush()
+        # list(): an overrides reload may run update_processors while a
+        # collection tick or checkpoint cut drains
+        for proc in list(self.processors.values()):
+            fn = getattr(proc, "drain_pipeline", None)
+            if fn is not None:
+                fn()
+
+    def try_track(self) -> bool:
+        """Register an in-flight push/collect unless this instance is
+        detached (fleet handoff fence). A True return must be paired
+        with `untrack()`."""
+        with self._push_cv:
+            if self.detached:
+                return False
+            self._pushes_inflight += 1
+        return True
+
+    def untrack(self) -> None:
+        with self._push_cv:
+            self._pushes_inflight -= 1
+            self._push_cv.notify_all()
+
+    def wait_pushes_idle(self, timeout_s: float = 5.0) -> bool:
+        """Block until no push is mid-flight (bounded); the fleet
+        handoff fence between popping this instance and snapshotting."""
+        deadline = time.monotonic() + timeout_s
+        with self._push_cv:
+            while self._pushes_inflight > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._push_cv.wait(left)
+        return True
 
     # -- processor lifecycle (instance.go:207-385) -------------------------
 
@@ -227,18 +278,13 @@ class GeneratorInstance:
     def collect_and_push(self, ts_ms: int | None = None) -> int:
         """One collection: purge stale series, gather device state, remote
         write. Returns number of scalar samples pushed."""
-        # drain the device scheduler first: updates accepted before this
-        # tick must land in the collected state, and a stale-series purge
-        # must never zero a slot that still has a queued batch targeting
-        # it (slot reuse would misroute the update to a new series). The
-        # staging pipeline reaps its buffer ring behind the same barrier,
-        # so collected state is bit-identical to synchronous mode.
-        from tempo_tpu import sched
-        sched.flush()
-        for proc in list(self.processors.values()):
-            drain = getattr(proc, "drain_pipeline", None)
-            if drain is not None:
-                drain()
+        # drain first: updates accepted before this tick must land in
+        # the collected state, and a stale-series purge must never zero
+        # a slot that still has a queued batch targeting it (slot reuse
+        # would misroute the update to a new series). The staging
+        # pipeline reaps its buffer ring behind the same barrier, so
+        # collected state is bit-identical to synchronous mode.
+        self.drain()
         if self.now() - self._last_purge > 60.0:
             self.registry.purge_stale()
             self._last_purge = self.now()

@@ -987,6 +987,153 @@ class SpanMetricsProcessor:
         elif self.mom is not None:
             self.mom = moments.moments_zero_slots(self.mom, padded)
 
+    # -- fleet checkpoint/restore (tempo_tpu/fleet/checkpoint.py) ----------
+
+    def sketch_checkpoint(self, slots: np.ndarray) -> tuple[dict | None, dict]:
+        """(meta, rows) for the sketch sidecars of the given calls-table
+        slots — the movable half of a tenant checkpoint. `*_sel` arrays
+        index into `slots` (the sketch plane may cover a strict prefix
+        of the series table). Caller holds the registry state lock."""
+        meta: dict = {"tier": self._tier, "dd": None, "mom": None}
+        rows: dict[str, np.ndarray] = {}
+        if self._pdd is not None or self.dd is not None:
+            if self._pdd is not None:
+                ddc, ddz, gamma, minv, lim = self._pdd
+                nb = ddc.width
+            else:
+                gamma, minv = self.dd.gamma, self.dd.min_value
+                lim, nb = self.dd.counts.shape
+            sel = np.flatnonzero(slots < lim)
+            ss = slots[sel]
+            if self._pdd is not None:
+                padded = np.full(_pad_len(max(ss.size, 1)), -1, np.int32)
+                padded[:ss.size] = ss
+                counts = np.asarray(ddc.gather(padded))[:ss.size]
+                zeros = np.asarray(ddz.gather(padded))[:ss.size]
+            else:
+                counts = np.asarray(self.dd.counts)[ss]
+                zeros = np.asarray(self.dd.zeros)[ss]
+            meta["dd"] = {"gamma": float(gamma), "min_value": float(minv),
+                          "nb": int(nb)}
+            rows["dd_sel"] = sel.astype(np.int64)
+            rows["dd_counts"] = counts
+            rows["dd_zeros"] = zeros
+        if self._pmom is not None or self.mom is not None:
+            mk, mlo, mhi = self._mom_meta
+            lim = self._pmom[4] if self._pmom is not None \
+                else self.mom.data.shape[0]
+            sel = np.flatnonzero(slots < lim)
+            ss = slots[sel]
+            if self._pmom is not None:
+                padded = np.full(_pad_len(max(ss.size, 1)), -1, np.int32)
+                padded[:ss.size] = ss
+                mrows = np.asarray(self._pmom[0].gather(padded))[:ss.size]
+            else:
+                mrows = np.asarray(self.mom.data)[ss]
+            meta["mom"] = {"k": int(mk), "lo": float(mlo), "hi": float(mhi)}
+            rows["mom_sel"] = sel.astype(np.int64)
+            rows["mom_rows"] = mrows
+        if meta["dd"] is None and meta["mom"] is None:
+            return None, {}
+        return meta, rows
+
+    def sketch_meta_check(self, meta: dict) -> None:
+        """Validate a checkpoint's sketch metadata against this
+        instance's planes via the existing ValueError-raising merge
+        guards — called BEFORE any restore row is written."""
+        dd = meta.get("dd")
+        live_dd = self._pdd is not None or self.dd is not None
+        if (dd is not None) != live_dd:
+            raise ValueError(
+                f"fleet restore: dd-sketch tier mismatch (checkpoint "
+                f"{'has' if dd else 'lacks'} a DDSketch plane, live "
+                f"instance {'has' if live_dd else 'lacks'} one)")
+        if dd is not None:
+            if self._pdd is not None:
+                _, _, gamma, minv, _ = self._pdd
+                nb = self._pdd[0].width
+            else:
+                gamma, minv = self.dd.gamma, self.dd.min_value
+                nb = self.dd.counts.shape[1]
+            sketches._merge_check(
+                "fleet_restore/dd",
+                ("gamma", gamma, "min_value", minv),
+                ("gamma", dd["gamma"], "min_value", dd["min_value"]),
+                (int(nb),), (int(dd["nb"]),))
+        mom = meta.get("mom")
+        live_mom = self._pmom is not None or self.mom is not None
+        if (mom is not None) != live_mom:
+            raise ValueError(
+                f"fleet restore: moments tier mismatch (checkpoint "
+                f"{'has' if mom else 'lacks'} a moments plane, live "
+                f"instance {'has' if live_mom else 'lacks'} one)")
+        if mom is not None:
+            mk, mlo, mhi = self._mom_meta
+            probe = np.zeros((1, moments.n_cols(int(mom["k"]))), np.float32)
+            moments.merge_meta_check(
+                moments.MomentsSketch(
+                    data=np.zeros((1, moments.n_cols(mk)), np.float32),
+                    k=mk, lo=mlo, hi=mhi),
+                moments.MomentsSketch(data=probe, k=int(mom["k"]),
+                                      lo=float(mom["lo"]),
+                                      hi=float(mom["hi"])))
+
+    def sketch_restore(self, meta: dict, live_slots: np.ndarray,
+                       ok: np.ndarray, rows: dict) -> None:
+        """Merge checkpointed sketch rows into the live planes: ADD for
+        the DDSketch grid and the moments count+sums, MAX for the two
+        moments bound columns — exactly the cross-shard combine. Caller
+        holds the registry state lock; `sketch_meta_check` already ran."""
+        from tempo_tpu.fleet.checkpoint import _paged_phys
+        if meta.get("dd") is not None and "dd_sel" in rows:
+            sel = rows["dd_sel"].astype(np.int64)
+            keep = ok[sel]
+            ls = live_slots[sel][keep]
+            counts = rows["dd_counts"][keep]
+            zeros = rows["dd_zeros"][keep]
+            lim = self._pdd[4] if self._pdd is not None \
+                else self.dd.counts.shape[0]
+            within = ls < lim
+            ls, counts, zeros = ls[within], counts[within], zeros[within]
+            if ls.size:
+                if self._pdd is not None:
+                    ddc, ddz = self._pdd[0], self._pdd[1]
+                    phys = _paged_phys(ddc, ls)
+                    ddc.rebind(ddc.data.at[phys].add(
+                        counts.astype(ddc.data.dtype)))
+                    phys = _paged_phys(ddz, ls)
+                    ddz.rebind(ddz.data.at[phys].add(
+                        zeros.astype(ddz.data.dtype)))
+                else:
+                    self.dd = dataclasses.replace(
+                        self.dd,
+                        counts=self.dd.counts.at[ls].add(
+                            counts.astype(np.float32)),
+                        zeros=self.dd.zeros.at[ls].add(
+                            zeros.astype(np.float32)))
+        if meta.get("mom") is not None and "mom_sel" in rows:
+            mk = self._mom_meta[0]
+            sel = rows["mom_sel"].astype(np.int64)
+            keep = ok[sel]
+            ls = live_slots[sel][keep]
+            mrows = rows["mom_rows"][keep].astype(np.float32)
+            lim = self._pmom[4] if self._pmom is not None \
+                else self.mom.data.shape[0]
+            within = ls < lim
+            ls, mrows = ls[within], mrows[within]
+            if ls.size:
+                if self._pmom is not None:
+                    mp = self._pmom[0]
+                    phys = _paged_phys(mp, ls)
+                    data = mp.data.at[phys, :mk + 1].add(mrows[:, :mk + 1])
+                    mp.rebind(data.at[phys, mk + 1:].max(mrows[:, mk + 1:]))
+                else:
+                    data = self.mom.data.at[ls, :mk + 1].add(
+                        mrows[:, :mk + 1])
+                    self.mom = dataclasses.replace(
+                        self.mom,
+                        data=data.at[ls, mk + 1:].max(mrows[:, mk + 1:]))
+
     def device_state_bytes(self) -> int:
         """Device bytes of the processor-OWNED sketch sidecar (the
         registry families report their own); paged: backed pages only."""

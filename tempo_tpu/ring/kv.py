@@ -87,6 +87,16 @@ class KVStore:
 # Cross-process KV: HTTP CAS client with polling watches
 # ---------------------------------------------------------------------------
 
+# backoff cap: 32x the poll interval (a 1s poller degrades to one probe
+# every ~30s against a dead host), bounded to a minute outright
+_POLL_BACKOFF_MAX_FACTOR = 32
+
+
+def _poll_backoff(interval_s: float, fail_streak: int) -> float:
+    """Watch-poll wait for the current consecutive-failure streak."""
+    factor = min(2 ** min(fail_streak, 16), _POLL_BACKOFF_MAX_FACTOR)
+    return min(interval_s * factor, max(interval_s, 60.0))
+
 def _value_to_json(value: Any) -> Any:
     """Ring desc-maps (the KV's dominant payload) serialize explicitly;
     everything else must already be JSON-safe."""
@@ -189,22 +199,37 @@ class RemoteKVStore:
                 pass
 
     def _poll_loop(self) -> None:
-        while not self._stop.wait(self.poll_interval_s):
+        # exponential backoff on repeated fetch errors: a dead KV host
+        # must not burn a poll-interval of connect timeouts forever —
+        # the wait doubles per all-failed pass (capped) and snaps back
+        # to the configured interval on the first success
+        fail_streak = 0
+        while not self._stop.wait(_poll_backoff(self.poll_interval_s,
+                                                fail_streak)):
             with self._lock:
                 keys = list(self._watches)
+            ok = not keys       # an idle poller has nothing to fail at
             for k in keys:
                 try:
                     ver, val = self._fetch(k)
                 except Exception:
                     continue            # KV briefly unreachable: keep view
+                ok = True
                 if val is not None:
                     self._notify(k, val, ver)
+            fail_streak = 0 if ok else fail_streak + 1
 
     def delete(self, key: str) -> None:
         self._ep.delete(key)
 
-    def shutdown(self) -> None:
+    def shutdown(self, timeout_s: float = 2.0) -> None:
+        """Stop and JOIN the poller (bounded): embedded/test reuse must
+        not leak a watch thread per KV client instance."""
         self._stop.set()
+        t = self._poller
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=timeout_s)
+        self._poller = None
 
 
 # ---------------------------------------------------------------------------
@@ -345,10 +370,13 @@ class ReplicatedKVStore:
 
     # -- reads ---------------------------------------------------------------
 
-    def _fetch_merged(self, key: str) -> Any:
+    def _fetch_merged(self, key: str, raise_unreachable: bool = False) -> Any:
         got = self._fan_out(lambda ep: ep.fetch(key)[1])
-        return _merge_values([v for v in got
-                              if not isinstance(v, Exception)])
+        views = [v for v in got if not isinstance(v, Exception)]
+        if raise_unreachable and not views and got:
+            # every member errored (distinct from "key absent everywhere")
+            raise RuntimeError(f"no KV member reachable for {key!r}: {got[0]!r}")
+        return _merge_values(views)
 
     def get(self, key: str) -> Any:
         return self._fetch_merged(key)
@@ -461,19 +489,32 @@ class ReplicatedKVStore:
                 pass
 
     def _poll_loop(self) -> None:
-        while not self._stop.wait(self.poll_interval_s):
+        # same error backoff as RemoteKVStore: a pass where NO member was
+        # reachable doubles the wait (capped); any reachable member
+        # resets it — a minority of dead members never slows the watch
+        fail_streak = 0
+        while not self._stop.wait(_poll_backoff(self.poll_interval_s,
+                                                fail_streak)):
             with self._lock:
                 keys = list(self._watches)
+            ok = not keys
             for k in keys:
                 try:
-                    val = self._fetch_merged(k)
+                    val = self._fetch_merged(k, raise_unreachable=True)
                 except Exception:
                     continue
+                ok = True
                 if val is not None:
                     self._notify(k, val)
+            fail_streak = 0 if ok else fail_streak + 1
 
-    def shutdown(self) -> None:
+    def shutdown(self, timeout_s: float = 2.0) -> None:
+        """Stop, join the poller (bounded), release the member pool."""
         self._stop.set()
+        t = self._poller
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=timeout_s)
+        self._poller = None
         self._pool.shutdown(wait=False)
 
 

@@ -230,6 +230,37 @@ class Ring:
     def healthy_instances(self) -> list[InstanceDesc]:
         return [i for i in self.instances() if self.healthy(i)]
 
+    def ownership(self) -> dict[str, float]:
+        """Fraction of the uint32 token space each instance owns (RF1
+        view — the tenant/job-placement share). searchsorted(side=left)
+        maps a key to the first ring token >= it, so the arc
+        (prev_token, token] belongs to that token's registrant; the
+        wrap-around arc goes to the first token. Sums to 1.0 over a
+        non-empty ring."""
+        st = self._state
+        n = len(st.tokens)
+        if n == 0:
+            return {}
+        toks = st.tokens.astype(np.float64)
+        gaps = np.empty(n, np.float64)
+        gaps[1:] = np.diff(toks)
+        gaps[0] = toks[0] + (2.0 ** 32 - toks[-1])
+        out = {iid: 0.0 for iid in st.ids}
+        share = np.bincount(st.owners, weights=gaps, minlength=len(st.ids))
+        for idx, iid in enumerate(st.ids):
+            out[iid] = float(share[idx]) / 2.0 ** 32
+        return out
+
+    def oldest_heartbeat_age(self) -> float:
+        """Seconds since the stalest ACTIVE member's heartbeat (0.0 when
+        the ring is empty or no member has ever heartbeated) — the
+        /status + TempoRingMemberStale signal."""
+        beats = [i.heartbeat_ts for i in self.instances()
+                 if i.state == ACTIVE and i.heartbeat_ts > 0]
+        if not beats:
+            return 0.0
+        return max(0.0, self.now() - min(beats))
+
     def __len__(self) -> int:
         return len(self._state.instances)
 
@@ -316,6 +347,18 @@ class Ring:
             uniq, inverse = np.unique(pos, return_inverse=True)
         return [self._set_at(st, int(p), rf) for p in uniq], inverse
 
+    def owner_of(self, key: str | int) -> InstanceDesc | None:
+        """The single healthy owner of hash(key) (RF1 with spillover):
+        the clockwise walk skips UNHEALTHY instances, so a crashed
+        member's share fails over to the next live instance. None on an
+        empty/all-dead ring."""
+        st = self._state
+        token = key if isinstance(key, int) else _hash_str(str(key))
+        for inst in st.walk(token, len(st.instances) or 1):
+            if self.healthy(inst):
+                return inst
+        return None
+
     def owns(self, member_id: str, key: str | int) -> bool:
         """Ring-job ownership: does member_id own hash(key)?  The compactor
         pattern (`modules/compactor/compactor.go:190`): single owner = RF 1.
@@ -323,12 +366,8 @@ class Ring:
         Ownership walks past UNHEALTHY instances: a crashed peer's job
         share fails over to the next live instance instead of black-holing
         until the stale descriptor is removed."""
-        st = self._state
-        token = key if isinstance(key, int) else _hash_str(str(key))
-        for inst in st.walk(token, len(st.instances) or 1):
-            if self.healthy(inst):
-                return inst.id == member_id
-        return False
+        owner = self.owner_of(key)
+        return owner is not None and owner.id == member_id
 
     # -- shuffle sharding --------------------------------------------------
 
@@ -393,6 +432,8 @@ class Lifecycler:
             id=instance_id, addr=addr, zone=zone, state=JOINING,
             tokens=_instance_tokens(instance_id, n_tokens),
             heartbeat_ts=now(), registered_ts=now())
+        self._hb_stop = threading.Event()
+        self._hb_thread: threading.Thread | None = None
         self._publish()
         self.desc.state = ACTIVE
         self._publish()
@@ -408,7 +449,44 @@ class Lifecycler:
         self.desc.heartbeat_ts = self.now()
         self._publish()
 
+    # -- background heartbeat loop -----------------------------------------
+
+    def start_heartbeat(self, interval_s: float = 15.0,
+                        jitter: float = 0.2) -> None:
+        """Heartbeat on a background thread at `interval_s` ± jitter
+        (fractional, deterministic per instance id — a fleet started in
+        lockstep must not CAS-storm the KV on every beat). Idempotent;
+        `stop_heartbeat()` / `leave()` stops and joins it. A failed
+        publish (KV transiently unreachable) is retried next beat —
+        peers only mark this instance unhealthy after the full
+        heartbeat timeout."""
+        if self._hb_thread is not None and self._hb_thread.is_alive():
+            return
+        self._hb_stop.clear()
+        # spread instances across the interval without randomness in the
+        # loop: a per-instance phase offset in [-jitter, +jitter]
+        phase = ((_hash_str(self.id) % 1000) / 1000.0 * 2.0 - 1.0) * jitter
+        wait_s = max(0.05, interval_s * (1.0 + phase))
+
+        def loop() -> None:
+            while not self._hb_stop.wait(wait_s):
+                try:
+                    self.heartbeat()
+                except Exception:
+                    pass
+        self._hb_thread = threading.Thread(
+            target=loop, daemon=True, name=f"lifecycler-hb-{self.id}")
+        self._hb_thread.start()
+
+    def stop_heartbeat(self, timeout_s: float = 2.0) -> None:
+        self._hb_stop.set()
+        t = self._hb_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=timeout_s)
+        self._hb_thread = None
+
     def leave(self) -> None:
+        self.stop_heartbeat()
         self.desc.state = LEAVING
         self._publish()
         def update(cur):

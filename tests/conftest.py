@@ -119,6 +119,42 @@ def pytest_sessionfinish(session, exitstatus):
 
 
 # ---------------------------------------------------------------------------
+# fleet child processes — spawned AND reliably reaped (no orphans on
+# test failure; every fleet test stays under the 10s tier-1 guard)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def fleet_procs():
+    """Factory spawning `python -m tempo_tpu.fleet.worker ...` children.
+
+    `spawn(args, env=...)` blocks until the worker prints its JSON ready
+    line (or dies — surfaced with its stderr tail) and returns the
+    Popen with `.ready` (the parsed line) attached. EVERY spawned child
+    is reaped on teardown regardless of test outcome: SIGTERM, bounded
+    wait, SIGKILL fallback — a failing test must not leak generator
+    processes into the rest of the suite. The lifecycle itself lives in
+    `tempo_tpu.fleet.worker.{spawn_worker,reap_workers}`, shared with
+    bench.py."""
+    from tempo_tpu.fleet.worker import reap_workers, spawn_worker
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs: list = []
+
+    def spawn(args, env=None, wait_ready_s=60.0):
+        e = dict(env or {})
+        e.setdefault("JAX_PLATFORMS", "cpu")
+        p = spawn_worker(args, env=e, wait_ready_s=wait_ready_s,
+                         cwd=repo_root)
+        procs.append(p)
+        return p
+
+    yield spawn
+
+    reap_workers(procs, term_wait_s=8.0)
+
+
+# ---------------------------------------------------------------------------
 # fault injection — shared overload / retry-storm test helpers
 # ---------------------------------------------------------------------------
 

@@ -222,7 +222,9 @@ def test_ring_kv_cluster_survives_ingester_death(tmp_path):
         # --- kill one ingester ABRUPTLY (no graceful leave) ---
         victim = apps.pop("ing1")
         servers.pop("ing1").shutdown()
-        victim._stop.set()              # heartbeats stop; no lc.leave()
+        victim._stop.set()              # loops stop; no lc.leave()
+        for lc in victim._lifecyclers:  # heartbeat loops live on the
+            lc.stop_heartbeat()         # lifecyclers now — kill those too
 
         # writes still succeed immediately: quorum 2 of RF3
         assert push("22" * 16) == 200
@@ -330,6 +332,8 @@ def test_replicated_kv_survives_kv_host_death(tmp_path):
         victim = apps.pop("ing1")
         servers.pop("ing1").shutdown()
         victim._stop.set()
+        for lc in victim._lifecyclers:
+            lc.stop_heartbeat()         # abrupt death: no beats, no leave
 
         # KV writes (heartbeats) keep landing on the 2 surviving members,
         # so the membership view stays writable: pushes/reads work NOW

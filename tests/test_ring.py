@@ -140,3 +140,159 @@ def test_user_configurable_overrides_api_and_validation():
     uc.delete("t1")
     assert o.for_tenant("t1").generator.collection_interval_s == \
         Limits().generator.collection_interval_s
+
+
+# ---------------------------------------------------------------------------
+# rebalancing invariants (fleet PR: tenants place on the ring RF1)
+# ---------------------------------------------------------------------------
+
+
+def _owners(r, keys):
+    return {k: r.owner_of(k).id for k in keys}
+
+
+def test_minimal_ownership_movement_on_join():
+    """A joining instance steals ~1/N of the key space and NOTHING
+    moves between surviving instances (consistent hashing's whole
+    point); the stolen share is token-count bounded."""
+    r = make_ring(4, rf=1)
+    keys = [f"tenant-{i}" for i in range(1000)]
+    before = _owners(r, keys)
+    r.register(InstanceDesc(id="ing-new", addr="hostN",
+                            tokens=_instance_tokens("ing-new", 64),
+                            state=ACTIVE, heartbeat_ts=1000.0))
+    after = _owners(r, keys)
+    moved = [k for k in keys if before[k] != after[k]]
+    # every moved key moved TO the joiner, never between old members
+    assert all(after[k] == "ing-new" for k in moved)
+    # token-count bound: the joiner owns 64 of 320 tokens (1/5);
+    # allow 2x sampling slack, and demand it actually took a share
+    assert 0 < len(moved) <= 2 * len(keys) * 64 / 320
+
+
+def test_minimal_ownership_movement_on_leave():
+    """A leaving instance's keys redistribute; keys owned by survivors
+    do not move at all."""
+    r = make_ring(5, rf=1)
+    keys = [f"tenant-{i}" for i in range(1000)]
+    before = _owners(r, keys)
+    r.unregister("ing-2")
+    after = _owners(r, keys)
+    for k in keys:
+        if before[k] != "ing-2":
+            assert after[k] == before[k], k
+        else:
+            assert after[k] != "ing-2"
+    # ownership fractions stay a partition of the space
+    assert abs(sum(r.ownership().values()) - 1.0) < 1e-9
+
+
+def test_shuffle_shard_stable_across_heartbeat_refresh():
+    """Heartbeat-only KV republishes (same membership fingerprint) must
+    not reshuffle any tenant's sub-ring — a shard that wobbled per
+    heartbeat would smear tenant blast radius over the whole ring."""
+    clock = [1000.0]
+    r = make_ring(10, rf=2, now=lambda: clock[0])
+    ids1 = {i.id for i in r.shuffle_shard("tenant-a", 3).instances()}
+    # republish the SAME membership with fresh heartbeats, several times
+    for step in range(1, 4):
+        clock[0] = 1000.0 + step
+        m = {i.id: i for i in r.instances()}
+        for d in m.values():
+            d.heartbeat_ts = clock[0]
+        r._on_update(m)
+        ids = {i.id for i in r.shuffle_shard("tenant-a", 3).instances()}
+        assert ids == ids1, f"shard moved on heartbeat refresh #{step}"
+    # membership change DOES reshuffle state (sanity: not frozen forever)
+    r.register(InstanceDesc(id="ing-x", addr="hx",
+                            tokens=_instance_tokens("ing-x", 64),
+                            state=ACTIVE, heartbeat_ts=clock[0]))
+    assert len(r.shuffle_shard("tenant-a", 3).instances()) == 3
+
+
+def test_do_batch_quorum_accounting_persistent_failure():
+    """One instance that fails EVERY call: each batch still succeeds
+    (every item reaches quorum among the healthy replicas), the failure
+    is charged to the right items, and the dead instance never absorbs
+    an item's only copies."""
+    r = make_ring(5, rf=3)
+    delivered: dict[str, set] = {}
+
+    def send(inst, items):
+        if inst.id == "ing-3":
+            raise RuntimeError("persistently down")
+        delivered.setdefault(inst.id, set()).update(items)
+
+    tokens = (np.arange(200, dtype=np.uint64) * 21_000_003 % (2**32)) \
+        .astype(np.uint32)
+    for _round in range(3):
+        do_batch(r, tokens, list(range(200)), send)
+    # every item reached at least quorum (2 of rf=3) distinct live instances
+    for item in range(200):
+        holders = {iid for iid, got in delivered.items() if item in got}
+        assert len(holders) >= 2, item
+    assert "ing-3" not in delivered
+    # two persistent failures out of rf=3 breaks quorum for hit items
+    def send2(inst, items):
+        if inst.id in ("ing-3", "ing-4"):
+            raise RuntimeError("down")
+    hit = [t for t in tokens.tolist()
+           if {i.id for i in r.get(t).instances} >= {"ing-3", "ing-4"}]
+    if hit:
+        with pytest.raises(RuntimeError):
+            do_batch(r, np.array(hit[:1], np.uint32), ["x"], send2)
+
+
+def test_lifecycler_background_heartbeat_loop():
+    """start_heartbeat() keeps the KV descriptor fresh without manual
+    heartbeat() calls; leave() stops AND joins the loop thread."""
+    import time as _time
+
+    kv = KVStore()
+    lc = Lifecycler(kv, "gen-hb", n_tokens=8)
+    t0 = lc.desc.heartbeat_ts
+    lc.start_heartbeat(interval_s=0.05)
+    lc.start_heartbeat(interval_s=0.05)       # idempotent
+    deadline = _time.time() + 2.0
+    while _time.time() < deadline:
+        cur = kv.get(lc.key)["gen-hb"].heartbeat_ts
+        if cur > t0:
+            break
+        _time.sleep(0.02)
+    assert kv.get(lc.key)["gen-hb"].heartbeat_ts > t0
+    thread = lc._hb_thread
+    lc.leave()
+    assert lc._hb_thread is None
+    assert thread is not None and not thread.is_alive()
+    assert kv.get(lc.key) == {}
+
+
+def test_remote_kv_shutdown_joins_poller_and_backs_off():
+    """RemoteKVStore.shutdown() must JOIN its poll thread (no leaked
+    threads in embedded/test reuse), and the poll loop must back off
+    exponentially while every fetch errors."""
+    import threading as _threading
+    import time as _time
+
+    from tempo_tpu.ring.kv import RemoteKVStore, _poll_backoff
+
+    # backoff math: doubles per failed pass, capped
+    assert _poll_backoff(1.0, 0) == 1.0
+    assert _poll_backoff(1.0, 1) == 2.0
+    assert _poll_backoff(1.0, 5) == 32.0
+    assert _poll_backoff(1.0, 50) == 32.0     # factor cap
+    assert _poll_backoff(5.0, 50) == 60.0     # absolute cap
+
+    # point at a dead endpoint; the watch thread starts, errors, and
+    # shutdown still joins it promptly
+    kv = RemoteKVStore("http://127.0.0.1:1", poll_interval_s=0.01,
+                       timeout_s=0.05)
+    kv.watch_key("ring", lambda v: None)
+    poller = kv._poller
+    assert poller is not None and poller.is_alive()
+    _time.sleep(0.1)
+    kv.shutdown()
+    assert kv._poller is None
+    assert not poller.is_alive()
+    # no stray kv threads left behind
+    assert not any(t is poller for t in _threading.enumerate())
