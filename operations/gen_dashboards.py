@@ -166,6 +166,37 @@ def dashboards() -> dict[str, dict]:
                   _rate("tempo_moments_solve_cache_hits_total")),
                 p("Moments solve wall s/s",
                   _rate("tempo_moments_solve_seconds_total")),
+                # per-op response-cache split (the aggregate hit ratio
+                # above cannot say WHICH endpoint is cold)
+                p("Frontend cache hits /s by op",
+                  _rate("tempo_tpu_frontend_cache_hits_total", "op"),
+                  legend="{{op}}"),
+                p("Frontend cache misses /s by op",
+                  _rate("tempo_tpu_frontend_cache_misses_total", "op"),
+                  legend="{{op}}"),
+                # materialized query grids (runbook "Materialized query
+                # grids"): hit share is the dashboard-scale win; misses
+                # by reason say why a read recomputed instead
+                p("Matview reads /s by outcome",
+                  _rate("tempo_matview_reads_total", "result"),
+                  legend="{{result}}"),
+                p("Matview grids built / subscriptions",
+                  "tempo_matview_grids",
+                  "sum(tempo_matview_subscriptions)"),
+                p("Matview appends /s vs spans /s",
+                  _rate("tempo_matview_appends_total"),
+                  _rate("tempo_matview_append_spans_total")),
+                p("Matview staleness by tenant",
+                  "tempo_matview_staleness_seconds",
+                  legend="{{tenant}}"),
+                p("Matview rebuilds /s by cause",
+                  _rate("tempo_matview_rebuilds_total", "cause"),
+                  legend="{{cause}}"),
+                p("Matview dropped spans /s by reason",
+                  _rate("tempo_matview_dropped_spans_total", "reason"),
+                  legend="{{reason}}"),
+                p("Matview device state bytes",
+                  "tempo_matview_state_bytes"),
             ]),
         "tempo-tpu-writes.json": dash(
             "Tempo-TPU / Writes",

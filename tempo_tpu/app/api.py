@@ -233,6 +233,27 @@ class Handler(BaseHTTPRequestHandler):
             except (ValueError, KeyError, TypeError) as e:
                 return self._err(400, f"malformed otlp payload: {e}")
             return self._reply(200, _json_bytes({"spans": n_spans}))
+        if path in ("/internal/matview/subscribe",
+                    "/internal/matview/unsubscribe"):
+            # explicit materialized-view subscription API (runbook
+            # "Materialized query grids"); auto-subscription via qlog
+            # recurrence needs no call at all
+            if self.app.frontend is None:
+                return self._err(404, "no frontend on this target")
+            try:
+                d = json.loads(body or b"{}")
+                query = d["query"]
+                step_s = float(d.get("step_s", 60.0))
+            except (KeyError, ValueError, TypeError) as e:
+                return self._err(400, f"bad subscribe body: {e}")
+            if path.endswith("/subscribe"):
+                ok, why = self.app.frontend.subscribe_query(
+                    tenant, query, step_s)
+                code = 200 if ok else 400
+                return self._reply(code, _json_bytes(
+                    {"subscribed": ok, "reason": why}))
+            ok = self.app.frontend.unsubscribe_query(tenant, query, step_s)
+            return self._reply(200, _json_bytes({"unsubscribed": ok}))
         if path == "/internal/generator/query_range":
             from tempo_tpu.traceql.engine_metrics import QueryRangeRequest
             d = json.loads(body)
@@ -664,8 +685,16 @@ class Handler(BaseHTTPRequestHandler):
             "rings": self._rings_status(),
             # fleet controller state (None = fleet mode off)
             "fleet": self._fleet_status(),
+            # materialized query grids (runbook "Materialized query
+            # grids"): None = tier disabled
+            "matview": self._matview_status(),
         }
         self._reply(200, _json_bytes(body))
+
+    def _matview_status(self) -> "dict | None":
+        from tempo_tpu import matview
+        mv = matview.materializer()
+        return None if mv is None else mv.status()
 
     def _rings_status(self) -> dict:
         out = {}

@@ -241,6 +241,13 @@ class App:
         self._init_bus()
         if OVERRIDES in mods:
             self._init_overrides()
+        # the materialized-view tier is process-wide like sched/pages
+        # (generator appends + frontend reads share it); configured
+        # AFTER overrides so grid expiry can fingerprint tenant limits
+        from tempo_tpu import matview
+        self.matview = matview.configure(self.cfg.matview,
+                                         overrides=self.overrides,
+                                         now=self.now)
         if STORE in mods:
             self._init_store()
         if INGESTER in mods:

@@ -23,6 +23,7 @@ from tempo_tpu.generator.instance import GeneratorConfig
 from tempo_tpu.generator.processors.localblocks import LocalBlocksConfig
 from tempo_tpu.ingester.ingester import IngesterConfig
 from tempo_tpu.ingester.instance import InstanceConfig
+from tempo_tpu.matview import MatViewConfig
 from tempo_tpu.overrides.limits import Limits
 from tempo_tpu.parallel.serving import MeshConfig
 from tempo_tpu.querier.querier import QuerierConfig
@@ -138,6 +139,12 @@ class Config:
     # DDSketch plane alone). Default off (dense layout); see runbook
     # "Sizing the page pool"
     pages: PagePoolConfig = dataclasses.field(default_factory=PagePoolConfig)
+    # materialized query grids (tempo_tpu.matview): hot recurring
+    # TraceQL-metrics queries stream into standing device grids at
+    # ingest; reads become a grid slice + final pass instead of a
+    # block/registry recompute. Default on (no overhead until a query
+    # is subscribed); see runbook "Materialized query grids"
+    matview: MatViewConfig = dataclasses.field(default_factory=MatViewConfig)
     # generator fleet (tempo_tpu.fleet): N generator processes dividing
     # the tenant space over the ring, with checkpoint/restore through
     # the storage backend and live rebalancing on membership change.
@@ -253,6 +260,35 @@ class Config:
                 "layout (pages.enabled: true) — serve time stays on f32 "
                 "state; see runbook 'Choosing the update kernel' for the "
                 "tier's documented tolerances")
+        mvc = self.matview
+        if mvc.enabled:
+            if mvc.window_steps < 2:
+                warnings.append(
+                    "matview.window_steps < 2: a materialized grid needs "
+                    "at least two ring columns to advance")
+            if mvc.window_steps > 4096:
+                warnings.append(
+                    "matview.window_steps > 4096: each grid holds "
+                    "series x window_steps (x64 for bucket kinds) f32 "
+                    "cells in HBM — size the ring to the dashboard "
+                    "window, not the retention window")
+            if not (0 < mvc.min_step_s <= mvc.max_step_s):
+                warnings.append(
+                    "matview.min_step_s/max_step_s must satisfy "
+                    "0 < min <= max")
+            if mvc.max_staleness_s <= 0:
+                warnings.append(
+                    "matview.max_staleness_s must be > 0: every read "
+                    "would fall through to the recompute path")
+            if mvc.max_subscriptions < 1 or mvc.max_series < 1:
+                warnings.append(
+                    "matview.max_subscriptions and matview.max_series "
+                    "must be >= 1")
+            if mvc.auto_subscribe and mvc.auto_subscribe_after < 1:
+                warnings.append(
+                    "matview.auto_subscribe_after < 1 materializes every "
+                    "query on first sight — set >= 1 (recurrences within "
+                    "qlog's sliding window)")
         warnings.extend(self.mesh.check())
         warnings.extend(self.fleet.check())
         if self.distributor.generator_placement not in ("trace", "tenant"):

@@ -177,6 +177,10 @@ class Frontend:
         # requests rejected with 503 under device-scheduler query
         # backpressure, by op (rendered via a callback family below)
         self.shed_requests: dict[str, int] = {}
+        # per-op response-cache accounting (the aggregate cache_stats
+        # dict cannot say WHICH endpoint is cold): hits/misses counted
+        # at job-dispatch time in _run_jobs, keyed by endpoint op
+        self._cache_ops: dict[str, dict[str, int]] = {}
         self.obs = registry if registry is not None else Registry()
         self._register_obs(self.obs)
 
@@ -240,6 +244,24 @@ class Frontend:
                  "QueryStats.device_ns — the read-side twin of "
                  "tempo_devtime_tenant_device_seconds_total)",
             labels=("tenant",))
+
+        def cache_by_op(field):
+            def fn():
+                with self._tenant_read_lock:
+                    return [((op,), c.get(field, 0))
+                            for op, c in self._cache_ops.items()]
+            return fn
+
+        reg.counter_func(
+            "tempo_tpu_frontend_cache_hits_total", cache_by_op("hits"),
+            help="Search-response cache hits by endpoint op (per-op twin "
+                 "of tempo_query_frontend_cache_hits_total)",
+            labels=("op",))
+        reg.counter_func(
+            "tempo_tpu_frontend_cache_misses_total", cache_by_op("misses"),
+            help="Search-response cache misses by endpoint op (cacheable "
+                 "sub-requests that had to execute)",
+            labels=("op",))
 
         def shed():
             with self._tenant_read_lock:
@@ -330,11 +352,17 @@ class Frontend:
                 self.shed_requests[op] = self.shed_requests.get(op, 0) + 1
             raise sched.QueryBackpressure(sc.cfg.retry_after_s)
 
+    def _note_cache(self, op: str, hits: int = 0, misses: int = 0) -> None:
+        with self._tenant_read_lock:
+            c = self._cache_ops.setdefault(op, {})
+            c["hits"] = c.get("hits", 0) + hits
+            c["misses"] = c.get("misses", 0) + misses
+
     def _run_jobs(self, tenant: str, jobs: Sequence[SearchJob],
                   fn: Callable[[SearchJob], Any],
                   on_result: Callable[[Any], bool],
                   spec_fn: Callable[[SearchJob], dict] | None = None,
-                  cache: "tuple | None" = None) -> int:
+                  cache: "tuple | None" = None, op: str = "search") -> int:
         """Dispatch jobs; fold results via on_result (return False = early
         exit, like streaming combiners cancelling remaining work). Raises
         the first job error — a failed sub-query fails the whole query, as
@@ -356,16 +384,22 @@ class Frontend:
         hits: dict[int, Any] = {}
         pending: list[tuple[int, "_Job"]] = []
         wrapped: list = []
+        n_hit = n_miss = 0
         for idx, j in enumerate(jobs):
             key = key_fn(j) if key_fn else None
             raw = self._job_cache.get(key) if key is not None else None
             if raw is not None:
                 hits[idx] = decode(raw)
                 wrapped.append(None)
+                n_hit += 1
             else:
+                if key is not None:
+                    n_miss += 1       # cacheable but had to execute
                 wj = _Job(j, fn, spec_fn(j) if spec_fn else None)
                 wrapped.append(wj)
                 pending.append((idx, wj))
+        if n_hit or n_miss:
+            self._note_cache(op, hits=n_hit, misses=n_miss)
 
         nbytes = 0
 
@@ -443,7 +477,8 @@ class Frontend:
 
     def _finish_query(self, op: str, tenant: str, query: str,
                       duration_s: float, st: QueryStats,
-                      error: Exception | None = None) -> None:
+                      error: Exception | None = None,
+                      extra: dict | None = None) -> None:
         """Close out one frontend request: per-tenant read-cost counters
         and exactly one structured "query complete" log decision — called
         once per public endpoint invocation, success or failure."""
@@ -468,14 +503,16 @@ class Frontend:
         # slow line must be able to tell
         from tempo_tpu import sched
         keep = sched.ingest_keep_fraction()
+        merged = dict(extra or {})
+        if keep < 1.0:
+            merged["ingestKeepFraction"] = round(keep, 4)
         self.qlog.log_query(
             op=op, tenant=tenant, query=query,
             status="error" if error is not None else "ok",
             duration_s=duration_s, stats=st,
             trace_id=tracing.current_trace_id_hex(),
             error=str(error) if error is not None else None,
-            extra=({"ingestKeepFraction": round(keep, 4)}
-                   if keep < 1.0 else None))
+            extra=merged or None)
 
     def search(self, tenant: str, query: str, *, limit: int = 20,
                start_s: float | None = None, end_s: float | None = None,
@@ -574,7 +611,8 @@ class Frontend:
                     "query": query, "meta": j.meta.to_json(),
                     "row_groups": list(j.row_groups), "limit": limit,
                     "start_s": j.start_s, "end_s": j.end_s},
-                cache=(search_key, _encode_metadata, _decode_metadata))
+                cache=(search_key, _encode_metadata, _decode_metadata),
+                op="search")
         self._record_op("search", tenant, self.now() - t0, nbytes)
         return combiner.results()
 
@@ -612,6 +650,11 @@ class Frontend:
                 "multi-tenant query of the metrics endpoint is not supported")
         self._check_device_pressure("metrics")
         t0 = self.now()
+        # the recurring-query identity (obs/queryfp.py) rides every
+        # "query complete" line, so the hot set qlog sees and the set
+        # the materializer serves are greppably the same thing
+        from tempo_tpu.obs.queryfp import query_fingerprint
+        fp_extra = {"queryFp": query_fingerprint("metrics", query, step_s)}
         with tracing.span_for_tenant("frontend.QueryRange", tenants[0],
                                      query=query), \
                 querystats.ensure_scope() as st:
@@ -621,10 +664,11 @@ class Frontend:
                                         on_partial=on_partial)
             except Exception as e:
                 self._finish_query("metrics", tenants[0], query,
-                                   self.now() - t0, st, error=e)
+                                   self.now() - t0, st, error=e,
+                                   extra=fp_extra)
                 raise
             self._finish_query("metrics", tenants[0], query,
-                               self.now() - t0, st)
+                               self.now() - t0, st, extra=fp_extra)
             return res
 
     def _query_range(self, tenant: str, query: str, *,
@@ -636,6 +680,26 @@ class Frontend:
                                 start_ns=int(start_s * 1e9),
                                 end_ns=int(end_s * 1e9),
                                 step_ns=int(step_s * 1e9))
+        # materialized-view tier: a subscribed query whose grid covers
+        # the window is a slice + final pass — no generator recompute,
+        # no backend jobs. Misses feed qlog's recurrence counter, which
+        # drives auto-subscription of the hot set.
+        from tempo_tpu import matview
+        mv = matview.materializer()
+        if mv is not None:
+            got = mv.read(tenant, req)
+            if got is not None:
+                comb = SeriesCombiner(metrics_kind(query), req.n_steps)
+                comb.add_all(got)
+                self._record_op("metrics", tenant, self.now() - t0, 0)
+                with querystats.stage("combine"):
+                    res = comb.final(req)
+                if on_partial is not None:
+                    on_partial(res)
+                return res
+            mv.consider_auto_subscribe(
+                tenant, query, step_s,
+                self.qlog.note_fingerprint(mv.fingerprint(query, step_s)))
         # single cutoff, not overlapping windows: generators own
         # (cutoff, end], backend RF1 blocks own [start, cutoff] — sub-results
         # keep the full step grid and clip observations to their side, so
@@ -693,7 +757,8 @@ class Frontend:
                     "meta": j.meta.to_json(),
                     "row_groups": list(j.row_groups),
                     "clip_end_ns": cutoff_ns},
-                cache=(qr_key, _encode_series, _decode_series))
+                cache=(qr_key, _encode_series, _decode_series),
+                op="metrics")
         self._record_op("metrics", tenant, self.now() - t0, nbytes)
         # the cross-shard/cross-job fold happens here (lazily): on the
         # serving mesh, count-exact kinds collapse into one in-mesh
@@ -701,6 +766,24 @@ class Frontend:
         # combine cost went
         with querystats.stage("combine"):
             return comb.final(req)
+
+    def subscribe_query(self, tenant: str, query: str, step_s: float
+                        ) -> "tuple[bool, str]":
+        """Explicit materialized-view subscription (the API half of the
+        matview tier; the other half is qlog-recurrence auto-subscribe).
+        Returns (ok, reason-when-refused)."""
+        from tempo_tpu import matview
+        mv = matview.materializer()
+        if mv is None:
+            return False, "matview tier disabled"
+        sub, why = mv.subscribe(tenant, query, step_s)
+        return sub is not None, why
+
+    def unsubscribe_query(self, tenant: str, query: str,
+                          step_s: float) -> bool:
+        from tempo_tpu import matview
+        mv = matview.materializer()
+        return mv is not None and mv.unsubscribe(tenant, query, step_s)
 
     def decode_job_result(self, spec: dict, result):
         """Decode a remote worker's JSON job result back into the objects

@@ -101,8 +101,10 @@ class Generator:
                 if sm_patch:
                     cfg.spanmetrics = dataclasses.replace(
                         cfg.spanmetrics, **sm_patch)
-                inst = self.instances[tenant] = GeneratorInstance(
-                    tenant, cfg, now=self.now)
+                inst = GeneratorInstance(tenant, cfg, now=self.now)
+                inst._matview_limits = \
+                    lambda t=tenant: self.overrides.for_tenant(t)
+                self.instances[tenant] = inst
             return inst
 
     def tenants(self) -> list[str]:

@@ -72,6 +72,10 @@ class GeneratorInstance:
         # in-flight must re-resolve instead of scattering into a fenced
         # snapshot
         self.detached = False
+        # resolver for this tenant's CURRENT overrides (set by
+        # Generator.instance); the materializer fingerprints it to
+        # expire/rebuild grids when the tenant's limits change
+        self._matview_limits: "object | None" = None
 
     def drain(self) -> None:
         """The collection/snapshot barrier: flush the device scheduler
@@ -159,7 +163,15 @@ class GeneratorInstance:
 
     def _fast_spanmetrics(self) -> "SpanMetricsProcessor | None":
         """The single eligible spanmetrics processor for the staged fast
-        routes, or None when full SpanBatch staging is required."""
+        routes, or None when full SpanBatch staging is required. A
+        tenant with materialized query grids (tempo_tpu.matview) always
+        takes the SpanBatch route: the matview appender evaluates
+        TraceQL over the batch columns, which the StageRec fast path
+        never materializes."""
+        from tempo_tpu import matview
+        mv = matview.materializer()
+        if mv is not None and mv.wants(self.tenant):
+            return None
         procs = list(self.processors.values())
         if len(procs) != 1 or not isinstance(procs[0], SpanMetricsProcessor):
             return None
@@ -253,6 +265,16 @@ class GeneratorInstance:
                    sample_weights: np.ndarray | None = None) -> None:
         self.spans_received += sb.n
         sb = self._apply_slack(sb)
+        # materialized query grids see the batch BEFORE the processor
+        # fan: a grid (re)build backfills from local-blocks state, so
+        # the backfill must not already contain this batch (the append
+        # below would then double-count it)
+        from tempo_tpu import matview
+        mv = matview.materializer()
+        if mv is not None and mv.wants(self.tenant):
+            mv.observe_batch(self.tenant, sb,
+                             lb=self.processors.get("local-blocks"),
+                             limits_fn=self._matview_limits)
         for proc in self.processors.values():
             if isinstance(proc, SpanMetricsProcessor):
                 proc.push_batch(sb, span_sizes,

@@ -107,6 +107,14 @@ class LocalBlocksProcessor:
         for b in self.inst.complete_blocks():
             yield from scan_views(b, freq)
 
+    def views_for_matview(self) -> Iterator[tuple]:
+        """Stored-state scan views for the materialized-view backfill
+        (`tempo_tpu.matview`): the grid (re)build runs the recompute
+        evaluator over exactly these views, so a fresh grid cannot
+        disagree with `query_range` over the same window. No bloom
+        prefilter — rebuilds are rare and must see every span."""
+        return self._views(None)
+
     def query_range(self, req, clip_start_ns: int | None = None,
                     clip_end_ns: int | None = None):
         """TraceQL metrics over recent data (`QueryRange` `query_range.go:25`):

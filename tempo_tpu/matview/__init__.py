@@ -1,0 +1,22 @@
+"""tempo_tpu.matview — incremental materialized query grids.
+
+Hot recurring TraceQL-metrics queries become standing device-resident
+grids that every ingest batch streams into; dashboard reads turn into a
+grid slice + the normal combiner/final pass instead of a block/registry
+recompute. See `materializer.py` for the design notes and
+`operations/runbook.md` ("Materialized query grids") for the
+operational story.
+"""
+
+from tempo_tpu.matview.materializer import (
+    Materializer,
+    MatViewConfig,
+    Subscription,
+    configure,
+    materializer,
+    query_supported,
+    reset,
+)
+
+__all__ = ["Materializer", "MatViewConfig", "Subscription", "configure",
+           "materializer", "query_supported", "reset"]

@@ -29,6 +29,7 @@ import logging
 import math
 import threading
 import time
+from collections import OrderedDict
 from typing import Callable
 
 from tempo_tpu.obs.querystats import QueryStats
@@ -103,6 +104,14 @@ class QueryLogger:
         self._lock = threading.Lock()
         self._sketches: dict[str, LatencySketch] = {}
         self._seen: dict[str, int] = {}
+        # recurring-query recognition: per-fingerprint (obs/queryfp.py
+        # — the identity shared with tempo_tpu.matview) hit counts over
+        # a sliding window, bounded LRU so dashboard churn cannot grow
+        # it without bound. The materialized-view tier reads these
+        # counts to auto-subscribe hot queries.
+        self._recur: "OrderedDict[str, tuple[int, float]]" = OrderedDict()
+        self._recur_window_s = 600.0
+        self._recur_max = 4096
         # token bucket for non-error records (errors always emit)
         self._rate = float(rate_limit_per_s)
         self._burst = float(burst)
@@ -158,6 +167,30 @@ class QueryLogger:
                 self.suppressed += 1
                 return None
             return reason
+
+    def note_fingerprint(self, fp: str) -> int:
+        """Count one sighting of a query fingerprint; returns how many
+        times it recurred within the sliding window. The frontend feeds
+        every metrics request through here and hands the count to the
+        materializer's auto-subscribe decision — qlog owns recurrence so
+        the query log and the matview tier see the same hot set."""
+        t = self.now()
+        with self._lock:
+            n, first = self._recur.get(fp, (0, t))
+            if t - first > self._recur_window_s:
+                n, first = 0, t            # window rolled: restart count
+            self._recur[fp] = (n + 1, first)
+            self._recur.move_to_end(fp)
+            while len(self._recur) > self._recur_max:
+                self._recur.popitem(last=False)
+            return n + 1
+
+    def fingerprint_count(self, fp: str) -> int:
+        with self._lock:
+            n, first = self._recur.get(fp, (0, 0.0))
+            if n and self.now() - first > self._recur_window_s:
+                return 0
+            return n
 
     # -- emission -----------------------------------------------------------
 

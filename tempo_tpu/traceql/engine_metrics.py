@@ -245,9 +245,11 @@ def _pad_pow2(n: int, lo: int = 256) -> int:
     return bucket_rows(n, lo)
 
 
-class _SeriesIndex:
+class SeriesIndex:
     """Host-side series table: group-key tuple → dense slot (the string side
-    of `GroupingAggregator`; device arrays never see strings)."""
+    of `GroupingAggregator`; device arrays never see strings). Shared by
+    the per-request evaluator below and the standing materialized-view
+    grids (`tempo_tpu.matview`), which must mint identical label keys."""
 
     def __init__(self):
         self.slots: dict[tuple, int] = {}
@@ -265,6 +267,68 @@ class _SeriesIndex:
 
     def __len__(self) -> int:
         return len(self.keys)
+
+
+_SeriesIndex = SeriesIndex   # former (pre-matview) private name
+
+
+def matching_rows(q: A.Pipeline, fetch_req, need_second_pass: bool,
+                  view: ColumnView) -> np.ndarray:
+    """Row indices of `view` matched by the query's filter stages —
+    pushdown mask when the conditions cover the query, full pipeline
+    evaluation otherwise. Shared by `MetricsEvaluator` and the matview
+    appender so a materialized grid can never disagree with the
+    recompute path about which spans count."""
+    if not need_second_pass:
+        from tempo_tpu.block.fetch import condition_mask
+
+        return np.flatnonzero(condition_mask(view, fetch_req))
+    stripped = A.Pipeline(q.stages)  # pipeline minus metrics stage
+    spansets = evaluate_pipeline(stripped, view)
+    if not spansets:
+        return np.empty(0, np.int64)
+    return np.unique(np.concatenate([ss.rows for ss in spansets]))
+
+
+def group_slots(by, series: SeriesIndex, view: ColumnView,
+                rows: np.ndarray):
+    """(keep_mask, slots[int32]) or None when there's no by().
+
+    Vectorized: each group column factorizes to integer codes, codes
+    compose into one key per row, and only UNIQUE combos build Python
+    label tuples — the per-span tuple loop of `GroupingAggregator`
+    becomes O(distinct series) host work. Shared with the matview
+    appender (same label formatting → same series keys)."""
+    if not by:
+        return None
+    cols = [(str(e), eval_expr(view, e)) for e in by]
+    keep = np.ones(len(rows), bool)
+    for _, c in cols:
+        keep &= c.exists[rows]  # spans missing a group key are dropped
+    kept = rows[keep]
+    if len(kept) == 0:
+        return keep, np.zeros(0, np.int32)
+    codes: list[np.ndarray] = []
+    uniqs: list[tuple[str, np.ndarray, str]] = []
+    for name, c in cols:
+        vals = c.values[kept]
+        if vals.dtype == object:    # python-object compares are O(n) py
+            vals = vals.astype("U")
+        u, inv = np.unique(vals, return_inverse=True)
+        codes.append(inv.astype(np.int64))
+        uniqs.append((name, u, c.t))
+    comp = codes[0]
+    for code, (_, u, _) in zip(codes[1:], uniqs[1:]):
+        comp = comp * len(u) + code
+    ucomp, first, inv = np.unique(comp, return_index=True,
+                                  return_inverse=True)
+    tuples = [
+        tuple((name, _fmt_label(u[codes[k][fi]], t))
+              for k, (name, u, t) in enumerate(uniqs))
+        for fi in first.tolist()
+    ]
+    uslots = series.lookup(tuples)
+    return keep, uslots[inv].astype(np.int32)
 
 
 class MetricsEvaluator:
@@ -289,7 +353,7 @@ class MetricsEvaluator:
             raise ValueError("not a metrics query: " + req.query)
         self.m = self.q.metrics
         self.fetch_req = extract_conditions(self.q, req.start_ns, req.end_ns)
-        self.series = _SeriesIndex()
+        self.series = SeriesIndex()
         self.n_steps = req.n_steps
         self._cap = 0
         self._grids: dict[str, jax.Array] = {}
@@ -458,53 +522,11 @@ class MetricsEvaluator:
         self._note_exemplars(view, rows, slots)
 
     def _matching_rows(self, view: ColumnView) -> np.ndarray:
-        if not self._need_second_pass:
-            from tempo_tpu.block.fetch import condition_mask
-
-            return np.flatnonzero(condition_mask(view, self.fetch_req))
-        stripped = A.Pipeline(self.q.stages)  # pipeline minus metrics stage
-        spansets = evaluate_pipeline(stripped, view)
-        if not spansets:
-            return np.empty(0, np.int64)
-        return np.unique(np.concatenate([ss.rows for ss in spansets]))
+        return matching_rows(self.q, self.fetch_req,
+                             self._need_second_pass, view)
 
     def _group_slots(self, view: ColumnView, rows: np.ndarray):
-        """(keep_mask, slots[int32]) or None when there's no by().
-
-        Vectorized: each group column factorizes to integer codes, codes
-        compose into one key per row, and only UNIQUE combos build Python
-        label tuples — the per-span tuple loop of `GroupingAggregator`
-        becomes O(distinct series) host work."""
-        if not self.m.by:
-            return None
-        cols = [(str(e), eval_expr(view, e)) for e in self.m.by]
-        keep = np.ones(len(rows), bool)
-        for _, c in cols:
-            keep &= c.exists[rows]  # spans missing a group key are dropped
-        kept = rows[keep]
-        if len(kept) == 0:
-            return keep, np.zeros(0, np.int32)
-        codes: list[np.ndarray] = []
-        uniqs: list[tuple[str, np.ndarray, str]] = []
-        for name, c in cols:
-            vals = c.values[kept]
-            if vals.dtype == object:    # python-object compares are O(n) py
-                vals = vals.astype("U")
-            u, inv = np.unique(vals, return_inverse=True)
-            codes.append(inv.astype(np.int64))
-            uniqs.append((name, u, c.t))
-        comp = codes[0]
-        for code, (_, u, _) in zip(codes[1:], uniqs[1:]):
-            comp = comp * len(u) + code
-        ucomp, first, inv = np.unique(comp, return_index=True,
-                                      return_inverse=True)
-        tuples = [
-            tuple((name, _fmt_label(u[codes[k][fi]], t))
-                  for k, (name, u, t) in enumerate(uniqs))
-            for fi in first.tolist()
-        ]
-        uslots = self.series.lookup(tuples)
-        return keep, uslots[inv].astype(np.int32)
+        return group_slots(self.m.by, self.series, view, rows)
 
     def _observe_compare(self, view: ColumnView, rows: np.ndarray,
                          step: np.ndarray) -> None:

@@ -57,6 +57,13 @@ def _reset_device_scheduler():
     from tempo_tpu.ops import moments
 
     moments.set_query_tier("log2")
+    # the materialized-view tier is process-wide the same way: an
+    # App-based test leaving it configured would silently stream every
+    # later test's generator pushes into stale grids (and serve its
+    # frontend reads from them)
+    from tempo_tpu import matview
+
+    matview.reset()
 
 
 # ---------------------------------------------------------------------------

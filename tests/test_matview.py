@@ -1,0 +1,629 @@
+"""Materialized query grids (tempo_tpu/matview) — ISSUE 13.
+
+The correctness contract under test:
+
+- dd/count kinds served from a grid are BIT-IDENTICAL to the recompute
+  path (`GeneratorInstance.query_range` → SeriesCombiner → final),
+  including across an overrides-change expiry/rebuild cycle;
+- moments-tier quantiles stay inside the plane-fuzz error class (f32
+  add-order only — same solver, same grids);
+- reads are served only when aligned, covered, and fresh; every other
+  outcome falls through with a per-reason miss counter;
+- the shared fingerprint (obs/queryfp.py) is stable across whitespace,
+  filter operand order, and time-window shifts — qlog and the
+  materializer must agree on "same query".
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import pytest
+
+from tempo_tpu import matview, sched
+from tempo_tpu.generator.generator import Generator
+from tempo_tpu.generator.instance import GeneratorConfig
+from tempo_tpu.generator.processors.localblocks import LocalBlocksConfig
+from tempo_tpu.matview.materializer import MatViewConfig, query_supported
+from tempo_tpu.model.span_batch import SpanBatchBuilder
+from tempo_tpu.obs.queryfp import canonical_query, query_fingerprint
+from tempo_tpu.overrides import Overrides
+from tempo_tpu.traceql.engine_metrics import (
+    QueryRangeRequest,
+    SeriesCombiner,
+    metrics_kind,
+)
+
+T0 = 1_700_000_000.0
+_ids = itertools.count(1)
+
+
+def mkgen(now):
+    cfg = GeneratorConfig(processors=("span-metrics", "local-blocks"),
+                          localblocks=LocalBlocksConfig())
+    return Generator(cfg, overrides=Overrides(), now=now)
+
+
+def push(inst, n_ops=3, per=6, statuses=(0,), attr=None):
+    b = SpanBatchBuilder(inst.registry.interner)
+    t0 = int(inst.now() * 1e9)
+    for i in range(n_ops):
+        for j in range(per):
+            c = next(_ids)
+            b.append(trace_id=c.to_bytes(16, "big"),
+                     span_id=c.to_bytes(8, "big"),
+                     name=f"op{i}", service="svc", kind=2,
+                     status_code=statuses[j % len(statuses)],
+                     start_unix_nano=t0 - j * 1_000_000_000,
+                     end_unix_nano=t0 - j * 1_000_000_000
+                     + (5 + i) * 1_000_000,
+                     attrs=attr)
+    inst.push_batch(b.build())
+
+
+def final_map(series, req):
+    comb = SeriesCombiner(metrics_kind(req.query), req.n_steps)
+    comb.add_all(series or [])
+    return {ts.labels: ts.samples for ts in comb.final(req)}
+
+
+def aligned_req(now_s, query, step_s=10.0, back_steps=11, span_steps=12):
+    start = (int(now_s) // int(step_s) - back_steps) * int(step_s)
+    return QueryRangeRequest(query, int(start * 1e9),
+                             int((start + span_steps * step_s) * 1e9),
+                             int(step_s * 1e9))
+
+
+def assert_bitident(got, recompute, req):
+    f1, f2 = final_map(got, req), final_map(recompute, req)
+    assert set(f1) == set(f2), (sorted(f1), sorted(f2))
+    for k in f1:
+        assert np.array_equal(f1[k], f2[k]), (k, f1[k], f2[k])
+    return f1
+
+
+# ---------------------------------------------------------------------------
+# fingerprint (satellite: shared obs helper, stability gates)
+# ---------------------------------------------------------------------------
+
+def test_fingerprint_whitespace_and_label_order_stable():
+    a = '{ resource.service.name = "a" && name = "b" } | rate() by (name)'
+    b = '{name="b"&&resource.service.name="a"}   |   rate()   by(name)'
+    assert canonical_query(a) == canonical_query(b)
+    assert query_fingerprint("metrics", a, 10.0) == \
+        query_fingerprint("metrics", b, 10.0)
+    # || chains and spanset combines sort too
+    assert canonical_query('{ .a = 1 || .b = 2 }') == \
+        canonical_query('{ .b = 2 || .a = 1 }')
+    assert canonical_query('{.a=1} && {.b=2}') == \
+        canonical_query('{.b=2} && {.a=1}')
+
+
+def test_fingerprint_time_window_independent_but_step_sensitive():
+    q = "{ } | rate()"
+    # the window never enters the hash (same dashboard, shifted poll)
+    assert query_fingerprint("metrics", q, 10.0) == \
+        query_fingerprint("metrics", q, 10.0)
+    assert query_fingerprint("metrics", q, 10.0) != \
+        query_fingerprint("metrics", q, 60.0)
+    assert query_fingerprint("metrics", q, 10.0) != \
+        query_fingerprint("search", q, 10.0)
+    # distinct queries stay distinct
+    assert query_fingerprint("metrics", "{ } | count_over_time()", 10.0) \
+        != query_fingerprint("metrics", q, 10.0)
+
+
+def test_fingerprint_unparseable_fallback_stable():
+    assert canonical_query("  not   a query ") == "not a query"
+    assert query_fingerprint("metrics", "not a query", 1.0) == \
+        query_fingerprint("metrics", " not  a  query", 1.0)
+
+
+def test_qlog_recurrence_counter():
+    from tempo_tpu.obs.qlog import QueryLogger
+    clock = [T0]
+    ql = QueryLogger(now=lambda: clock[0])
+    fp = query_fingerprint("metrics", "{ } | rate()", 10.0)
+    assert [ql.note_fingerprint(fp) for _ in range(3)] == [1, 2, 3]
+    assert ql.fingerprint_count(fp) == 3
+    clock[0] += 700.0                       # past the sliding window
+    assert ql.fingerprint_count(fp) == 0
+    assert ql.note_fingerprint(fp) == 1     # window restarted
+
+
+# ---------------------------------------------------------------------------
+# subscription gating
+# ---------------------------------------------------------------------------
+
+def test_query_supported_gates():
+    ok, _ = query_supported("{ } | rate() by (name)")
+    assert ok
+    ok, _ = query_supported(
+        "{ } | quantile_over_time(duration, .5, .99) by (name)")
+    assert ok
+    for bad in ("{ } | min_over_time(duration)",     # kind not gridable
+                "{ } | avg_over_time(duration)",
+                "{ nestedSetLeft > 0 } | rate()",    # structural intrinsic
+                "{ rootName = `x` } | rate()",       # whole-trace root
+                "{ parent.name = `x` } | rate()",    # parent scope
+                "{.a=1} && {.b=2} | rate()",         # spanset combine
+                "{ }",                               # not a metrics query
+                "{{{"):                              # unparseable
+        ok, why = query_supported(bad)
+        assert not ok and why, bad
+
+
+def test_subscribe_refusals_and_budget():
+    mv = matview.configure(MatViewConfig(max_subscriptions=2))
+    sub, why = mv.subscribe("t", "{ } | min_over_time(duration)", 10.0)
+    assert sub is None and "not materializable" in why
+    sub, why = mv.subscribe("t", "{ } | rate()", 0.1)
+    assert sub is None and "outside" in why
+    s1, _ = mv.subscribe("t", "{ } | rate()", 10.0)
+    s1b, _ = mv.subscribe("t", "{ } | rate()", 10.0)
+    assert s1 is s1b                       # idempotent, not double-counted
+    s2, _ = mv.subscribe("t", "{ } | count_over_time()", 10.0)
+    assert s1 is not None and s2 is not None
+    s3, why = mv.subscribe("t", "{ } | rate() by (name)", 10.0)
+    assert s3 is None and "budget" in why
+    assert mv.unsubscribe("t", "{ } | rate()", 10.0)
+    assert not mv.wants("t") or mv.wants("t")   # map consistent
+    s3, why = mv.subscribe("t", "{ } | rate() by (name)", 10.0)
+    assert s3 is not None
+
+
+# ---------------------------------------------------------------------------
+# streaming append + read: bit-identity vs the recompute path
+# ---------------------------------------------------------------------------
+
+def test_rate_read_bit_identical_to_recompute():
+    clock = [T0]
+    now = lambda: clock[0]
+    gen = mkgen(now)
+    inst = gen.instance("t1")
+    mv = matview.configure(MatViewConfig(max_staleness_s=1e9), now=now)
+    query = "{ } | rate() by (name)"
+    mv.subscribe("t1", query, 10.0)
+    push(inst)                       # builds (empty backfill) + appends
+    clock[0] += 25
+    push(inst)
+    sched.flush()
+    req = aligned_req(now(), query)
+    got = mv.read("t1", req)
+    assert got is not None and mv.reads.get("hit") == 1
+    assert_bitident(got, inst.query_range(req), req)
+
+
+def test_backfill_on_late_subscribe_bit_identical():
+    """Subscribing AFTER data exists backfills from local-blocks state
+    through the real evaluator — first read already covers history."""
+    clock = [T0]
+    now = lambda: clock[0]
+    gen = mkgen(now)
+    inst = gen.instance("t1")
+    mv = matview.configure(MatViewConfig(max_staleness_s=1e9), now=now)
+    query = "{ } | count_over_time() by (name)"
+    push(inst)
+    clock[0] += 30
+    push(inst)                       # pre-subscription history
+    mv.subscribe("t1", query, 10.0)
+    clock[0] += 10
+    push(inst)                       # triggers build (backfill) + append
+    sched.flush()
+    req = aligned_req(now(), query)
+    got = mv.read("t1", req)
+    assert got is not None
+    assert_bitident(got, inst.query_range(req), req)
+
+
+def test_quantile_dd_bit_identical_across_override_rebuild():
+    """The differential satellite: dd-tier quantile grids must match the
+    recompute path bit-for-bit BEFORE and AFTER an overrides-change
+    expiry/rebuild cycle."""
+    clock = [T0]
+    now = lambda: clock[0]
+    gen = mkgen(now)
+    inst = gen.instance("t1")
+    mv = matview.configure(
+        MatViewConfig(max_staleness_s=1e9, overrides_check_interval_s=0.0),
+        now=now)
+    query = "{ } | quantile_over_time(duration, .5, .9, .99) by (name)"
+    mv.subscribe("t1", query, 10.0)
+    push(inst)
+    clock[0] += 15
+    push(inst)
+    sched.flush()
+    req = aligned_req(now(), query)
+    got = mv.read("t1", req)
+    assert got is not None
+    assert_bitident(got, inst.query_range(req), req)
+
+    # flip the tenant's overrides: next batch expires + rebuilds
+    gen.overrides.set_tenant_patch(
+        "t1", {"generator": {"collection_interval_s": 30.0}})
+    clock[0] += 10
+    push(inst)
+    sched.flush()
+    assert mv.rebuilds.get("overrides", 0) >= 1
+    sub = mv.subscriptions()[0]
+    assert not sub.needs_build           # rebuilt on the push path
+    req2 = aligned_req(now(), query)
+    got2 = mv.read("t1", req2)
+    assert got2 is not None
+    assert_bitident(got2, inst.query_range(req2), req2)
+
+
+def test_moments_tier_within_error_budget():
+    from tempo_tpu.ops import moments as msk
+    clock = [T0]
+    now = lambda: clock[0]
+    gen = mkgen(now)
+    inst = gen.instance("t1")
+    mv = matview.configure(MatViewConfig(max_staleness_s=1e9), now=now)
+    query = "{ } | quantile_over_time(duration, .5, .99) by (name)"
+    with msk.use_query_tier("moments"):
+        mv.subscribe("t1", query, 10.0)
+        push(inst, per=12)
+        clock[0] += 15
+        push(inst, per=12)
+        sched.flush()
+        req = aligned_req(now(), query)
+        got = mv.read("t1", req)
+        assert got is not None
+        f1 = final_map(got, req)
+        f2 = final_map(inst.query_range(req), req)
+        assert set(f1) == set(f2)
+        for k in f1:
+            a, b = f1[k], f2[k]
+            denom = np.maximum(np.abs(b), 1e-12)
+            rel = np.max(np.abs(a - b) / denom)
+            assert rel <= 0.02, (k, a, b)   # f32 add-order class only
+
+
+def test_tier_change_expires_grid():
+    from tempo_tpu.ops import moments as msk
+    clock = [T0]
+    now = lambda: clock[0]
+    gen = mkgen(now)
+    inst = gen.instance("t1")
+    mv = matview.configure(MatViewConfig(max_staleness_s=1e9), now=now)
+    query = "{ } | quantile_over_time(duration, .5) by (name)"
+    mv.subscribe("t1", query, 10.0)
+    push(inst)
+    sched.flush()
+    req = aligned_req(now(), query)
+    assert mv.read("t1", req) is not None
+    with msk.use_query_tier("moments"):
+        assert mv.read("t1", req) is None        # tier flip → miss
+        assert mv.reads.get("miss_tier_changed") == 1
+        push(inst)                               # rebuilds on moments axis
+        sched.flush()
+        assert mv.read("t1", req) is not None
+
+
+# ---------------------------------------------------------------------------
+# ring mechanics, coverage, staleness
+# ---------------------------------------------------------------------------
+
+def test_ring_advance_and_coverage_misses():
+    clock = [T0]
+    now = lambda: clock[0]
+    gen = mkgen(now)
+    inst = gen.instance("t1")
+    mv = matview.configure(
+        MatViewConfig(window_steps=8, max_staleness_s=1e9), now=now)
+    query = "{ } | rate() by (name)"
+    mv.subscribe("t1", query, 10.0)
+    push(inst, per=1)
+    clock[0] += 200                  # advance far: ring recycles columns
+    push(inst, per=1)
+    sched.flush()
+    # a window inside coverage serves…
+    req = aligned_req(now(), query, back_steps=5, span_steps=6)
+    assert mv.read("t1", req) is not None
+    # …the evicted past does not
+    req_old = aligned_req(now(), query, back_steps=30, span_steps=6)
+    assert mv.read("t1", req_old) is None
+    assert mv.reads.get("miss_coverage", 0) >= 1
+    # unaligned start can never map onto the step-aligned ring
+    req_un = QueryRangeRequest(query, req.start_ns + 1, req.end_ns + 1,
+                               req.step_ns)
+    assert mv.read("t1", req_un) is None
+    assert mv.reads.get("miss_unaligned") == 1
+
+
+def test_late_spans_dropped_and_counted():
+    clock = [T0]
+    now = lambda: clock[0]
+    gen = mkgen(now)
+    inst = gen.instance("t1")
+    mv = matview.configure(
+        MatViewConfig(window_steps=4, max_staleness_s=1e9), now=now)
+    mv.subscribe("t1", "{ } | rate()", 10.0)
+    push(inst, n_ops=1, per=1)
+    sub = mv.subscriptions()[0]
+    # a span 100 steps old lands outside the 4-column ring
+    b = SpanBatchBuilder(inst.registry.interner)
+    c = next(_ids)
+    old = int((now() - 1000) * 1e9)
+    b.append(trace_id=c.to_bytes(16, "big"), span_id=c.to_bytes(8, "big"),
+             name="op0", service="svc", kind=2, status_code=0,
+             start_unix_nano=old, end_unix_nano=old + 1_000_000)
+    inst.cfg.ingestion_time_range_slack_s = 0   # let the old span through
+    inst.push_batch(b.build())
+    sched.flush()
+    assert sub.late_dropped >= 1
+
+
+def test_staleness_gate_and_gauge():
+    clock = [T0]
+    now = lambda: clock[0]
+    gen = mkgen(now)
+    inst = gen.instance("t1")
+    mv = matview.configure(MatViewConfig(max_staleness_s=30.0), now=now)
+    query = "{ } | rate()"
+    mv.subscribe("t1", query, 10.0)
+    push(inst)
+    sched.flush()
+    req = aligned_req(now(), query)
+    assert mv.read("t1", req) is not None
+    clock[0] += 120                  # no batches: grid goes stale
+    req2 = aligned_req(now(), query)
+    assert mv.read("t1", req2) is None
+    assert mv.reads.get("miss_stale") == 1
+    # the gauge reports the per-tenant worst case
+    from tempo_tpu.matview.materializer import _mv_staleness
+    rows = dict(_mv_staleness())
+    assert rows[("t1",)] == pytest.approx(120.0, abs=1.0)
+
+
+def test_series_overflow_budget():
+    clock = [T0]
+    now = lambda: clock[0]
+    gen = mkgen(now)
+    inst = gen.instance("t1")
+    mv = matview.configure(
+        MatViewConfig(max_series=64, max_staleness_s=1e9), now=now)
+    mv.subscribe("t1", "{ } | rate() by (name)", 10.0)
+    push(inst, n_ops=100, per=1)     # 100 groups > 64-series budget
+    sched.flush()
+    sub = mv.subscriptions()[0]
+    assert sub.overflow_dropped > 0
+    req = aligned_req(now(), "{ } | rate() by (name)")
+    got = mv.read("t1", req)
+    assert got is not None and len(got) <= 64
+
+
+# ---------------------------------------------------------------------------
+# auto-subscribe + idle expiry + fast-route gate
+# ---------------------------------------------------------------------------
+
+def test_auto_subscribe_and_idle_expiry():
+    clock = [T0]
+    now = lambda: clock[0]
+    gen = mkgen(now)
+    inst = gen.instance("t1")
+    mv = matview.configure(
+        MatViewConfig(auto_subscribe_after=3, idle_expire_s=100.0,
+                      max_staleness_s=1e9), now=now)
+    q = "{ } | rate()"
+    mv.consider_auto_subscribe("t1", q, 10.0, recurrences=2)
+    assert not mv.subscriptions()
+    mv.consider_auto_subscribe("t1", q, 10.0, recurrences=3)
+    subs = mv.subscriptions()
+    assert len(subs) == 1 and subs[0].origin == "auto"
+    assert mv.auto_subscribed == 1
+    push(inst)
+    sched.flush()
+    assert not subs[0].needs_build
+    clock[0] += 200                  # never read → idle expiry on push
+    push(inst)
+    assert not mv.subscriptions()
+    # a tenant that STOPS ingesting still expires, via the rate-limited
+    # sweep on the read/scrape paths (fleet handoff / idle tenant)
+    mv.consider_auto_subscribe("t-gone", q, 10.0, recurrences=3)
+    assert len(mv.subscriptions()) == 1
+    clock[0] += 200
+    mv.status()                      # scrape-path sweep
+    assert not mv.subscriptions()
+
+
+def test_matview_disables_staged_fast_route():
+    clock = [T0]
+    now = lambda: clock[0]
+    gen = Generator(GeneratorConfig(processors=("span-metrics",)),
+                    overrides=Overrides(), now=now)
+    inst = gen.instance("t1")
+    assert inst._fast_spanmetrics() is not None
+    mv = matview.configure(MatViewConfig(), now=now)
+    mv.subscribe("t1", "{ } | rate()", 10.0)
+    assert inst._fast_spanmetrics() is None      # full SpanBatch route
+    assert gen.instance("t2")._fast_spanmetrics() is not None
+
+
+# ---------------------------------------------------------------------------
+# frontend integration: hit path, auto-subscribe wiring, per-op cache
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def fe_rig():
+    from tempo_tpu.backend.mem import MemBackend
+    from tempo_tpu.db.tempodb import TempoDB
+    from tempo_tpu.frontend import Frontend, FrontendConfig
+    from tempo_tpu.querier import Querier
+    from tempo_tpu.querier.querier import QuerierConfig
+    from tempo_tpu.ring import Ring
+
+    clock = [T0]
+    now = lambda: clock[0]
+    gen = mkgen(now)
+    be = MemBackend()
+    db = TempoDB(be, be)
+    ring = Ring(replication_factor=1, now=now)
+    q = Querier(db, ring, {}, cfg=QuerierConfig(rf=1))
+    fe = Frontend(db, q, cfg=FrontendConfig(
+        query_backend_after_s=10 * 365 * 86400.0),   # generator-only leg
+        generator_query_range=gen.query_range, now=now)
+    return clock, now, gen, fe
+
+
+def test_frontend_serves_hit_and_matches_recompute(fe_rig):
+    clock, now, gen, fe = fe_rig
+    inst = gen.instance("t1")
+    mv = matview.configure(MatViewConfig(max_staleness_s=1e9), now=now)
+    query = "{ } | rate() by (name)"
+    ok, why = fe.subscribe_query("t1", query, 10.0)
+    assert ok, why
+    push(inst)
+    clock[0] += 20
+    push(inst)
+    sched.flush()
+    start = (int(now()) // 10 - 11) * 10
+    kw = dict(start_s=float(start), end_s=float(start + 120), step_s=10.0)
+    served = fe.query_range("t1", query, **kw)
+    assert mv.reads.get("hit") == 1
+    matview.reset()                       # force the recompute path
+    recomputed = fe.query_range("t1", query, **kw)
+    a = {s.labels: s.samples.tolist() for s in served}
+    b = {s.labels: s.samples.tolist() for s in recomputed}
+    assert a == b
+    assert fe.unsubscribe_query("t1", query, 10.0) is False  # mv reset
+
+
+def test_frontend_auto_subscribes_recurring_query(fe_rig):
+    clock, now, gen, fe = fe_rig
+    inst = gen.instance("t1")
+    mv = matview.configure(
+        MatViewConfig(auto_subscribe_after=3, max_staleness_s=1e9),
+        now=now)
+    push(inst)
+    query = "{ } | rate() by (name)"
+    start = (int(now()) // 10 - 5) * 10
+    kw = dict(start_s=float(start), end_s=float(start + 60), step_s=10.0)
+    for _ in range(3):                    # misses feed qlog recurrence
+        fe.query_range("t1", query, **kw)
+    subs = mv.subscriptions()
+    assert len(subs) == 1 and subs[0].origin == "auto"
+    push(inst)                            # builds the grid
+    sched.flush()
+    fe.query_range("t1", query, **kw)
+    assert mv.reads.get("hit", 0) >= 1
+
+
+def test_per_op_cache_counters(fe_rig):
+    """Satellite: per-op frontend cache hit/miss counter families."""
+    from tempo_tpu.backend.cache import CacheProvider
+    from tempo_tpu.db.tempodb import TempoDB
+    from tempo_tpu.backend.mem import MemBackend
+    from tempo_tpu.frontend import Frontend, FrontendConfig
+    from tempo_tpu.frontend.slos import SLOConfig
+    from tempo_tpu.querier import Querier
+    from tempo_tpu.querier.querier import QuerierConfig
+    from tempo_tpu.ring import Ring
+
+    clock = [T0 + 7200.0]
+    now = lambda: clock[0]
+    be = MemBackend()
+    db = TempoDB(be, be)
+    traces = []
+    for i in range(1, 6):
+        tid = bytes([i]) * 16
+        t0 = int((T0 + i) * 1e9)
+        traces.append((tid, [{
+            "trace_id": tid, "span_id": bytes([i]) * 8, "name": "op",
+            "service": "svc", "start_unix_nano": t0,
+            "end_unix_nano": t0 + 50_000_000}]))
+    db.write_block("acme", traces, replication_factor=1)
+    db.poll_now()
+    ring = Ring(replication_factor=1, now=now)
+    q = Querier(db, ring, {}, cfg=QuerierConfig(rf=1))
+    fe = Frontend(db, q, cfg=FrontendConfig(
+        target_bytes_per_job=1,
+        slo={"search": SLOConfig(duration_slo_s=60.0)}),
+        cache_provider=CacheProvider(), now=now)
+    fe.search("acme", "{ }", limit=10, start_s=0, end_s=now())
+    assert fe._cache_ops["search"]["misses"] > 0
+    assert fe._cache_ops["search"].get("hits", 0) == 0
+    fe.search("acme", "{ }", limit=10, start_s=0, end_s=now())
+    assert fe._cache_ops["search"]["hits"] > 0
+    kw = dict(start_s=T0, end_s=T0 + 60, step_s=10.0)
+    fe.query_range("acme", "{ } | rate()", **kw)
+    fe.query_range("acme", "{ } | rate()", **kw)
+    assert fe._cache_ops["metrics"]["misses"] > 0
+    assert fe._cache_ops["metrics"]["hits"] > 0
+    text = fe.obs.render()
+    assert 'tempo_tpu_frontend_cache_hits_total{op="search"}' in text
+    assert 'tempo_tpu_frontend_cache_misses_total{op="metrics"}' in text
+
+
+# ---------------------------------------------------------------------------
+# obs + config + status surfaces
+# ---------------------------------------------------------------------------
+
+def test_matview_obs_families_render():
+    from tempo_tpu.obs.jaxruntime import RUNTIME
+    clock = [T0]
+    now = lambda: clock[0]
+    gen = mkgen(now)
+    inst = gen.instance("t1")
+    mv = matview.configure(MatViewConfig(max_staleness_s=1e9), now=now)
+    mv.subscribe("t1", "{ } | rate()", 10.0)
+    push(inst)
+    sched.flush()
+    mv.read("t1", aligned_req(now(), "{ } | rate()"))
+    mv.read("t1", QueryRangeRequest("{ } | count_over_time()",
+                                    int(T0 * 1e9), int((T0 + 60) * 1e9),
+                                    int(10e9)))
+    text = RUNTIME.render()
+    assert 'tempo_matview_subscriptions{origin="explicit"} 1' in text
+    assert "tempo_matview_grids 1" in text
+    assert 'tempo_matview_reads_total{result="hit"} 1' in text
+    assert 'tempo_matview_reads_total{result="miss_unsubscribed"} 1' in text
+    assert "tempo_matview_appends_total" in text
+    assert "tempo_matview_state_bytes" in text
+    assert 'tempo_matview_staleness_seconds{tenant="t1"}' in text
+    st = mv.status()
+    assert st["subscriptions"] == 1 and st["grids_built"] == 1
+    assert st["subscribed"][0]["tenant"] == "t1"
+
+
+def test_config_check_matview_bounds():
+    from tempo_tpu.app.config import Config
+    cfg = Config()
+    assert not [w for w in cfg.check() if "matview" in w]
+    cfg.matview.window_steps = 1
+    cfg.matview.max_staleness_s = 0.0
+    cfg.matview.auto_subscribe_after = 0
+    warns = "\n".join(cfg.check())
+    assert "matview.window_steps < 2" in warns
+    assert "matview.max_staleness_s" in warns
+    assert "matview.auto_subscribe_after" in warns
+
+
+def test_zero_steady_state_recompiles_on_append():
+    """Warm appends must reuse the shared engine scatter traces — the
+    acceptance criterion's zero-recompile gate, in miniature."""
+    from tempo_tpu.obs.jaxruntime import JIT_COMPILES
+    clock = [T0]
+    now = lambda: clock[0]
+    gen = mkgen(now)
+    inst = gen.instance("t1")
+    mv = matview.configure(MatViewConfig(max_staleness_s=1e9), now=now)
+    mv.subscribe("t1", "{ } | rate() by (name)", 10.0)
+    for _ in range(3):                   # warm every shape bucket
+        push(inst, n_ops=3, per=6)
+        clock[0] += 10
+    sched.flush()
+
+    def compiles():
+        with JIT_COMPILES._lock:
+            return sum(v for k, v in JIT_COMPILES._series.items()
+                       if k and k[0].startswith(("matview", "engine")))
+
+    warm = compiles()
+    for _ in range(5):
+        push(inst, n_ops=3, per=6)
+        clock[0] += 10
+    sched.flush()
+    assert compiles() == warm
