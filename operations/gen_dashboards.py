@@ -278,6 +278,19 @@ def dashboards() -> dict[str, dict]:
                 p("Registry state bytes by layout",
                   "sum(tempo_registry_state_bytes) by (layout)",
                   legend="{{layout}}"),
+                # generator ingest WAL (runbook "Crash recovery and
+                # fault injection"): acked-is-durable write rate, fsync
+                # cost, and the recovery/dead-letter signals
+                p("Ingest WAL appends (batches + bytes /s)",
+                  _rate("tempo_wal_appended_batches_total"),
+                  _rate("tempo_wal_appended_bytes_total")),
+                p("Ingest WAL fsyncs /s + truncated segments /s",
+                  _rate("tempo_wal_fsyncs_total"),
+                  _rate("tempo_wal_truncated_segments_total")),
+                p("WAL replay: batches /s, dead letters /s, lag",
+                  _rate("tempo_wal_replayed_batches_total"),
+                  _rate("tempo_wal_dead_letters_total"),
+                  "max(tempo_wal_replay_lag_seconds)"),
             ]),
         "tempo-tpu-resources.json": dash(
             "Tempo-TPU / Resources",

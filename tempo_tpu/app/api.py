@@ -229,7 +229,11 @@ class Handler(BaseHTTPRequestHandler):
             return self._reply(200, b"{}")
         if path == "/internal/generator/push_otlp":
             try:
-                n_spans = self.app.generator.push_otlp(tenant, body)
+                # X-Push-Id: client retry idempotency — a replayed id
+                # returns the cached span count without re-scattering
+                n_spans = self.app.generator.push_otlp(
+                    tenant, body,
+                    push_id=self.headers.get("X-Push-Id") or None)
             except (ValueError, KeyError, TypeError) as e:
                 return self._err(400, f"malformed otlp payload: {e}")
             return self._reply(200, _json_bytes({"spans": n_spans}))
@@ -685,6 +689,12 @@ class Handler(BaseHTTPRequestHandler):
             "rings": self._rings_status(),
             # fleet controller state (None = fleet mode off)
             "fleet": self._fleet_status(),
+            # generator ingest WAL (runbook "Crash recovery and fault
+            # injection"): None = WAL disabled
+            "wal": self._wal_status(),
+            # armed fault points + injected counts (None = disarmed —
+            # the only acceptable state outside a chaos run)
+            "faults": self._faults_status(),
             # materialized query grids (runbook "Materialized query
             # grids"): None = tier disabled
             "matview": self._matview_status(),
@@ -717,6 +727,15 @@ class Handler(BaseHTTPRequestHandler):
     def _fleet_status(self) -> "dict | None":
         fc = getattr(self.app, "fleet", None)
         return None if fc is None else fc.status()
+
+    def _wal_status(self) -> "dict | None":
+        gen = getattr(self.app, "generator", None)
+        wal = getattr(gen, "wal", None) if gen is not None else None
+        return None if wal is None else wal.status()
+
+    def _faults_status(self) -> "dict | None":
+        from tempo_tpu.utils import faults
+        return faults.stats() if faults.ARMED else None
 
     def _pages_status(self) -> "dict | None":
         from tempo_tpu.registry import pages

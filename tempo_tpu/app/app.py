@@ -213,6 +213,11 @@ class App:
 
     def _build(self) -> None:
         mods = TARGETS[self.cfg.target]
+        # fault injection is process-wide and must arm before any module
+        # whose paths carry fault points is constructed; disarmed (the
+        # default) it costs one module-flag check per guarded call site
+        from tempo_tpu.utils import faults
+        faults.configure(self.cfg.faults)
         # the shared device-execution scheduler is process-wide state
         # (like the JAX runtime registry): configure it before any module
         # that dispatches kernels is constructed
@@ -310,7 +315,16 @@ class App:
             self.backend = LocalBackend(s.local_path)
         else:
             from tempo_tpu.backend.cloud import open_backend
-            self.backend = open_backend(s.backend, **s.cloud)
+            self.backend = open_backend(s.backend, op_timeout_s=s.op_timeout_s,
+                                        **s.cloud)
+        # resilience wrapper: backend.read/write fault points + bounded
+        # jittered-backoff retries on transient store errors (cloud
+        # flaps, injected faults) — DoesNotExist/AlreadyExists pass
+        # through untouched
+        from tempo_tpu.backend.cloud import ResilientBackend
+        self.backend = ResilientBackend(self.backend,
+                                        retries=s.op_retries,
+                                        backoff_s=s.op_retry_backoff_s)
 
     def _init_overrides(self) -> None:
         uc = UserConfigurableOverrides(self.backend, self.backend)
@@ -394,10 +408,26 @@ class App:
         cfg = self.cfg.generator
         cfg.localblocks_flush_writer = self.backend
         iid = self._iid("generator")
+        wal = None
+        if self.cfg.wal.enabled:
+            from tempo_tpu.generator.wal import GeneratorWal
+            wal = GeneratorWal(self.cfg.wal, now=self.now)
         self.generator = Generator(cfg, overrides=self.overrides,
                                    instance_id=iid, registry=self.obs,
-                                   now=self.now)
+                                   now=self.now, wal=wal)
         self._join_ring("generator", iid)
+        if wal is not None and not self.cfg.fleet.enabled:
+            # non-fleet boot recovery: no checkpoints exist, so state
+            # starts empty and the whole WAL replays (the fleet path
+            # replays inside the controller's boot tick, AFTER restore
+            # populated the watermarks)
+            got = self.generator.replay_wal_all()
+            if got["batches"] or got["dead_letters"]:
+                import logging
+                logging.getLogger("tempo_tpu.generator.wal").info(
+                    "boot WAL replay: %d batches across %d tenants "
+                    "(%d dead-lettered)", got["batches"], got["tenants"],
+                    got["dead_letters"])
         if self.cfg.fleet.enabled:
             # the fleet controller's own view of the generator ring:
             # membership changes (and heartbeat expiry) drive the

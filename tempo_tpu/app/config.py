@@ -20,6 +20,7 @@ from tempo_tpu.distributor.distributor import DistributorConfig
 from tempo_tpu.fleet import FleetConfig
 from tempo_tpu.frontend.frontend import FrontendConfig
 from tempo_tpu.generator.instance import GeneratorConfig
+from tempo_tpu.generator.wal import IngestWalConfig
 from tempo_tpu.generator.processors.localblocks import LocalBlocksConfig
 from tempo_tpu.ingester.ingester import IngesterConfig
 from tempo_tpu.ingester.instance import InstanceConfig
@@ -29,6 +30,7 @@ from tempo_tpu.parallel.serving import MeshConfig
 from tempo_tpu.querier.querier import QuerierConfig
 from tempo_tpu.registry.pages import PagePoolConfig
 from tempo_tpu.sched import SchedConfig
+from tempo_tpu.utils.faults import FaultsConfig
 
 
 @dataclasses.dataclass
@@ -72,6 +74,13 @@ class StorageConfig:
     memcached_expiration_s: int = 0
     hedge_delay_s: float = 0.0          # >0: hedge slow object reads
     hedge_max: int = 1
+    # object-store resilience (backend/cloud.py ResilientBackend):
+    # transient op failures retry with bounded jittered backoff; cloud
+    # clients get a per-op socket timeout so a hung endpoint cannot
+    # wedge a flush/checkpoint thread forever
+    op_retries: int = 2
+    op_retry_backoff_s: float = 0.1
+    op_timeout_s: float = 30.0
 
 
 @dataclasses.dataclass
@@ -150,6 +159,17 @@ class Config:
     # the storage backend and live rebalancing on membership change.
     # Default off; see runbook "Operating a generator fleet"
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
+    # generator ingest WAL (tempo_tpu.generator.wal): every acked push
+    # appends to a per-tenant local segment log before the ack returns;
+    # boot replays past the fleet-checkpoint watermark — kill -9 / OOM
+    # recovery is bit-identical to the uninterrupted run. Default off;
+    # see runbook "Crash recovery and fault injection"
+    wal: IngestWalConfig = dataclasses.field(default_factory=IngestWalConfig)
+    # fault injection (tempo_tpu.utils.faults): named fault points in
+    # the real backend/KV/RPC/sched/WAL paths, scripted with
+    # deterministic seeds — for chaos runs ONLY (`faults.allow: true`
+    # required; zero cost disarmed)
+    faults: FaultsConfig = dataclasses.field(default_factory=FaultsConfig)
     overrides_defaults: Limits = dataclasses.field(default_factory=Limits)
     per_tenant_override_config: str = ""   # runtime-config file path
     compaction_interval_s: float = 30.0
@@ -291,6 +311,15 @@ class Config:
                     "qlog's sliding window)")
         warnings.extend(self.mesh.check())
         warnings.extend(self.fleet.check())
+        warnings.extend(self.wal.check())
+        warnings.extend(self.faults.check())
+        if self.wal.enabled and not self.fleet.enabled:
+            warnings.append(
+                "wal.enabled without fleet.enabled: nothing truncates "
+                "the ingest WAL (truncation rides checkpoint watermarks) "
+                "— boot replay stays correct but segments and replay "
+                "time grow without bound; enable the fleet (a single "
+                "member is fine) to cycle checkpoints")
         if self.distributor.generator_placement not in ("trace", "tenant"):
             warnings.append(
                 f"distributor.generator_placement "

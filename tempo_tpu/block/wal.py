@@ -27,12 +27,23 @@ from tempo_tpu.block.reader import _rows_to_spans
 import numpy as np
 
 
+from tempo_tpu.utils import fsync_dir as _fsync_dir  # noqa: E402
+
+
 class WALBlock:
     def __init__(self, path: str, tenant: str, block_id: str | None = None):
         self.tenant = tenant
         self.block_id = block_id or str(uuid.uuid4())
         self.dir = os.path.join(path, f"{self.block_id}+{tenant}+{bs.VERSION}")
+        created = not os.path.isdir(self.dir)
         os.makedirs(self.dir, exist_ok=True)
+        if created:
+            # fsync the WAL ROOT so the block dir's own dirent survives a
+            # crash: segment files fsync themselves and their parent (the
+            # block dir, in append()), but a power loss right after the
+            # first append could otherwise drop the block directory entry
+            # from the root — a fully-fsynced segment nobody can rescan
+            _fsync_dir(path)
         self._next_seg = self._scan_next_seg()
         self.spans_appended = 0
 

@@ -9,7 +9,10 @@ resolve with a single searchsorted per unique trace (ring.do_batch).
 from __future__ import annotations
 
 import dataclasses
+import errno
+import random
 import time
+import urllib.error
 from typing import Callable, Protocol, Sequence
 
 import numpy as np
@@ -32,6 +35,21 @@ REASON_TRACE_TOO_LARGE = "trace_too_large"
 REASON_INVALID_TRACE_ID = "invalid_trace_id"
 REASON_INTERNAL = "internal_error"
 REASON_UNKNOWN_ERROR = "unknown_error"
+
+
+def _never_committed(e: BaseException) -> bool:
+    """True iff the failed generator-tee send provably never reached a
+    listener (connection refused). ONLY those are safe to re-send to a
+    re-resolved ring owner: timeouts / resets / client-level retry
+    exhaustion may have committed server-side, and the inner
+    RemoteGeneratorClient already retried them under ONE X-Push-Id —
+    re-sending here would mint a new id past the receiver's dedupe."""
+    if isinstance(e, urllib.error.URLError) and \
+            not isinstance(e, urllib.error.HTTPError):
+        e = e.reason if isinstance(e.reason, BaseException) else e
+    return isinstance(e, ConnectionRefusedError) or (
+        isinstance(e, OSError)
+        and getattr(e, "errno", None) == errno.ECONNREFUSED)
 
 
 class IngesterClient(Protocol):
@@ -147,6 +165,7 @@ class Distributor:
         self.metrics: dict[str, float] = {
             "spans_received_total": 0, "bytes_received_total": 0,
             "traces_pushed_total": 0, "push_failures_total": 0,
+            "push_retries_total": 0,
         }
         self.discarded: dict[str, int] = {}
         self.obs = registry if registry is not None else Registry()
@@ -162,6 +181,10 @@ class Distributor:
                 "Distinct traces replicated to the ingester ring",
             "push_failures_total":
                 "Quorum replication failures (ingester or generator ring)",
+            "push_retries_total":
+                "Tenant-placement generator pushes retried after a send "
+                "failure (owner re-resolved off the live ring each "
+                "attempt; the RPC push id makes the retry idempotent)",
         }
         for key, help_text in helps.items():
             reg.counter_func(
@@ -516,14 +539,32 @@ class Distributor:
         dead member's tenants until its descriptor was removed."""
         if self.cfg.generator_placement == "tenant":
             from tempo_tpu.fleet.placement import tenant_token
-            inst = self.generator_ring.owner_of(tenant_token(tenant))
-            if inst is None:
-                self.metrics["push_failures_total"] += 1
-                return
-            try:
-                send_fn(inst, list(range(n_items)))
-            except Exception:   # best-effort tee: client/transport errors
-                self.metrics["push_failures_total"] += 1
+
+            # owner-moved retry: a REFUSED send (dead/killed member, the
+            # one failure that provably never committed) re-resolves the
+            # owner off the LIVE ring view — heartbeat expiry or handoff
+            # may have moved the tenant mid-push — and retries with
+            # jitter. Ambiguous failures stay failures: the client-level
+            # idempotent retry (same X-Push-Id) already covered them.
+            last_owner = None
+            for attempt in range(3):
+                inst = self.generator_ring.owner_of(tenant_token(tenant))
+                if inst is None:
+                    break
+                try:
+                    send_fn(inst, list(range(n_items)))
+                    return
+                except Exception as e:
+                    if attempt == 2 or not _never_committed(e):
+                        break
+                    if last_owner == inst.id:
+                        # same owner still refusing: brief jittered
+                        # pause before the ring view names a new one
+                        time.sleep(0.05 * (1 + attempt)
+                                   * (0.5 + random.random()))
+                    last_owner = inst.id
+                    self.metrics["push_retries_total"] += 1
+            self.metrics["push_failures_total"] += 1
             return
         try:
             do_batch(self.generator_ring, tokens, list(range(n_items)),

@@ -53,6 +53,11 @@ class FleetConfig:
     restore_on_boot: bool = True
     # object-store prefix the checkpoint blobs live under
     checkpoint_prefix: str = "fleet-checkpoints"
+    # transient blob-write failures retry with jittered exponential
+    # backoff before the handoff falls back to reattach/orphan; retries
+    # are counted in tempo_fleet_checkpoint_retries_total{cause}
+    checkpoint_write_retries: int = 3
+    checkpoint_retry_backoff_s: float = 0.2
 
     def check(self) -> list[str]:
         problems = []
@@ -64,6 +69,11 @@ class FleetConfig:
             problems.append(
                 f"fleet.checkpoint_prefix {self.checkpoint_prefix!r} must "
                 "be a single non-empty path segment")
+        if self.checkpoint_write_retries < 0 or \
+                self.checkpoint_retry_backoff_s <= 0:
+            problems.append(
+                "fleet.checkpoint_write_retries must be >= 0 and "
+                "checkpoint_retry_backoff_s > 0")
         return ["fleet: " + p for p in problems] if problems else []
 
 
@@ -84,6 +94,10 @@ STATS = {
     "restore_dropped_series": 0,
     "handoffs": 0,
 }
+
+# checkpoint blob-write retries by exception class (controller backoff
+# loop; a rising rate means the object store is flapping under handoffs)
+RETRY_CAUSES: dict = {}
 
 from tempo_tpu.obs.jaxruntime import RUNTIME  # noqa: E402
 
@@ -106,6 +120,13 @@ RUNTIME.counter_func(
     lambda: [((), float(STATS["restores"]))],
     help="Tenant checkpoints restored-and-merged into this process "
          "(boot restores + handoff receives)")
+RUNTIME.counter_func(
+    "tempo_fleet_checkpoint_retries_total",
+    lambda: [((cause,), float(n)) for cause, n in RETRY_CAUSES.items()],
+    help="Checkpoint blob-write retries by failure cause (jittered "
+         "backoff before reattach/orphan fallback; runbook 'Operating "
+         "a generator fleet')",
+    labels=("cause",))
 RUNTIME.counter_func(
     "tempo_fleet_handoffs_total",
     lambda: [((), float(STATS["handoffs"]))],

@@ -241,17 +241,40 @@ def snapshot_instance(inst) -> bytes:
     accepted before the snapshot must be IN it — the same barrier the
     collection tick uses), then gathers every family's active rows under
     the registry state lock so the cut is consistent across the
-    slot-aligned families and their sketch sidecars."""
+    slot-aligned families and their sketch sidecars.
+
+    CALLER CONTRACT: no push may be in flight on this instance — the
+    handoff path fences with `wait_pushes_idle` after `pop_instance`,
+    and the shutdown path joins HTTP handler threads first. The WAL
+    watermark read below claims every record appended so far; a push
+    racing this function could scatter+append between the watermark
+    read and the state gather, landing in the blob AND above the
+    watermark — double-applied on crash recovery."""
     t0 = time.perf_counter()
     inst.drain()
     reg = inst.registry
     arrays: dict[str, np.ndarray] = {}
+    # ingest-WAL watermark map {member instance_id: [segment, seq]}:
+    # restored watermarks carry forward (a blob that passed through
+    # another member still bounds THIS member's local replay) and the
+    # live watermark is read here — after the caller's push fence, so
+    # every record whose scatter this snapshot gathered is covered.
+    # The caller truncates segments <= checkpointed_wal_seq once the
+    # blob write lands.
+    wal_meta = {k: [int(v[0]), int(v[1])]
+                for k, v in getattr(inst, "wal_watermarks", {}).items()}
+    mark = getattr(inst, "_wal_mark", None)
+    if mark is not None:
+        iid, seg, seq = mark()
+        wal_meta[iid] = [int(seg), int(seq)]
+        inst.checkpointed_wal_seq = int(seq)
     meta: dict = {
         "version": CHECKPOINT_VERSION,
         "tenant": inst.tenant,
         "created_ts": reg.now(),
         "fingerprint": overrides_fingerprint(inst),
         "layout": inst.state_layout,
+        "wal": wal_meta,
         "families": {},
         "spanmetrics": None,
     }
@@ -404,6 +427,14 @@ def restore_instance(inst, blob: bytes) -> dict:
                      if k.startswith("__sketch__::")}
             sk_proc.sketch_restore(meta["spanmetrics"], calls_live_slots,
                                    calls_ok, srows)
+    # merge WAL watermarks (max seq per member): the local replay must
+    # skip records this blob's lineage already holds
+    marks = getattr(inst, "wal_watermarks", None)
+    if marks is not None:
+        for iid, wm in (meta.get("wal") or {}).items():
+            cur = marks.get(iid)
+            if cur is None or int(wm[1]) > int(cur[1]):
+                marks[iid] = [int(wm[0]), int(wm[1])]
     STATS["restores"] += 1
     STATS["restore_merged_series"] += stats["series"]
     STATS["restore_dropped_series"] += stats["dropped"]
@@ -451,6 +482,9 @@ def checkpoint_name(now: float, instance_id: str) -> str:
 
 def write_checkpoint(writer: RawWriter, prefix: str, tenant: str,
                      blob: bytes, name: str) -> None:
+    from tempo_tpu.utils import faults
+    if faults.ARMED:
+        faults.fire("fleet.checkpoint.write")
     writer.write(name, KeyPath((prefix, _tenant_seg(tenant))), blob)
 
 

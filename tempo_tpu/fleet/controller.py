@@ -26,11 +26,12 @@ is how single-host restart-without-data-loss falls out for free.
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 from typing import Callable
 
-from tempo_tpu.fleet import STATS, FleetConfig
+from tempo_tpu.fleet import RETRY_CAUSES, STATS, FleetConfig
 from tempo_tpu.fleet import checkpoint as ck
 from tempo_tpu.fleet.placement import TenantPlacement
 
@@ -73,6 +74,10 @@ class FleetController:
         self._orphans: dict[str, list] = {}
         self._lock = threading.Lock()   # serializes tick/shutdown
         self.last_tick_ts = 0.0
+        # boot-time ingest-WAL replay runs exactly once, AFTER the boot
+        # restore pass populated the per-member watermarks (a second
+        # pass would re-apply scatter-adds)
+        self._wal_replayed = False
         # ring updates should react faster than the poll interval:
         # a KV publish nudges the loop awake
         kv = getattr(ring, "kv", None)
@@ -127,6 +132,19 @@ class FleetController:
                         _LOG.exception("fleet %s: shutdown checkpoint of "
                                        "%s failed", self.id, tenant)
 
+    def _shutdown_fence(self, inst) -> None:
+        """Best-effort in-flight fence for the shutdown (non-remove)
+        snapshot. The supported deployment entry (fleet.worker) JOINS
+        its HTTP handler threads before App.shutdown, so nothing is in
+        flight here; an embedding that keeps pushing through shutdown
+        still gets the bounded wait, shrinking the watermark-vs-gather
+        race snapshot_instance's caller contract describes."""
+        if not inst.wait_pushes_idle(5.0):
+            _LOG.warning("fleet %s: pushes still in flight for %s at "
+                         "shutdown snapshot — join handler threads "
+                         "before App.shutdown (fleet.worker does)",
+                         self.id, inst.tenant)
+
     # -- the watch tick ----------------------------------------------------
 
     def _held(self) -> list[str]:
@@ -148,6 +166,56 @@ class FleetController:
                                    self.id, tenant, new_owner)
             if self.cfg.restore_on_boot:
                 self._restore_owned()
+            if not self._wal_replayed:
+                # ingest-WAL replay: every tenant with local segments,
+                # past the watermark the restore pass (above) merged in.
+                # Owned or not — these acked records exist nowhere else;
+                # a non-owned tenant's replayed state hands off next tick.
+                self._wal_replayed = True
+                try:
+                    got = self.generator.replay_wal_all()
+                    if got["batches"] or got["dead_letters"]:
+                        _LOG.info(
+                            "fleet %s: WAL replay recovered %d batches "
+                            "across %d tenants (%d dead-lettered)",
+                            self.id, got["batches"], got["tenants"],
+                            got["dead_letters"])
+                except Exception:
+                    _LOG.exception("fleet %s: WAL replay failed", self.id)
+
+    def _write_checkpoint_blob(self, tenant: str, blob: bytes) -> None:
+        """Write one checkpoint blob with bounded jittered-backoff
+        retries: a transient store failure during a handoff otherwise
+        forces the whole reattach/orphan dance for nothing."""
+        delay = self.cfg.checkpoint_retry_backoff_s
+        for attempt in range(self.cfg.checkpoint_write_retries + 1):
+            try:
+                ck.write_checkpoint(
+                    self.writer, self.cfg.checkpoint_prefix, tenant, blob,
+                    ck.checkpoint_name(self.now(), self.id))
+                return
+            except Exception as e:
+                if attempt >= self.cfg.checkpoint_write_retries:
+                    raise
+                cause = type(e).__name__
+                RETRY_CAUSES[cause] = RETRY_CAUSES.get(cause, 0) + 1
+                _LOG.warning(
+                    "fleet %s: checkpoint write of %s failed (%s: %s), "
+                    "retry %d/%d", self.id, tenant, cause, e,
+                    attempt + 1, self.cfg.checkpoint_write_retries)
+                time.sleep(delay * (0.5 + random.random()))
+                delay = min(delay * 2, 5.0)
+
+    def _truncate_wal(self, tenant: str, inst) -> None:
+        """Drop WAL segments the just-written blob covers (the snapshot
+        recorded its own watermark on the instance)."""
+        try:
+            self.generator.truncate_wal(
+                tenant, getattr(inst, "checkpointed_wal_seq", None))
+        except Exception:
+            _LOG.exception("fleet %s: WAL truncation of %s failed "
+                           "(replay stays watermark-guarded)",
+                           self.id, tenant)
 
     def _retry_orphans(self) -> None:
         """Re-attempt checkpoints of handoff-popped instances whose
@@ -165,9 +233,8 @@ class FleetController:
                     continue
                 try:
                     blob = ck.snapshot_instance(inst)
-                    ck.write_checkpoint(
-                        self.writer, self.cfg.checkpoint_prefix, tenant,
-                        blob, ck.checkpoint_name(self.now(), self.id))
+                    self._write_checkpoint_blob(tenant, blob)
+                    self._truncate_wal(tenant, inst)
                     self.generator.release_instance_pages(inst)
                 except Exception:
                     _LOG.exception("fleet %s: orphan checkpoint of %s "
@@ -184,49 +251,66 @@ class FleetController:
         self._checkpoint(tenant, remove=True)
         STATS["handoffs"] += 1
 
+    def _orphan(self, tenant: str, inst) -> None:
+        """Stash a popped instance the tenant slot already replaced.
+        Its eventual checkpoint must NOT claim the tenant's WAL
+        watermark: the replacement instance owns the live WAL stream
+        now, and a claim here would truncate records whose state lives
+        only in the replacement."""
+        inst._wal_mark = None
+        self._orphans.setdefault(tenant, []).append(inst)
+
     def _checkpoint(self, tenant: str, remove: bool) -> None:
         if remove:
             # handoff order matters: POP first (later pushes build a
-            # fresh instance that the next tick hands off again), fence
-            # in-flight handler threads, and only then cut the snapshot
-            # — an acked push must always be in SOME checkpoint
+            # fresh instance that the next tick hands off again — and,
+            # with the WAL on, skip appends for the duration of the cut
+            # so the snapshot's watermark claim can never cover a
+            # replacement instance's records), fence in-flight handler
+            # threads, and only then cut the snapshot — an acked push
+            # must always be in SOME checkpoint
             inst = self.generator.pop_instance(tenant)
             if inst is None:
-                return
-            if not inst.wait_pushes_idle(5.0):
-                # NEVER checkpoint past the fence: a straggler scatter
-                # landing after the snapshot would be lost outright when
-                # the pages release below (acked push, zeroed page). The
-                # instance is detached, so no NEW push can enter it —
-                # put it back (or orphan it) and retry once it drains.
-                _LOG.warning("fleet %s: pushes still in flight for %s "
-                             "after 5s fence; handoff retried next tick",
-                             self.id, tenant)
-                if not self.generator.reattach_instance(tenant, inst):
-                    self._orphans.setdefault(tenant, []).append(inst)
+                self.generator.end_handoff(tenant)
                 return
             try:
-                blob = ck.snapshot_instance(inst)
-                ck.write_checkpoint(self.writer, self.cfg.checkpoint_prefix,
-                                    tenant, blob,
-                                    ck.checkpoint_name(self.now(), self.id))
-            except Exception:
-                # the pop already happened: a failed snapshot/write must
-                # not lose the accrued state or leak its pages — put the
-                # instance back (the lost() walk retries next tick), or
-                # stash it for the orphan loop if a straggler push
-                # already rebuilt the tenant slot
-                if not self.generator.reattach_instance(tenant, inst):
-                    self._orphans.setdefault(tenant, []).append(inst)
-                raise
-            self.generator.release_instance_pages(inst)
+                if not inst.wait_pushes_idle(5.0):
+                    # NEVER checkpoint past the fence: a straggler
+                    # scatter landing after the snapshot would be lost
+                    # outright when the pages release below (acked push,
+                    # zeroed page). The instance is detached, so no NEW
+                    # push can enter it — put it back (or orphan it) and
+                    # retry once it drains.
+                    _LOG.warning("fleet %s: pushes still in flight for "
+                                 "%s after 5s fence; handoff retried "
+                                 "next tick", self.id, tenant)
+                    if not self.generator.reattach_instance(tenant, inst):
+                        self._orphan(tenant, inst)
+                    return
+                try:
+                    blob = ck.snapshot_instance(inst)
+                    self._write_checkpoint_blob(tenant, blob)
+                except Exception:
+                    # the pop already happened: a failed snapshot/write
+                    # must not lose the accrued state or leak its pages
+                    # — put the instance back (the lost() walk retries
+                    # next tick), or stash it for the orphan loop if a
+                    # straggler push already rebuilt the tenant slot
+                    if not self.generator.reattach_instance(tenant, inst):
+                        self._orphan(tenant, inst)
+                    raise
+                self._truncate_wal(tenant, inst)
+                self.generator.release_instance_pages(inst)
+            finally:
+                self.generator.end_handoff(tenant)
             return
         inst = self.generator.instances.get(tenant)
         if inst is None:
             return
+        self._shutdown_fence(inst)
         blob = ck.snapshot_instance(inst)
-        ck.write_checkpoint(self.writer, self.cfg.checkpoint_prefix, tenant,
-                            blob, ck.checkpoint_name(self.now(), self.id))
+        self._write_checkpoint_blob(tenant, blob)
+        self._truncate_wal(tenant, inst)
 
     def _restore_owned(self) -> None:
         all_ckpts = ck.list_checkpoints(self.reader,

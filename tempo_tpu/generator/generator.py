@@ -33,11 +33,24 @@ class Generator:
                  overrides: Overrides | None = None,
                  instance_id: str = "generator-0",
                  registry: Registry | None = None,
-                 now: Callable[[], float] = time.time) -> None:
+                 now: Callable[[], float] = time.time,
+                 wal=None) -> None:
         self.base_cfg = cfg or GeneratorConfig()
         self.overrides = overrides or Overrides()
         self.id = instance_id
         self.now = now
+        # ingest WAL (generator/wal.py, None = disabled): every acked
+        # push is appended before the ack returns, replayed on boot past
+        # the fleet-checkpoint watermark — acked means durable
+        self.wal = wal
+        # tenants mid-handoff: their pushes SKIP the WAL append. The
+        # popped instance's snapshot claims the tenant's WAL watermark,
+        # and a replacement instance's record slipping under that claim
+        # would be truncated without being in any blob; during the
+        # (sub-second) cut, straggler durability rides the handoff
+        # protocol's next-tick checkpoint instead. Set atomically with
+        # the detach in pop_instance, cleared when the handoff concludes.
+        self._wal_skip: set[str] = set()
         self.instances: dict[str, GeneratorInstance] = {}
         self._cgroups: dict = {}      # group name → ConsumerGroup (kafka)
         self._lock = threading.Lock()
@@ -104,6 +117,9 @@ class Generator:
                 inst = GeneratorInstance(tenant, cfg, now=self.now)
                 inst._matview_limits = \
                     lambda t=tenant: self.overrides.for_tenant(t)
+                if self.wal is not None:
+                    inst._wal_mark = \
+                        lambda t=tenant: (self.id, *self.wal.watermark(t))
                 self.instances[tenant] = inst
             return inst
 
@@ -129,10 +145,19 @@ class Generator:
         to a fresh instance instead of scattering into the snapshot."""
         with self._lock:
             inst = self.instances.pop(tenant, None)
+            if inst is not None and self.wal is not None:
+                self._wal_skip.add(tenant)
         if inst is not None:
             with inst._push_cv:
                 inst.detached = True
         return inst
+
+    def end_handoff(self, tenant: str) -> None:
+        """Close the WAL-skip window a pop_instance opened (idempotent;
+        the fleet controller calls this once the cut concluded — blob
+        written + truncated, instance reattached, or orphaned)."""
+        with self._lock:
+            self._wal_skip.discard(tenant)
 
     def reattach_instance(self, tenant: str,
                           inst: "GeneratorInstance") -> bool:
@@ -148,10 +173,18 @@ class Generator:
             if tenant in self.instances:
                 return False
             self.instances[tenant] = inst
+            self._wal_skip.discard(tenant)   # WAL resumes with the inst
         with inst._push_cv:
             inst.detached = False
             inst._push_cv.notify_all()
         return True
+
+    def _wal_for(self, tenant: str):
+        """The WAL to append this tenant's pushes to, or None (WAL off,
+        or the tenant is mid-handoff — see _wal_skip)."""
+        if self.wal is None or tenant in self._wal_skip:
+            return None
+        return self.wal
 
     @contextlib.contextmanager
     def _tracked_push(self, tenant: str):
@@ -197,16 +230,24 @@ class Generator:
         inst = self.pop_instance(tenant)
         if inst is not None:
             self.release_instance_pages(inst)
+            self.end_handoff(tenant)
         return inst
 
     # -- write (PushSpans RPC analog; the distributor's GeneratorClient) ---
 
-    def push_spans(self, tenant: str, spans: Sequence[dict]) -> None:
+    def push_spans(self, tenant: str, spans: Sequence[dict],
+                   durable: bool = True) -> None:
         with self._tracked_push(tenant) as inst:
             self._push_spans(inst, spans)
+            wal = self._wal_for(tenant)
+            if durable and wal is not None:
+                # bus-driven pushes pass durable=False: the bus commits
+                # offsets AFTER processing, so it IS the replay log and
+                # a WAL record would double-apply on crash recovery
+                wal.append_spans(tenant, spans)
 
-    def _push_spans(self, inst: GeneratorInstance,
-                    spans: Sequence[dict]) -> None:
+    def _push_spans(self, inst: GeneratorInstance, spans: Sequence[dict],
+                    now_s: "float | None" = None) -> None:
         b = SpanBatchBuilder(inst.registry.interner)
         for s in spans:
             b.append(
@@ -222,10 +263,10 @@ class Generator:
                 end_unix_nano=int(s.get("end_unix_nano", 0)),
                 attrs=s.get("attrs"),
                 res_attrs=s.get("res_attrs"))
-        inst.push_batch(b.build())
+        inst.push_batch(b.build(), now_s=now_s)
 
-    def push_otlp(self, tenant: str, data: bytes,
-                  trusted: bool = False) -> int:
+    def push_otlp(self, tenant: str, data: bytes, trusted: bool = False,
+                  push_id: str | None = None) -> int:
         """OTLP ExportTraceServiceRequest bytes → series state, staged by
         the vectorized native-scan path. The reference's PushSpansRequest
         carries OTLP-shaped ResourceSpans (`tempo.proto` PushSpansRequest),
@@ -233,27 +274,81 @@ class Generator:
         per-span Python staging. Returns span count. `trusted` marks bytes
         already validated IN THIS PROCESS (the distributor's tee): the
         stage may skip re-validating attribute bytes; never set it for
-        wire input."""
-        from tempo_tpu.model.otlp_batch import batch_from_otlp
+        wire input. `push_id` (the RPC plane's X-Push-Id) makes retries
+        idempotent: a recently acked id returns its cached count without
+        re-scattering."""
+        from tempo_tpu.model.otlp_batch import batch_from_otlp, stage_otlp
 
         with self._tracked_push(tenant) as inst:
-            got = inst.push_otlp_staged(data, trusted=trusted)
-            if got is not None:
-                return got
-            need_span, need_res = inst.needs_attr_columns()
-            sb, sizes = batch_from_otlp(data, inst.registry.interner,
-                                        return_sizes=True,
-                                        include_span_attrs=need_span,
-                                        include_res_attrs=need_res,
-                                        trusted=trusted)
-            inst.push_batch(sb, span_sizes=sizes)
-            return sb.n
+            # dedupe states: an int is acked AND durable (done); a
+            # ("pending", n) tuple means a prior attempt scattered but
+            # its WAL append failed — the retry must redo ONLY the
+            # durability half, never the scatter (a second scatter
+            # double-counts; skipping the append leaves an acked push
+            # that a crash would silently lose)
+            seen = inst.seen_push(push_id) if push_id is not None else None
+            if isinstance(seen, int):
+                return seen
+            pending = seen[1] if seen is not None else None
+            wal = self._wal_for(tenant)
+            if self.wal is not None:
+                # WAL-enabled: stage ONCE, push through the staged-view
+                # route (fast StageRec or SpanBatch, picked inside), and
+                # append the staged columns — the same record shape the
+                # distributor tee logs, replayable into a fresh interner
+                need_span, need_res = inst.needs_attr_columns()
+                st = stage_otlp(data, inst.registry.interner,
+                                trusted=trusted,
+                                include_span_attrs=need_span,
+                                include_res_attrs=need_res)
+                if st is not None:
+                    view = st.view()
+                    if pending is not None:
+                        got = pending
+                    else:
+                        got = inst.push_staged_view(view)
+                    if got is not None:
+                        if push_id is not None:
+                            inst.note_push(push_id, ("pending", got))
+                        if wal is not None:
+                            wal.append_view(tenant, view, push_id=push_id)
+                        if push_id is not None:
+                            inst.note_push(push_id, got)
+                        return got
+            if pending is not None:
+                got = pending
+            else:
+                got = inst.push_otlp_staged(data, trusted=trusted)
+                if got is None:
+                    need_span, need_res = inst.needs_attr_columns()
+                    sb, sizes = batch_from_otlp(
+                        data, inst.registry.interner, return_sizes=True,
+                        include_span_attrs=need_span,
+                        include_res_attrs=need_res, trusted=trusted)
+                    inst.push_batch(sb, span_sizes=sizes)
+                    got = sb.n
+            if push_id is not None:
+                inst.note_push(push_id, ("pending", got))
+            if wal is not None:
+                # no staged product on this route (native staging off):
+                # log the raw payload instead — bigger record, same
+                # exactly-once replay contract
+                wal.append_otlp(tenant, data, trusted=trusted,
+                                push_id=push_id)
+            if push_id is not None:
+                inst.note_push(push_id, got)
+            return got
 
     def push_otlp_recs(self, tenant: str, raw: bytes, recs) -> int | None:
         """In-process distributor tee: scan records (any ring-sharded
         subset) + the ORIGINAL payload — no re-parse, no re-encode.
         Returns span count or None when this tenant needs the full
         staging path (caller sends payload bytes instead)."""
+        if self.wal is not None:
+            # the recs fast route has no WAL-able staged product (scan
+            # records carry raw-offset columns, not interner ids);
+            # declining routes the caller to push_otlp, which logs
+            return None
         with self._tracked_push(tenant) as inst:
             return inst.push_otlp_recs(raw, recs)
 
@@ -276,9 +371,132 @@ class Generator:
         """The zero-copy distributor tee: a row-index view over a shared
         decode-once staging (`model.otlp_batch.StagedView`). Returns the
         span count, or None when this instance cannot consume the view
-        (foreign interner) — the caller falls back to payload bytes."""
+        (foreign interner) — the caller falls back to payload bytes.
+
+        WAL append happens AFTER the scatter and BEFORE the ack returns
+        (acked-is-durable): both sit inside the tracked-push fence, so a
+        checkpoint's watermark — read after `wait_pushes_idle` — always
+        covers every record whose scatter the snapshot gathered."""
         with self._tracked_push(tenant) as inst:
-            return inst.push_staged_view(view)
+            got = inst.push_staged_view(view)
+            if got is not None:
+                wal = self._wal_for(tenant)
+                if wal is not None:
+                    wal.append_view(tenant, view)
+            return got
+
+    # -- ingest WAL (generator/wal.py): replay + truncation ----------------
+
+    def _apply_wal_record(self, tenant: str, meta: dict, arrays,
+                          seg_strings, idmap_cache: dict | None = None
+                          ) -> None:
+        """Replay ONE WAL record through the normal push paths with the
+        ORIGINAL push wall time (slack filtering must drop exactly what
+        the live push dropped). Raises on undecodable/declined records —
+        the WAL quarantines those to the dead-letter dir."""
+        import numpy as np
+
+        from tempo_tpu.generator import wal as wal_mod
+        from tempo_tpu.model.otlp_batch import batch_from_otlp, stage_otlp
+
+        kind = meta.get("kind")
+        ts = float(meta.get("ts", self.now()))
+        with self._tracked_push(tenant) as inst:
+            pid = meta.get("push_id")
+            if pid is not None and inst.seen_push(pid) is not None:
+                return                  # already applied this boot
+            if kind == "staged":
+                # idmap grows incrementally with the segment string
+                # table (cache keyed on the per-segment list identity):
+                # re-interning the full vocabulary per record would make
+                # replay O(records x strings)
+                c = idmap_cache if idmap_cache is not None else {}
+                # identity via a STRONG reference, never id(): a freed
+                # list's id is reusable (the PR-6 step-cache lesson)
+                if c.get("list") is not seg_strings:
+                    c.clear()
+                    c["list"] = seg_strings
+                    c["n"] = 0
+                    c["idmap"] = np.zeros(0, np.int32)
+                if len(seg_strings) > c["n"]:
+                    new = np.asarray(inst.registry.interner.intern_many(
+                        seg_strings[c["n"]:]), np.int32)
+                    c["idmap"] = np.concatenate([c["idmap"], new])
+                    c["n"] = len(seg_strings)
+                view = wal_mod.rebuild_view(inst.registry.interner, meta,
+                                            arrays, seg_strings,
+                                            c["idmap"])
+                got = inst.push_staged_view(view, now_s=ts)
+                if got is None:
+                    raise RuntimeError(
+                        "staged WAL record declined by the live instance")
+            elif kind == "otlp":
+                data = arrays["raw"].tobytes()
+                trusted = bool(meta.get("trusted"))
+                need_span, need_res = inst.needs_attr_columns()
+                st = stage_otlp(data, inst.registry.interner,
+                                trusted=trusted,
+                                include_span_attrs=need_span,
+                                include_res_attrs=need_res)
+                got = inst.push_staged_view(st.view(), now_s=ts) \
+                    if st is not None else None
+                if got is None:
+                    sb, sizes = batch_from_otlp(
+                        data, inst.registry.interner, return_sizes=True,
+                        include_span_attrs=need_span,
+                        include_res_attrs=need_res, trusted=trusted)
+                    inst.push_batch(sb, span_sizes=sizes, now_s=ts)
+                    got = sb.n
+            elif kind == "spans":
+                from tempo_tpu.rpc import _json_to_spans
+                self._push_spans(inst, _json_to_spans(meta["spans"]),
+                                 now_s=ts)
+                got = int(meta.get("n", 0))
+            else:
+                raise ValueError(f"unknown WAL record kind {kind!r}")
+            if pid is not None:
+                # re-seed the idempotency window: a client retry landing
+                # after crash-recovery must still dedupe
+                inst.note_push(pid, got)
+
+    def replay_wal(self, tenant: str,
+                   past_seq: "int | None" = None) -> dict:
+        """Replay this tenant's local WAL records past the watermark:
+        `past_seq=None` reads it from the instance's restored checkpoint
+        metadata (this member's entry; -1 = nothing restored, replay
+        everything still on disk)."""
+        if self.wal is None:
+            return {"batches": 0, "dead_letters": 0}
+        if past_seq is None:
+            wm = self.instance(tenant).wal_watermarks.get(self.id)
+            past_seq = int(wm[1]) if wm else -1
+        cache: dict = {}
+        return self.wal.replay(
+            tenant,
+            lambda meta, arrays, seg_strings, t=tenant:
+                self._apply_wal_record(t, meta, arrays, seg_strings,
+                                       idmap_cache=cache),
+            past_seq=past_seq)
+
+    def replay_wal_all(self) -> dict:
+        """Boot recovery: replay every tenant with WAL segments on disk
+        (ownership is irrelevant — these records exist nowhere else; the
+        fleet handoff moves replayed state to the right owner on the
+        next tick)."""
+        out = {"tenants": 0, "batches": 0, "dead_letters": 0}
+        if self.wal is None:
+            return out
+        for tenant in self.wal.tenants_on_disk():
+            got = self.replay_wal(tenant)
+            out["tenants"] += 1
+            out["batches"] += got["batches"]
+            out["dead_letters"] += got["dead_letters"]
+        return out
+
+    def truncate_wal(self, tenant: str, upto_seq: "int | None") -> None:
+        """Drop WAL segments wholly covered by a written checkpoint."""
+        if self.wal is not None and upto_seq is not None and upto_seq >= 0:
+            self.wal.truncate(tenant, upto_seq)
 
     # -- reads (frontend generator_query_range hook) -----------------------
 
@@ -347,7 +565,10 @@ class Generator:
                 for _tid, spans in decode_push(rec.value):
                     by_tenant.setdefault(rec.tenant, []).extend(spans)
             for tenant, spans in by_tenant.items():
-                self.push_spans(tenant, spans)
+                # durable=False: the bus commit (below) is the replay
+                # log for these spans; WAL-logging them too would
+                # double-apply on a crash before the commit
+                self.push_spans(tenant, spans, durable=False)
             if cg is not None:
                 cg.commit(p, recs[-1].offset + 1)    # generation-fenced
             else:
@@ -400,3 +621,5 @@ class Generator:
         for t in self._threads:
             t.join(timeout=2)
         self.collect_all()
+        if self.wal is not None:
+            self.wal.close()

@@ -63,6 +63,21 @@ class GeneratorInstance:
         self.spans_received = 0
         self.spans_filtered_slack = 0
         self._last_purge = 0.0
+        # ingest-WAL bookkeeping (generator/wal.py): `wal_watermarks`
+        # maps member instance_id -> [segment, seq] of the last WAL
+        # record covered by restored checkpoints — carried FORWARD
+        # through checkpoint handoffs so a member that restores its own
+        # state back never replays records an earlier checkpoint already
+        # holds. `_wal_mark` (set by Generator when the WAL is enabled)
+        # reads this member's live watermark at snapshot time.
+        self.wal_watermarks: dict[str, list] = {}
+        self._wal_mark = None
+        self.checkpointed_wal_seq: "int | None" = None
+        # idempotent RPC push dedupe: push-id -> span count of recently
+        # acked pushes. A client retrying a push whose RESPONSE was lost
+        # (timeout, owner kill) must not double-scatter; WAL replay
+        # re-seeds this so the window survives a crash-restart.
+        self._push_ids: "dict[str, int]" = {}
         # in-flight push tracking (fleet handoff barrier): a checkpoint
         # cut must not race an acked-but-still-scattering push
         self._pushes_inflight = 0
@@ -106,6 +121,19 @@ class GeneratorInstance:
         with self._push_cv:
             self._pushes_inflight -= 1
             self._push_cv.notify_all()
+
+    def seen_push(self, push_id: str):
+        """Recently seen push id state: an int span count (acked AND
+        durable), a ("pending", count) tuple (scattered, WAL append not
+        yet confirmed — a retry redoes only the append), or None."""
+        with self._lock:
+            return self._push_ids.get(push_id)
+
+    def note_push(self, push_id: str, result) -> None:
+        with self._lock:
+            self._push_ids[push_id] = result
+            while len(self._push_ids) > 512:   # bounded: FIFO eviction
+                self._push_ids.pop(next(iter(self._push_ids)))
 
     def wait_pushes_idle(self, timeout_s: float = 5.0) -> bool:
         """Block until no push is mid-flight (bounded); the fleet
@@ -177,11 +205,15 @@ class GeneratorInstance:
             return None
         return procs[0] if procs[0].supports_staged_fast_path() else None
 
-    def _slack_bounds(self) -> tuple[int, int]:
+    def _slack_bounds(self, now_s: "float | None" = None
+                      ) -> tuple[int, int]:
+        # now_s: WAL replay passes the ORIGINAL push wall time so the
+        # slack filter drops exactly the spans the live push dropped —
+        # replay at boot must be bit-identical to the uninterrupted run
         slack = self.cfg.ingestion_time_range_slack_s
         if slack <= 0:
             return 0, 0
-        now_ns = int(self.now() * 1e9)
+        now_ns = int((self.now() if now_s is None else now_s) * 1e9)
         return now_ns - int(slack * 1e9), now_ns + int(slack * 1e9)
 
     def push_otlp_recs(self, raw: bytes, recs) -> int | None:
@@ -199,7 +231,8 @@ class GeneratorInstance:
         self.spans_filtered_slack += got[1]
         return len(recs)
 
-    def push_staged_view(self, view) -> int | None:
+    def push_staged_view(self, view, now_s: "float | None" = None
+                         ) -> int | None:
         """Decode-once tee consumption: a row view over the distributor's
         shared staging. The dedicated-spanmetrics fast route feeds the
         StageRec rows straight to the fused resolve (no SpanBatch); every
@@ -219,13 +252,14 @@ class GeneratorInstance:
         proc = self._fast_spanmetrics()
         if proc is not None and not st.needs_service_fixup:
             spans = view.stage_rows()
-            lo, hi = self._slack_bounds()
+            lo, hi = self._slack_bounds(now_s)
             _n_valid, n_filtered = proc.push_staged(spans, lo, hi, weights=w)
             self.spans_received += len(spans)
             self.spans_filtered_slack += n_filtered
             return len(spans)
         sb, sizes = view.batch_slice()
-        self.push_batch(sb, span_sizes=sizes, sample_weights=w)
+        self.push_batch(sb, span_sizes=sizes, sample_weights=w,
+                        now_s=now_s)
         return view.n
 
     def push_otlp_staged(self, data: bytes, trusted: bool = False
@@ -262,9 +296,10 @@ class GeneratorInstance:
         return len(spans)
 
     def push_batch(self, sb: SpanBatch, span_sizes: np.ndarray | None = None,
-                   sample_weights: np.ndarray | None = None) -> None:
+                   sample_weights: np.ndarray | None = None,
+                   now_s: "float | None" = None) -> None:
         self.spans_received += sb.n
-        sb = self._apply_slack(sb)
+        sb = self._apply_slack(sb, now_s)
         # materialized query grids see the batch BEFORE the processor
         # fan: a grid (re)build backfills from local-blocks state, so
         # the backfill must not already contain this batch (the append
@@ -282,12 +317,12 @@ class GeneratorInstance:
             else:
                 proc.push_batch(sb)
 
-    def _apply_slack(self, sb: SpanBatch) -> SpanBatch:
+    def _apply_slack(self, sb: SpanBatch,
+                     now_s: "float | None" = None) -> SpanBatch:
         slack = self.cfg.ingestion_time_range_slack_s
         if slack <= 0:
             return sb
-        now_ns = int(self.now() * 1e9)
-        lo, hi = now_ns - int(slack * 1e9), now_ns + int(slack * 1e9)
+        lo, hi = self._slack_bounds(now_s)
         keep = (sb.end_unix_nano >= lo) & (sb.end_unix_nano <= hi)
         dropped = int((sb.valid & ~keep).sum())
         if dropped:

@@ -17,6 +17,8 @@ import urllib.parse
 import urllib.request
 from typing import Any, Callable
 
+from tempo_tpu.utils import faults
+
 
 class KVStore:
     """Thread-safe CAS store with key watches (dskit `kv.Client` analog)."""
@@ -39,6 +41,8 @@ class KVStore:
                       value: Any) -> tuple[bool, int]:
         """Conditional put for the HTTP KV service: succeeds only when the
         stored version matches. Returns (ok, current_version)."""
+        if faults.ARMED:
+            faults.fire("ring.kv.cas")
         with self._lock:
             ver, _ = self._data.get(key, (0, None))
             if ver != expect_version:
@@ -53,6 +57,8 @@ class KVStore:
             retries: int = 10) -> Any:
         """Read-modify-write with optimistic concurrency, like kv CAS loops
         (usage-stats leader election `pkg/usagestats/reporter.go:239`)."""
+        if faults.ARMED:
+            faults.fire("ring.kv.cas")
         for _ in range(retries):
             with self._lock:
                 ver, cur = self._data.get(key, (0, None))
@@ -161,6 +167,8 @@ class RemoteKVStore:
 
     def cas(self, key: str, update: Callable[[Any], Any],
             retries: int = 10) -> Any:
+        if faults.ARMED:
+            faults.fire("ring.kv.cas")
         for _ in range(retries):
             ver, cur = self._fetch(key)
             new = update(cur)
@@ -392,6 +400,9 @@ class ReplicatedKVStore:
         (ring maps); last-write-wins for everything else. NOTE: `update`
         runs once per member, concurrently — it must be a pure function
         of its argument."""
+        if faults.ARMED:
+            faults.fire("ring.kv.cas")
+
         def member_cas(ep):
             for _ in range(retries):
                 ver, cur = ep.fetch(key)

@@ -16,11 +16,16 @@ service objects.
 from __future__ import annotations
 
 import json
+import random
+import time
+import urllib.error
 import urllib.parse
 import urllib.request
+import uuid
 from typing import Sequence
 
 from tempo_tpu.ingest.encoding import decode_push, encode_push
+from tempo_tpu.utils import faults
 
 
 def _check_single_record(records: list[bytes]) -> bytes:
@@ -34,10 +39,12 @@ class _BaseClient:
         self.timeout = timeout_s
 
     def _post(self, path: str, body: bytes, tenant: str,
-              ctype: str = "application/x-tempo-push") -> dict:
-        req = urllib.request.Request(
-            self.base + path, data=body,
-            headers={"Content-Type": ctype, "X-Scope-OrgID": tenant})
+              ctype: str = "application/x-tempo-push",
+              headers: dict | None = None) -> dict:
+        h = {"Content-Type": ctype, "X-Scope-OrgID": tenant}
+        if headers:
+            h.update(headers)
+        req = urllib.request.Request(self.base + path, data=body, headers=h)
         with urllib.request.urlopen(req, timeout=self.timeout) as r:
             return json.loads(r.read() or b"{}")
 
@@ -50,17 +57,30 @@ class _BaseClient:
             return json.loads(r.read() or b"{}")
 
 
+def _push_retryable(e: BaseException) -> bool:
+    """Transport failures and gateway-class 5xx are worth retrying; a
+    4xx is the payload's fault and retrying re-offers the same bytes."""
+    if isinstance(e, urllib.error.HTTPError):
+        return e.code in (502, 503, 504)
+    return isinstance(e, (urllib.error.URLError, TimeoutError,
+                          ConnectionError, OSError))
+
+
 class RemoteIngesterClient(_BaseClient):
     """IngesterClient + IngesterQueryClient over HTTP (`Pusher.PushBytesV2`
     + `Querier` RPCs)."""
 
     def push(self, tenant: str,
              traces: Sequence[tuple[bytes, list[dict]]]) -> list[str | None]:
+        if faults.ARMED:
+            faults.fire("rpc.push")
         body = _check_single_record(encode_push(traces, max_record_bytes=1 << 62))
         res = self._post("/internal/ingester/push", body, tenant)
         return res.get("errors", [None] * len(traces))
 
     def push_otlp(self, tenant: str, payload: bytes) -> dict[str, str]:
+        if faults.ARMED:
+            faults.fire("rpc.push")
         res = self._post("/internal/ingester/push_otlp", payload, tenant,
                          ctype="application/x-protobuf")
         return res.get("errors", {})
@@ -97,6 +117,8 @@ class RemoteGeneratorClient(_BaseClient):
     """GeneratorClient over HTTP (`MetricsGenerator.PushSpans`)."""
 
     def push_spans(self, tenant: str, spans: Sequence[dict]) -> None:
+        if faults.ARMED:
+            faults.fire("rpc.push")
         groups: dict[bytes, list[dict]] = {}
         for s in spans:
             groups.setdefault(s.get("trace_id", b""), []).append(s)
@@ -104,10 +126,28 @@ class RemoteGeneratorClient(_BaseClient):
             encode_push(list(groups.items()), max_record_bytes=1 << 62))
         self._post("/internal/generator/push", body, tenant)
 
-    def push_otlp(self, tenant: str, data: bytes) -> int:
-        res = self._post("/internal/generator/push_otlp", data, tenant,
-                         ctype="application/x-protobuf")
-        return int(res.get("spans", 0))
+    def push_otlp(self, tenant: str, data: bytes, retries: int = 2) -> int:
+        """Idempotent push: every attempt carries the SAME X-Push-Id, so
+        a retry after a lost response (timeout, receiver kill) dedupes
+        server-side against the receiver's recent-push window instead of
+        double-scattering. Transient transport errors / gateway 5xx
+        retry with jittered backoff; the caller (distributor tee)
+        re-resolves the ring owner on final failure."""
+        push_id = uuid.uuid4().hex
+        delay = 0.05
+        for attempt in range(retries + 1):
+            try:
+                if faults.ARMED:
+                    faults.fire("rpc.push")
+                res = self._post("/internal/generator/push_otlp", data,
+                                 tenant, ctype="application/x-protobuf",
+                                 headers={"X-Push-Id": push_id})
+                return int(res.get("spans", 0))
+            except Exception as e:
+                if attempt >= retries or not _push_retryable(e):
+                    raise
+                time.sleep(delay * (0.5 + random.random()))
+                delay = min(delay * 2, 1.0)
 
     def query_range(self, tenant: str, req, clip_start_ns: int | None = None):
         from tempo_tpu.traceql.engine_metrics import TimeSeries

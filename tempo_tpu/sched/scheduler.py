@@ -69,6 +69,8 @@ from typing import Callable, Sequence
 
 import numpy as np
 
+from tempo_tpu.utils import faults
+
 from tempo_tpu.obs import devtime
 from tempo_tpu.utils import tracing
 
@@ -850,6 +852,8 @@ class DeviceScheduler:
             # the WHOLE build+dispatch sits under the guard: a failure
             # anywhere (allocation, a bad job array, the kernel itself)
             # must land on the jobs, never escape to kill the worker
+            if faults.ARMED:
+                faults.fire("sched.dispatch")
             bucket = bucket_rows(max(rows, 1), self.cfg.min_bucket_rows)
             if g.align > 1 and bucket % g.align:
                 # serving mesh: the padded window must split evenly over

@@ -1,1 +1,15 @@
 """Shared utilities (the analog of the reference's `pkg/` helpers)."""
+
+import os as _os
+
+
+def fsync_dir(path: str) -> None:
+    """Persist a directory's entries themselves: after creating,
+    renaming, or deleting a file, the DIRENT is only crash-durable once
+    the directory fd is fsynced (both WALs — block/wal.py and
+    generator/wal.py — depend on this for their recovery contracts)."""
+    dfd = _os.open(path, _os.O_RDONLY)
+    try:
+        _os.fsync(dfd)
+    finally:
+        _os.close(dfd)

@@ -64,6 +64,12 @@ def _reset_device_scheduler():
     from tempo_tpu import matview
 
     matview.reset()
+    # the fault-injection registry is process-wide and module-flag
+    # gated; a test (or an App built with faults armed) must never
+    # leak injected failures into later tests
+    from tempo_tpu.utils import faults
+
+    faults.reset()
 
 
 # ---------------------------------------------------------------------------
@@ -81,6 +87,16 @@ def _reset_device_scheduler():
 # with TEMPO_TEST_NO_TIME_GUARD=1.
 
 _RUNTIME_BUDGET_S = 10.0
+# explicit, per-test budget exceptions — each must say WHY. The point
+# of the guard is surfacing slow tests in the PR that adds them; an
+# entry here is that surfacing, not an escape hatch.
+_BUDGET_OVERRIDES = {
+    # two REAL fleet-worker process boots (~4s of jax+App init each,
+    # irreducible) around a SIGKILL: the ingest-WAL crash-recovery
+    # contract cannot be exercised in-process
+    "tests/test_fleet.py::test_sigkill_restart_replays_wal_bit_identically":
+        25.0,
+}
 _GRANDFATHERED_MODULES = frozenset({
     "test_app.py", "test_aux.py", "test_backend.py",
     "test_bench_orchestration.py", "test_block.py", "test_cli.py",
@@ -106,7 +122,9 @@ def pytest_runtest_logreport(report):
     module = report.nodeid.split("::", 1)[0].rsplit("/", 1)[-1]
     guarded = module not in _GRANDFATHERED_MODULES \
         or "moments" in report.nodeid
-    if guarded and report.duration > _RUNTIME_BUDGET_S:
+    budget = _BUDGET_OVERRIDES.get(report.nodeid.split("[", 1)[0],
+                                   _RUNTIME_BUDGET_S)
+    if guarded and report.duration > budget:
         _runtime_offenders.append((report.nodeid, report.duration))
 
 

@@ -395,6 +395,105 @@ generator:
     assert p.wait(timeout=15) is not None
 
 
+def test_sigkill_restart_replays_wal_bit_identically(fleet_procs,
+                                                     tmp_path):
+    """The SIGKILL variant of the worker handoff test: kill -9 a member
+    (no drain, no shutdown checkpoint), restart it over the same dirs,
+    and assert the ingest-WAL replay restores every ACKED push —
+    collect() and quantile() bit-identical to an uninterrupted in-process
+    oracle fed the same payloads."""
+    import json
+    import socket
+    import urllib.request
+
+    import numpy as np
+
+    from tempo_tpu.generator.generator import Generator
+    from tempo_tpu.model.otlp import encode_spans_otlp
+    from tempo_tpu.overrides import Overrides
+    from tempo_tpu.overrides.limits import Limits
+    from tempo_tpu.rpc import RemoteGeneratorClient
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    cfg = tmp_path / "member.yaml"
+    cfg.write_text(f"""
+target: metrics-generator
+server: {{http_listen_port: {port}}}
+ring_kv_url: local
+usage_stats_enabled: false
+storage:
+  backend: local
+  local_path: {tmp_path}/blocks
+  wal_path: {tmp_path}/wal
+wal: {{enabled: true, dir: {tmp_path}/gwal}}
+fleet: {{enabled: true, rebalance_interval_s: 5.0}}
+distributor: {{generator_placement: tenant}}
+generator:
+  processors: [span-metrics]
+overrides_defaults:
+  generator:
+    processors: [span-metrics]
+    max_active_series: 2048
+    ingestion_time_range_slack_s: 0.0
+    collection_interval_s: 3600.0
+    sketch: dd
+""")
+    rng = np.random.default_rng(11)
+    now_ns = int(NOW * 1e9)
+    payloads = [encode_spans_otlp([
+        dict(trace_id=rng.bytes(16), span_id=rng.bytes(8),
+             name=f"op-{i % 4}", service=f"svc-{i % 3}", kind=2,
+             status_code=0, start_unix_nano=now_ns,
+             end_unix_nano=now_ns + int(rng.integers(1, 5e8)))
+        for i in range(24)]) for _ in range(3)]
+
+    p = fleet_procs(["--config", str(cfg)])
+    client = RemoteGeneratorClient(f"http://127.0.0.1:{port}",
+                                   timeout_s=30.0)
+    for pl in payloads:
+        assert client.push_otlp("t1", pl) == 24
+    p.kill()                             # SIGKILL: nothing drains
+    assert p.wait(timeout=10) is not None
+
+    p2 = fleet_procs(["--config", str(cfg)])   # same dirs, same WAL
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{p2.ready['port']}"
+        "/internal/generator/collect?ts_ms=1",
+        headers={"X-Scope-OrgID": "t1"})
+    doc = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    got = {(s["name"], tuple(tuple(kv) for kv in s["labels"])):
+           s["value"] for s in doc["samples"]}
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{p2.ready['port']}"
+        "/internal/generator/quantile?q=0.99",
+        headers={"X-Scope-OrgID": "t1"})
+    qdoc = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    got_q = {tuple(tuple(kv) for kv in e["labels"]): e["value"]
+             for e in qdoc["quantiles"]}
+
+    lim = Limits()
+    lim.generator.processors = ("span-metrics",)
+    lim.generator.max_active_series = 2048
+    lim.generator.ingestion_time_range_slack_s = 0.0
+    lim.generator.collection_interval_s = 3600.0
+    lim.generator.sketch = "dd"
+    oracle = Generator(GeneratorConfig(), instance_id="oracle",
+                       overrides=Overrides(defaults=lim))
+    for pl in payloads:
+        oracle.push_otlp("t1", pl)
+    inst = oracle.instance("t1")
+    inst.drain()
+    want = {(s.name, tuple(s.labels)): s.value
+            for s in inst.registry.collect(ts_ms=1)
+            if not s.is_stale_marker}
+    _assert_merge_equal(got, want)
+    want_q = {tuple(k): v for k, v in
+              inst.processors["span-metrics"].quantile(0.99).items()}
+    assert got_q == want_q
+
+
 def test_kv_only_worker(fleet_procs):
     """The standalone /kv CAS server speaks the RemoteKVStore wire."""
     from tempo_tpu.ring.kv import RemoteKVStore

@@ -1,0 +1,556 @@
+"""Generator ingest WAL + fault-injection registry (ISSUE 14).
+
+Durability contract: every acked push is in the WAL (append before
+ack), boot replay past the checkpoint watermark is bit-identical to the
+uninterrupted run and exactly-once, torn tails and poison records
+degrade to counted skips/quarantines — never to a crash-loop or a
+double-count. Fault points are deterministic, zero-cost disarmed, and
+refused by config.check unless explicitly allowed.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tempo_tpu.backend.mem import MemBackend
+from tempo_tpu.fleet import checkpoint as ck
+from tempo_tpu.generator.generator import Generator
+from tempo_tpu.generator.instance import GeneratorConfig
+from tempo_tpu.generator.wal import (
+    STATS,
+    GeneratorWal,
+    IngestWalConfig,
+    decode_record,
+)
+from tempo_tpu.model.otlp import encode_spans_otlp
+from tempo_tpu.overrides import Overrides
+from tempo_tpu.overrides.limits import Limits
+from tempo_tpu.utils import faults
+
+NOW = time.time()
+
+
+def _limits() -> Limits:
+    lim = Limits()
+    lim.generator.processors = ("span-metrics",)
+    lim.generator.max_active_series = 2048
+    lim.generator.ingestion_time_range_slack_s = 0.0
+    lim.generator.collection_interval_s = 3600.0
+    return lim
+
+
+def _payload(seed: int, n: int = 24) -> bytes:
+    rng = np.random.default_rng(seed)
+    return encode_spans_otlp([
+        dict(trace_id=rng.bytes(16), span_id=rng.bytes(8),
+             name=f"op-{i % 4}", service=f"svc-{i % 3}", kind=2,
+             status_code=int(i % 5 == 0) * 2,
+             start_unix_nano=int(NOW * 1e9),
+             end_unix_nano=int(NOW * 1e9) + int(rng.integers(1, 5e8)),
+             attrs={"k": f"v{i % 2}"})
+        for i in range(n)])
+
+
+def _mkgen(tmp_path, iid: str = "m0", sub: str = "wal") -> Generator:
+    wal = GeneratorWal(IngestWalConfig(
+        enabled=True, dir=str(tmp_path / sub)))
+    return Generator(GeneratorConfig(), instance_id=iid,
+                     overrides=Overrides(defaults=_limits()), wal=wal)
+
+
+def _collect(gen: Generator, tenant: str) -> dict:
+    inst = gen.instance(tenant)
+    inst.drain()
+    return {(s.name, s.labels): s.value
+            for s in inst.registry.collect(ts_ms=1)
+            if not s.is_stale_marker}
+
+
+# ---------------------------------------------------------------------------
+# WAL append + replay
+# ---------------------------------------------------------------------------
+
+
+def test_replay_after_simulated_kill_is_bit_identical(tmp_path):
+    """Abandon a generator (no shutdown, no checkpoint — the kill -9
+    shape), rebuild over the same WAL dir: replay restores collect()
+    AND quantile() bit-identically, exactly once."""
+    g1 = _mkgen(tmp_path)
+    for seed in (1, 2, 3):
+        g1.push_otlp("t1", _payload(seed))
+    want = _collect(g1, "t1")
+    want_q = g1.instance("t1").processors["span-metrics"].quantile(0.99)
+
+    g2 = _mkgen(tmp_path)
+    got_stats = g2.replay_wal_all()
+    assert got_stats == {"tenants": 1, "batches": 3, "dead_letters": 0}
+    assert _collect(g2, "t1") == want
+    assert g2.instance("t1").processors["span-metrics"].quantile(0.99) \
+        == want_q
+
+
+def test_staged_view_record_round_trips_sample_weights(tmp_path):
+    """A sampled push's Horvitz-Thompson weights ride the WAL record:
+    the replayed weighted rates match the live weighted rates."""
+    from tempo_tpu.model.otlp_batch import stage_otlp
+
+    g1 = _mkgen(tmp_path)
+    inst = g1.instance("t1")
+    st = stage_otlp(_payload(7), inst.registry.interner)
+    if st is None:
+        pytest.skip("native staging unavailable")
+    w = np.linspace(1.0, 4.0, st.n).astype(np.float32)
+    st.sample_weight = w
+    assert g1.push_staged_view("t1", st.view()) == st.n
+    want = _collect(g1, "t1")
+    calls = [v for (name, _l), v in want.items()
+             if name == "traces_spanmetrics_calls_total"]
+    assert calls and not np.allclose(sum(calls), st.n)  # weights applied
+
+    g2 = _mkgen(tmp_path)
+    assert g2.replay_wal_all()["batches"] == 1
+    assert _collect(g2, "t1") == want
+
+
+def test_checkpoint_watermark_truncates_and_bounds_replay(tmp_path):
+    """Records ≤ the snapshot watermark live in the blob (segments
+    truncate once it lands); restore + replay applies each acked batch
+    exactly once — the uninterrupted oracle matches bit-identically."""
+    be = MemBackend()
+    g1 = _mkgen(tmp_path)
+    for seed in (1, 2):
+        g1.push_otlp("t1", _payload(seed))
+    inst = g1.instance("t1")
+    blob = ck.snapshot_instance(inst)
+    assert inst.checkpointed_wal_seq == 1
+    ck.write_checkpoint(be, "fleet-checkpoints", "t1", blob,
+                        ck.checkpoint_name(NOW, "m0"))
+    t0 = STATS["truncated_segments"]
+    g1.truncate_wal("t1", inst.checkpointed_wal_seq)
+    assert STATS["truncated_segments"] > t0
+    assert g1.wal._tw("t1").segments() == []
+    for seed in (3, 4):
+        g1.push_otlp("t1", _payload(seed))
+    want = _collect(g1, "t1")
+
+    g2 = _mkgen(tmp_path)
+    inst2 = g2.instance("t1")
+    ck.restore_instance(inst2, blob)
+    assert inst2.wal_watermarks == {"m0": [0, 1]}
+    assert g2.replay_wal_all()["batches"] == 2   # only seqs 2..3
+    assert _collect(g2, "t1") == want
+
+    # oracle: the same four pushes, never interrupted
+    oracle = Generator(GeneratorConfig(), instance_id="oracle",
+                       overrides=Overrides(defaults=_limits()))
+    for seed in (1, 2, 3, 4):
+        oracle.push_otlp("t1", _payload(seed))
+    assert _collect(g2, "t1") == _collect(oracle, "t1")
+
+
+def test_torn_tail_is_skipped_not_fatal(tmp_path):
+    """A crash mid-append leaves a torn frame at the segment tail:
+    replay recovers every COMPLETE record and counts the tear."""
+    g1 = _mkgen(tmp_path)
+    g1.push_otlp("t1", _payload(1))
+    want = _collect(g1, "t1")
+    tw = g1.wal._tw("t1")
+    seg = os.path.join(tw.dir, tw.segments()[-1])
+    with open(seg, "ab") as f:
+        f.write(b"TWR1" + b"\x22" * 9)   # half a header, then nothing
+    torn0 = STATS["torn_frames"]
+    g2 = _mkgen(tmp_path)
+    assert g2.replay_wal_all()["batches"] == 1
+    assert STATS["torn_frames"] > torn0
+    assert _collect(g2, "t1") == want
+
+
+def test_poison_record_dead_letters_instead_of_crash_looping(tmp_path):
+    """A record that deterministically raises quarantines to
+    deadletter/ (original payload intact, decodable) and replay keeps
+    going — later records still restore."""
+    from tempo_tpu.generator.wal import _encode_record
+
+    g1 = _mkgen(tmp_path)
+    g1.push_otlp("t1", _payload(1))
+    # hand-append a poison record between two good ones
+    tw = g1.wal._tw("t1")
+    tw.append(_encode_record({"v": 1, "kind": "bogus", "ts": NOW}, {}))
+    g1.push_otlp("t1", _payload(2))
+    want = _collect(g1, "t1")
+
+    g2 = _mkgen(tmp_path)
+    got = g2.replay_wal_all()
+    assert got == {"tenants": 1, "batches": 2, "dead_letters": 1}
+    assert _collect(g2, "t1") == want
+    dl_dir = os.path.join(str(tmp_path / "wal"), "t1", "deadletter")
+    files = sorted(os.listdir(dl_dir))
+    assert files == ["000000000001.rec", "000000000001.strings.json"]
+    with open(os.path.join(dl_dir, files[0]), "rb") as f:
+        meta, _arrays = decode_record(f.read())
+    assert meta["kind"] == "bogus"
+
+
+def test_fsync_policies_and_rotation(tmp_path):
+    cfg = IngestWalConfig(enabled=True, dir=str(tmp_path / "w"),
+                          fsync="off", segment_max_bytes=1 << 20)
+    wal = GeneratorWal(cfg)
+    f0 = STATS["fsyncs"]
+    g = Generator(GeneratorConfig(), overrides=Overrides(
+        defaults=_limits()), wal=wal)
+    g.push_otlp("t1", _payload(1))
+    assert STATS["fsyncs"] == f0          # off: no per-append fsync
+    wal.cfg.fsync = "batch"
+    g.push_otlp("t1", _payload(2))
+    assert STATS["fsyncs"] == f0 + 1
+    # rotation by size: shrink the bound so the next append rotates
+    wal.cfg.segment_max_bytes = 1 << 20
+    tw = wal._tw("t1")
+    tw.cfg = wal.cfg
+    before = len(tw.segments())
+    tw._seg_bytes = wal.cfg.segment_max_bytes  # force the size bound
+    g.push_otlp("t1", _payload(3))
+    assert len(tw.segments()) == before + 1
+    # watermark names the newest segment + last seq
+    assert wal.watermark("t1") == (2, 2)
+
+
+def test_push_id_dedupe_survives_replay(tmp_path):
+    """A retried push (same X-Push-Id) after a lost response applies
+    once — live AND after a crash-restart (the WAL record re-seeds the
+    dedupe window)."""
+    g1 = _mkgen(tmp_path)
+    n = g1.push_otlp("t1", _payload(1), push_id="abc")
+    assert g1.push_otlp("t1", _payload(1), push_id="abc") == n
+    want = _collect(g1, "t1")
+    one = Generator(GeneratorConfig(), instance_id="one",
+                    overrides=Overrides(defaults=_limits()))
+    one.push_otlp("t1", _payload(1))
+    assert want == _collect(one, "t1")    # second send never scattered
+
+    g2 = _mkgen(tmp_path)
+    g2.replay_wal_all()
+    assert _collect(g2, "t1") == want
+    # the retry landing AFTER recovery still dedupes
+    assert g2.push_otlp("t1", _payload(1), push_id="abc") == n
+    assert _collect(g2, "t1") == want
+
+
+def test_push_otlp_recs_declines_when_wal_enabled(tmp_path):
+    g = _mkgen(tmp_path)
+    assert g.push_otlp_recs("t1", b"", None) is None
+
+
+def test_pending_retry_redoes_only_the_append(tmp_path):
+    """A push whose scatter landed but whose WAL append failed leaves a
+    PENDING dedupe entry: the client retry (same push id) must not
+    re-scatter, must re-append, and the batch ends both counted once
+    and durable."""
+    g = _mkgen(tmp_path)
+    spec = faults.FaultSpec(point="wal.fsync", probability=1.0, count=1)
+    with faults.use([spec]):
+        with pytest.raises(OSError):
+            g.push_otlp("t1", _payload(1), push_id="r1")
+    assert g.instance("t1").seen_push("r1") == ("pending", 24)
+    # retry: append succeeds this time, entry finalizes
+    assert g.push_otlp("t1", _payload(1), push_id="r1") == 24
+    assert g.instance("t1").seen_push("r1") == 24
+    want = _collect(g, "t1")
+    one = Generator(GeneratorConfig(), instance_id="one",
+                    overrides=Overrides(defaults=_limits()))
+    one.push_otlp("t1", _payload(1))
+    assert want == _collect(one, "t1")   # scattered exactly once
+    # and the record IS durable now: two frames on disk (the failed
+    # attempt's unsynced frame + the retry's), replay applies one
+    # (push-id dedupe re-seeded from the first record replayed)
+    g2 = _mkgen(tmp_path)
+    g2.replay_wal_all()
+    assert _collect(g2, "t1") == want
+
+
+def test_checkpoint_floor_bounds_replay_without_blob(tmp_path):
+    """Finding-5 shape: a watermark landing mid-segment truncates no
+    whole segment, and the member restarts NOT restoring the covering
+    blob (ownership moved, blob consumed by a peer). The persisted
+    CHECKPOINTED floor must still bound replay — below-floor records
+    are in the blob's lineage and re-applying them double-counts."""
+    g1 = _mkgen(tmp_path)
+    for seed in (1, 2):
+        g1.push_otlp("t1", _payload(seed))
+    inst = g1.instance("t1")
+    ck.snapshot_instance(inst)           # blob discarded on purpose
+    g1.truncate_wal("t1", inst.checkpointed_wal_seq)
+    # mid-segment watermark: the open segment holds seqs 0..2 after one
+    # more push, nothing truncates
+    g1.push_otlp("t1", _payload(3))
+    assert g1.wal._tw("t1").segments() != []
+    assert g1.wal._tw("t1").checkpoint_floor() == 1
+
+    g2 = _mkgen(tmp_path)                # restart, NO blob restored
+    got = g2.replay_wal_all()
+    assert got["batches"] == 1           # only seq 2, past the floor
+    oracle = Generator(GeneratorConfig(), instance_id="o",
+                       overrides=Overrides(defaults=_limits()))
+    oracle.push_otlp("t1", _payload(3))
+    assert _collect(g2, "t1") == _collect(oracle, "t1")
+
+
+def test_interner_replacement_rotates_segment(tmp_path):
+    """A replaced tenant instance brings a FRESH interner (new id
+    space): appends must rotate to a fresh segment whose string table
+    starts from zero, or replayed ids would resolve through the OLD
+    instance's strings — silent series misattribution."""
+    rng = np.random.default_rng(31)
+
+    def _pl(prefix: str) -> bytes:
+        return encode_spans_otlp([
+            dict(trace_id=rng.bytes(16), span_id=rng.bytes(8),
+                 name=f"{prefix}-op-{i % 3}", service=f"{prefix}-svc",
+                 kind=2, status_code=0, start_unix_nano=int(NOW * 1e9),
+                 end_unix_nano=int(NOW * 1e9) + int(2e8))
+            for i in range(12)])
+
+    g = _mkgen(tmp_path)
+    g.push_otlp("t1", _pl("a"))
+    g.remove_instance("t1")              # instance + interner replaced
+    g.push_otlp("t1", _pl("b"))          # fresh interner, same WAL
+    assert len(g.wal._tw("t1").segments()) == 2   # forced rotation
+
+    g2 = _mkgen(tmp_path)
+    assert g2.replay_wal_all() == {"tenants": 1, "batches": 2,
+                                   "dead_letters": 0}
+    got = _collect(g2, "t1")
+    names = {dict(labels).get("span_name") for (_n, labels) in got}
+    assert any(n and n.startswith("a-op") for n in names)
+    assert any(n and n.startswith("b-op") for n in names)
+    # oracle: both payloads into ONE instance — replay merges the two
+    # instance generations into the live registry the same way
+    oracle = Generator(GeneratorConfig(), instance_id="oi",
+                       overrides=Overrides(defaults=_limits()))
+    rng2 = np.random.default_rng(31)
+
+    def _pl2(prefix: str) -> bytes:
+        return encode_spans_otlp([
+            dict(trace_id=rng2.bytes(16), span_id=rng2.bytes(8),
+                 name=f"{prefix}-op-{i % 3}", service=f"{prefix}-svc",
+                 kind=2, status_code=0, start_unix_nano=int(NOW * 1e9),
+                 end_unix_nano=int(NOW * 1e9) + int(2e8))
+            for i in range(12)])
+    oracle.push_otlp("t1", _pl2("a"))
+    oracle.push_otlp("t1", _pl2("b"))
+    assert got == _collect(oracle, "t1")
+
+
+def test_seq_counter_survives_full_truncation_restart(tmp_path):
+    """After a checkpoint truncates EVERY segment, a restarted process
+    must seed its seq counter past the persisted floor — reusing seqs
+    at or below it would make the next replay silently skip freshly
+    acked records."""
+    g1 = _mkgen(tmp_path)
+    for seed in (1, 2):
+        g1.push_otlp("t1", _payload(seed))
+    inst = g1.instance("t1")
+    ck.snapshot_instance(inst)
+    g1.truncate_wal("t1", inst.checkpointed_wal_seq)
+    assert g1.wal._tw("t1").segments() == []
+
+    g2 = _mkgen(tmp_path)                # restart over the empty WAL
+    g2.push_otlp("t1", _payload(3))
+    assert g2.wal.watermark("t1") == (2, 2)    # floor 1 → next seq 2
+    want = _collect(g2, "t1")
+
+    g3 = _mkgen(tmp_path)                # crash again: replay seq 2
+    assert g3.replay_wal_all()["batches"] == 1
+    oracle = Generator(GeneratorConfig(), instance_id="o2",
+                       overrides=Overrides(defaults=_limits()))
+    oracle.push_otlp("t1", _payload(3))
+    assert _collect(g3, "t1") == _collect(oracle, "t1")
+    assert want == _collect(oracle, "t1")
+
+
+def test_handoff_window_skips_wal_and_never_claims_foreign_records(
+        tmp_path):
+    """During a handoff cut (pop → blob → truncate), a straggler push
+    builds a replacement instance whose records must NOT enter the WAL:
+    the popped instance's snapshot claims the tenant watermark, and a
+    foreign record under that claim would truncate without being in any
+    blob. After end_handoff the WAL resumes."""
+    g = _mkgen(tmp_path)
+    g.push_otlp("t1", _payload(1))
+    old = g.pop_instance("t1")           # opens the skip window
+    n0 = STATS["appended_batches"]
+    g.push_otlp("t1", _payload(2))       # straggler → fresh instance
+    assert STATS["appended_batches"] == n0      # skipped
+    blob_seq_claim = None
+    assert old.wait_pushes_idle(2.0)
+    ck.snapshot_instance(old)
+    blob_seq_claim = old.checkpointed_wal_seq
+    assert blob_seq_claim == 0           # only the old instance's record
+    g.end_handoff("t1")
+    g.push_otlp("t1", _payload(3))       # WAL resumes
+    assert STATS["appended_batches"] == n0 + 1
+
+
+# ---------------------------------------------------------------------------
+# fault-injection registry
+# ---------------------------------------------------------------------------
+
+
+def test_faults_deterministic_and_bounded():
+    spec = faults.FaultSpec(point="backend.write", probability=0.5,
+                            count=3)
+    fired = []
+    for trial in range(2):
+        with faults.use([spec], seed=42):
+            hits = []
+            for i in range(40):
+                try:
+                    faults.fire("backend.write")
+                    hits.append(0)
+                except OSError:
+                    hits.append(1)
+            fired.append(hits)
+            assert faults.stats()["backend.write"] == 3  # count cap
+    assert fired[0] == fired[1]           # same seed, same schedule
+    assert not faults.ARMED               # context exit disarms
+
+
+def test_faults_latency_only_and_after():
+    spec = faults.FaultSpec(point="rpc.push", probability=1.0, after=2,
+                            latency_s=0.0, error="none")
+    with faults.use([spec]):
+        faults.fire("rpc.push")           # skipped: after=2
+        faults.fire("rpc.push")
+        faults.fire("rpc.push")           # fires, but error="none"
+        assert faults.stats()["rpc.push"] == 1
+
+
+def test_faults_config_gate():
+    cfg = faults.FaultsConfig(points={"rpc.push": {"probability": 0.1}})
+    assert any("faults.allow" in w for w in cfg.check())
+    cfg.allow = True
+    assert cfg.check() == []
+    # env spec honored only under the same allow gate
+    os.environ["TEMPO_FAULTS"] = \
+        '{"wal.fsync": {"probability": 1.0, "count": 1}}'
+    try:
+        faults.configure(faults.FaultsConfig(allow=False))
+        assert not faults.ARMED
+        faults.configure(faults.FaultsConfig(allow=True))
+        assert faults.ARMED
+        with pytest.raises(OSError):
+            faults.fire("wal.fsync")
+    finally:
+        del os.environ["TEMPO_FAULTS"]
+        faults.reset()
+
+
+def test_wal_fsync_fault_fails_the_push_but_replay_covers_it(tmp_path):
+    """An injected fsync failure errors the push (unacked) — but the
+    scatter already landed and the frame is on disk, so the snapshot
+    watermark still covers it: no replay double-count."""
+    g = _mkgen(tmp_path)
+    g.push_otlp("t1", _payload(1))
+    spec = faults.FaultSpec(point="wal.fsync", probability=1.0, count=1)
+    with faults.use([spec]):
+        with pytest.raises(OSError):
+            g.push_otlp("t1", _payload(2))
+    want = _collect(g, "t1")              # both batches scattered
+    blob = ck.snapshot_instance(g.instance("t1"))
+    assert g.instance("t1").checkpointed_wal_seq == 1  # frame counted
+    g2 = _mkgen(tmp_path)
+    ck.restore_instance(g2.instance("t1"), blob)
+    assert g2.replay_wal_all()["batches"] == 0         # all ≤ watermark
+    assert _collect(g2, "t1") == want
+
+
+# ---------------------------------------------------------------------------
+# hardened retry paths the fault points flushed out
+# ---------------------------------------------------------------------------
+
+
+def test_resilient_backend_retries_transient_and_passes_semantic():
+    from tempo_tpu.backend.cloud import ResilientBackend
+    from tempo_tpu.backend.raw import DoesNotExist, KeyPath
+
+    be = ResilientBackend(MemBackend(), retries=3, backoff_s=0.001)
+    kp = KeyPath(("x",))
+    spec = faults.FaultSpec(point="backend.write", probability=1.0,
+                            count=2)
+    with faults.use([spec]):
+        be.write("a", kp, b"payload")     # 2 injected failures, retried
+    assert be.read("a", kp) == b"payload"
+    with pytest.raises(DoesNotExist):     # semantic error: no retry loop
+        be.read("missing", kp)
+    spec = faults.FaultSpec(point="backend.read", probability=1.0)
+    with faults.use([spec]):
+        with pytest.raises(OSError):      # retries exhausted → surfaces
+            be.read("a", kp)
+
+
+def test_controller_checkpoint_write_retries_with_cause_metric(tmp_path):
+    from tempo_tpu.fleet import RETRY_CAUSES, FleetConfig
+    from tempo_tpu.fleet.controller import FleetController
+    from tempo_tpu.ring import KVStore, Lifecycler, Ring
+
+    kv = KVStore()
+    be = MemBackend()
+    gen = _mkgen(tmp_path)
+    Lifecycler(kv, "m0", key="generator", now=lambda: NOW)
+    ring = Ring(kv=kv, key="generator", replication_factor=1,
+                now=lambda: NOW)
+    fc = FleetController(gen, ring, "m0", be, be,
+                         cfg=FleetConfig(checkpoint_write_retries=3,
+                                         checkpoint_retry_backoff_s=0.001),
+                         now=lambda: NOW)
+    gen.push_otlp("t1", _payload(1))
+    spec = faults.FaultSpec(point="fleet.checkpoint.write",
+                            probability=1.0, count=2)
+    before = dict(RETRY_CAUSES)
+    with faults.use([spec]):
+        fc._checkpoint("t1", remove=False)
+    assert ck.list_checkpoints(be, "fleet-checkpoints") != {}
+    grew = {k: v - before.get(k, 0) for k, v in RETRY_CAUSES.items()
+            if v - before.get(k, 0)}
+    assert sum(grew.values()) == 2        # both injected failures counted
+    # the successful write truncated the WAL below the watermark
+    assert gen.wal._tw("t1").segments() == []
+
+
+# ---------------------------------------------------------------------------
+# block-WAL satellite: directory-entry durability + torn-dir rescan
+# ---------------------------------------------------------------------------
+
+
+def test_block_wal_dir_fsync_and_torn_directory_rescan(tmp_path):
+    from tempo_tpu.block import wal as bwal
+
+    root = str(tmp_path / "bwal")
+    os.makedirs(root)
+    blk = bwal.WALBlock(root, "t1")
+    blk.append([
+        dict(trace_id=b"\x01" * 16, span_id=b"\x02" * 8, name="op",
+             service="svc", kind=2, status_code=0,
+             start_unix_nano=1, end_unix_nano=2)])
+    # torn directory shapes a rescan must tolerate: a block dir whose
+    # crash left only a tmp file, an empty block dir (dirent fsynced,
+    # nothing appended yet), and stray non-block entries
+    torn = os.path.join(root, "11111111+t2+vtpu1")
+    os.makedirs(torn)
+    with open(os.path.join(torn, ".0000001.tmp"), "wb") as f:
+        f.write(b"partial parquet")
+    os.makedirs(os.path.join(root, "22222222+t3+vtpu1"))
+    with open(os.path.join(root, "junk.txt"), "w") as f:
+        f.write("not a block")
+    blocks = bwal.rescan_blocks(root)
+    by_tenant = {b.tenant: b for b in blocks}
+    assert set(by_tenant) == {"t1", "t2", "t3"}
+    assert by_tenant["t1"].complete()          # full segment readable
+    assert by_tenant["t2"].complete() == []    # tmp file: not a segment
+    assert by_tenant["t3"].complete() == []    # empty dir reads empty
+    # appending after a rescan continues the segment numbering
+    assert by_tenant["t2"]._next_seg == 0
