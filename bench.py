@@ -3372,13 +3372,129 @@ overrides_defaults:
     return out
 
 
+def bench_selftrace() -> dict:
+    """Self-tracing loopback overhead: the distributor OTLP push path
+    with the loopback SelfTracer installed (every push emits spans;
+    periodic flushes re-enter the SAME distributor under the reserved
+    ops tenant) vs NoopTracer. Alternating arms, median-of-5 ratio.
+    Gates: push overhead <= 3% and zero steady-state recompiles —
+    self-span batches must reuse the bucketed kernel shapes the user
+    tenant already compiled, never add their own.
+    """
+    import statistics
+
+    from tempo_tpu import sched
+    from tempo_tpu.distributor import Distributor
+    from tempo_tpu.generator.generator import Generator
+    from tempo_tpu.generator.instance import GeneratorConfig
+    from tempo_tpu.obs.jaxruntime import JIT_COMPILES
+    from tempo_tpu.overrides import Overrides
+    from tempo_tpu.ring import ACTIVE, InstanceDesc, Ring
+    from tempo_tpu.ring.ring import _instance_tokens
+    from tempo_tpu.utils import tracing
+
+    now = time.time
+
+    def ring_of(iid):
+        r = Ring(replication_factor=1, now=now)
+        r.register(InstanceDesc(id=iid, state=ACTIVE,
+                                tokens=_instance_tokens(iid, 64),
+                                heartbeat_ts=now()))
+        return r
+
+    class _NullStagedIng:
+        staged_needs_attrs = False
+
+        def push(self, tenant, traces):
+            return [None] * len(traces)
+
+        def push_otlp(self, tenant, payload):
+            return {}
+
+        def push_staged(self, tenant, view):
+            return {}
+
+    payload = _make_otlp_payload(8192)
+    iters = 12
+    ov = Overrides()
+    for t in ("bench", "tempo-self"):
+        ov.set_tenant_patch(t, {"generator": {"processors": ["span-metrics"],
+                                              "disable_collection": True},
+                                "ingestion": {"rate_limit_bytes": 1 << 40,
+                                              "burst_size_bytes": 1 << 40}})
+    gen = Generator(GeneratorConfig(), instance_id="g0", overrides=ov)
+    dist = Distributor(ring_of("i0"), {"i0": _NullStagedIng()}, overrides=ov,
+                       generator_ring=ring_of("g0"),
+                       generator_clients={"g0": gen}, now=now)
+    tr = tracing.SelfTracer(sink=lambda b: dist.push_otlp("tempo-self", b),
+                            flush_interval_s=3600.0)
+    noop = tracing.NoopTracer()
+
+    def arm(tracer) -> float:
+        tracing.install(tracer)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            dist.push_otlp("bench", payload)
+        if tracer is tr:
+            # one export tick charged in-arm. Still conservative: at this
+            # push rate the production 2s flush interval spans ~20x more
+            # pushes than one arm does
+            tr.flush()
+        sched.flush()
+        return time.perf_counter() - t0
+
+    # warm both arms twice: user-tenant kernel shapes, ops-tenant shapes
+    # for the loopback self-span batches, and the intern tables
+    for _ in range(2):
+        arm(tr)
+        tr.flush()
+        arm(noop)
+    compiles0 = JIT_COMPILES.value(("spanmetrics_fused_update",))
+    offs, ons, ratios = [], [], []
+    try:
+        for r in range(5):
+            if r % 2 == 0:
+                off, on = arm(noop), arm(tr)
+            else:
+                on, off = arm(tr), arm(noop)
+            offs.append(off)
+            ons.append(on)
+            ratios.append(on / off if off > 0 else 1.0)
+        tracing.install(tr)
+        tr.flush()
+        sched.flush()
+        steady = int(JIT_COMPILES.value(("spanmetrics_fused_update",))
+                     - compiles0)
+    finally:
+        tracing.install(noop)
+        tr.shutdown()
+        sched.reset()
+    total = iters * 8192
+    out = {
+        "selftrace_off_spans_per_sec": round(total / statistics.median(offs)),
+        "selftrace_on_spans_per_sec": round(total / statistics.median(ons)),
+        "selftrace_overhead_pct":
+            round(100.0 * (statistics.median(ratios) - 1.0), 2),
+        "selftrace_spans_exported": tr.exported,
+        "selftrace_dropped_spans": tr.stats["dropped_spans"],
+        "selftrace_loopback_batches": tr.stats["loopback_batches"],
+        "selftrace_steady_state_compiles": steady,
+    }
+    out["selftrace_accept_ok"] = bool(
+        out["selftrace_overhead_pct"] <= 3.0
+        and steady == 0
+        and tr.exported > 0
+        and tr.stats["dropped_spans"] == 0)
+    return out
+
+
 STAGES = {"e2e": bench_e2e_ingest, "kernel": bench_kernel,
           "query": bench_query, "obs": bench_obs, "sched": bench_sched,
           "saturation": bench_saturation, "multichip": bench_multichip,
           "pages": bench_pages, "moments": bench_moments,
           "paged_fused": bench_paged_fused, "soak": bench_soak,
           "fleet": bench_fleet, "matview": bench_matview,
-          "chaos": bench_chaos}
+          "chaos": bench_chaos, "selftrace": bench_selftrace}
 
 
 def _cpu_env(env: dict) -> dict:
@@ -3794,6 +3910,20 @@ def main() -> int:
         "chaos_error": results.get("chaos_error"),
         "chaos_fault_error": results.get("chaos_fault_error"),
         "chaos_accept_ok": results.get("chaos_accept_ok"),
+        # self-tracing loopback (ISSUE 16): push-path overhead with the
+        # tracer exporting into this process's own distributor
+        "selftrace_off_spans_per_sec": results.get(
+            "selftrace_off_spans_per_sec"),
+        "selftrace_on_spans_per_sec": results.get(
+            "selftrace_on_spans_per_sec"),
+        "selftrace_overhead_pct": results.get("selftrace_overhead_pct"),
+        "selftrace_spans_exported": results.get("selftrace_spans_exported"),
+        "selftrace_dropped_spans": results.get("selftrace_dropped_spans"),
+        "selftrace_loopback_batches": results.get(
+            "selftrace_loopback_batches"),
+        "selftrace_steady_state_compiles": results.get(
+            "selftrace_steady_state_compiles"),
+        "selftrace_accept_ok": results.get("selftrace_accept_ok"),
     }
     if errors:
         extra["errors"] = errors

@@ -109,6 +109,22 @@ def dashboards() -> dict[str, dict]:
                   legend="{{method}}"),
                 p("Distributor push p99",
                   _p99("tempo_distributor_push_duration_seconds")),
+                # self-tracing loopback (runbook "Tracing Tempo with
+                # Tempo"): the system's own trace pipeline health — span
+                # volume vs kept trees, the drop ratio the
+                # TempoSelfTraceDropHigh alert fires on, and tail-keep
+                # buffer pressure (sizing signal for max_trace_spans)
+                p("Self-trace spans /s: recorded, kept trees, loopback",
+                  _rate("tempo_selftrace_spans_total"),
+                  _rate("tempo_selftrace_kept_traces_total"),
+                  _rate("tempo_selftrace_loopback_batches_total")),
+                p("Self-trace drop ratio (alert fires > 1%)",
+                  "rate(tempo_selftrace_dropped_spans_total[5m]) /"
+                  " clamp_min(rate(tempo_selftrace_spans_total[5m]),"
+                  " 1e-9)", unit="percentunit"),
+                p("Self-trace tail buffer + export retries /s",
+                  "tempo_selftrace_tail_buffer_spans",
+                  _rate("tempo_selftrace_export_retries_total")),
             ]),
         "tempo-tpu-reads.json": dash(
             "Tempo-TPU / Reads",

@@ -160,6 +160,14 @@ class Handler(BaseHTTPRequestHandler):
             # `a|b` org ids are read-side federation only; writes must name
             # ONE tenant (the reference rejects multi-tenant pushes)
             return self._err(400, "multi-tenant org id not allowed on writes")
+        if path in ("/v1/traces", "/api/v2/spans", "/api/traces"):
+            from tempo_tpu.utils import tracing
+            if tracing.is_reserved(tenant):
+                # the loopback ops tenant is written ONLY by the tracer's
+                # own sink/RPC plane; public pushes into it would forge
+                # self-observability data
+                return self._err(400, f"tenant {tenant!r} is reserved "
+                                      "for selftrace loopback ingest")
         try:
             if path == "/v1/traces":
                 return self._push(tenant)
@@ -376,7 +384,12 @@ class Handler(BaseHTTPRequestHandler):
     # -- reads -------------------------------------------------------------
 
     def do_GET(self) -> None:  # noqa: N802
-        self._observe_request("GET", self._do_get)
+        from tempo_tpu.utils import tracing
+
+        # reads propagate too: frontend → querier shard jobs → tempodb
+        # reads all hang off the caller's tree when a context arrives
+        with tracing.adopted(self.headers.get("traceparent")):
+            self._observe_request("GET", self._do_get)
 
     def _do_get(self) -> None:
         path = urlparse(self.path).path
@@ -698,8 +711,15 @@ class Handler(BaseHTTPRequestHandler):
             # materialized query grids (runbook "Materialized query
             # grids"): None = tier disabled
             "matview": self._matview_status(),
+            # self-tracing export health (runbook "Tracing Tempo with
+            # Tempo"): None = tracer not installed
+            "selftrace": self._selftrace_status(),
         }
         self._reply(200, _json_bytes(body))
+
+    def _selftrace_status(self) -> "dict | None":
+        from tempo_tpu.utils import tracing
+        return tracing.tracer().status()
 
     def _matview_status(self) -> "dict | None":
         from tempo_tpu import matview

@@ -174,6 +174,47 @@ class App:
             "tempo_self_tracer_dropped_spans_total", tracer_dropped,
             help="Self-tracing spans lost to buffer overflow or failed "
                  "OTLP exports (silent span loss is an alerting signal)")
+
+        # the selftrace loopback families (runbook "Tracing Tempo with
+        # Tempo"): registered unconditionally — NoopTracer reports 0 —
+        # so the drift gate sees every name on every deployment
+        def _selftrace_stat(key):
+            def read():
+                from tempo_tpu.utils import tracing
+                stats = getattr(tracing.tracer(), "stats", None) or {}
+                return [((), float(stats.get(key, 0)))]
+            return read
+
+        for key, txt in (
+                ("spans", "Spans recorded by the installed SelfTracer "
+                          "(pre-sampling; every hop of every trace)"),
+                ("kept_traces", "Traces whose whole tree survived to "
+                                "export: head-sampled in, errored, or "
+                                "mark_keep()-ed (SLO miss)"),
+                ("dropped_spans", "Self-spans LOST: tail/export buffer "
+                                  "overflow or a batch dropped after its "
+                                  "one bounded export retry (sampled-out "
+                                  "spans are not losses and not counted)"),
+                ("export_retries", "Export batches held for their one "
+                                   "bounded retry after a failed flush"),
+                ("loopback_batches", "Batches delivered through the "
+                                     "loopback sink into this process's "
+                                     "own distributor")):
+            self.obs.counter_func(
+                f"tempo_selftrace_{key}_total", _selftrace_stat(key),
+                help=txt)
+
+        def tail_buffer():
+            from tempo_tpu.utils import tracing
+            t = tracing.tracer()
+            return [((), float(t.tail_buffered()))] \
+                if hasattr(t, "tail_buffered") else [((), 0.0)]
+
+        self.obs.gauge_func(
+            "tempo_selftrace_tail_buffer_spans", tail_buffer,
+            help="Spans held in per-trace tail-keep buffers awaiting "
+                 "their trace's keep/sample verdict (sizing signal for "
+                 "selftrace.max_trace_spans / max_open_traces)")
         # ring membership/placement families (fleet satellite): rows
         # appear as rings wire up; the families are registered eagerly
         # so the dashboards/alerts drift gate always sees the names
@@ -625,12 +666,33 @@ class App:
             self.db.enable_polling(self.cfg.storage.poll_interval_s)
             if self.cfg.target in (ALL, COMPACTOR):
                 self.db.enable_compaction(self.cfg.compaction_interval_s)
-        if self.cfg.self_tracing_endpoint:
+        stc = self.cfg.selftrace
+        st_endpoint = stc.endpoint or self.cfg.self_tracing_endpoint
+        st_tenant = stc.tenant if stc.tenant != "tempo-self" \
+            else self.cfg.self_tracing_tenant
+        st_sink = None
+        if stc.enabled and self.distributor is not None:
+            # loopback: export batches go straight into this process's
+            # own distributor under the reserved ops tenant (recursion-
+            # guarded inside the tracer + span_for_tenant)
+            def st_sink(payload, _dist=self.distributor,
+                        _tenant=st_tenant):
+                _dist.push_otlp(_tenant, payload)
+        if st_sink is not None or st_endpoint:
             from tempo_tpu.utils import tracing
+            # service.name is the fleet-wide identity ("tempo-tpu");
+            # the process role rides as a resource attr so servicegraph
+            # edges stay one node while queries can still slice by role
             self._self_tracer = tracing.SelfTracer(
-                self.cfg.self_tracing_endpoint,
-                service_name=f"tempo-tpu-{self.cfg.target}",
-                tenant=self.cfg.self_tracing_tenant, now=self.now)
+                st_endpoint, service_name="tempo-tpu", tenant=st_tenant,
+                flush_interval_s=stc.flush_interval_s,
+                max_buffer=stc.max_buffer,
+                head_sample_rate=stc.head_sample_rate,
+                max_trace_spans=stc.max_trace_spans,
+                max_open_traces=stc.max_open_traces,
+                sink=st_sink,
+                resource_attrs={"tempo.target": self.cfg.target},
+                now=self.now)
             tracing.install(self._self_tracer)
         if self.bus is not None and (self.blockbuilder is not None
                                      or self.generator is not None):

@@ -31,6 +31,7 @@ from tempo_tpu.querier.querier import QuerierConfig
 from tempo_tpu.registry.pages import PagePoolConfig
 from tempo_tpu.sched import SchedConfig
 from tempo_tpu.utils.faults import FaultsConfig
+from tempo_tpu.utils.tracing import SelfTraceConfig
 
 
 @dataclasses.dataclass
@@ -182,8 +183,17 @@ class Config:
     # self-tracing (cmd/tempo/main.go:227-281): OTLP/HTTP endpoint that
     # receives this process's own spans — another cluster, or this very
     # process's listen address (dogfood mode). Empty = disabled.
+    # DEPRECATED in favor of the selftrace: block below; kept as an
+    # alias (maps onto selftrace.endpoint/tenant when the block is
+    # untouched) so existing YAMLs keep working.
     self_tracing_endpoint: str = ""
     self_tracing_tenant: str = "tempo-self"
+    # self-tracing loopback (runbook "Tracing Tempo with Tempo"):
+    # propagated spans from every internal hop, tail-kept per trace
+    # (SLO-miss/error trees always survive head sampling), exported
+    # into this process's OWN distributor under the reserved ops tenant
+    selftrace: SelfTraceConfig = dataclasses.field(
+        default_factory=SelfTraceConfig)
 
     def check(self) -> list[str]:
         """Config sanity warnings (`config.go:145-236` CheckConfig)."""
@@ -348,6 +358,20 @@ class Config:
             # multiples (masking at the configured row count)
             warnings.extend(self.pages.check(
                 (self.generator.registry.max_active_series,)))
+        warnings.extend(self.selftrace.check())
+        if self.selftrace.enabled and self.target not in ("all",):
+            warnings.append(
+                "selftrace.enabled on a non-all target: loopback needs "
+                "this process's own distributor; single-role processes "
+                "should set selftrace.endpoint to a distributor URL "
+                "instead (spans still join one fleet-wide tree via "
+                "traceparent propagation)")
+        if self.selftrace.enabled and self.fleet.enabled and \
+                self.distributor.generator_placement == "tenant" and \
+                not self.selftrace.tenant:
+            warnings.append(
+                "selftrace under fleet placement needs a reserved tenant "
+                "name: it is excluded from handoff/auto-subscribe by name")
         if self.distributor.jaeger_agent_port and \
                 self.distributor.jaeger_agent_host in ("", "0.0.0.0", "::") \
                 and not self.distributor.jaeger_agent_allow_wildcard:

@@ -537,6 +537,8 @@ class Distributor:
         (heartbeat expiry with no leave()): `do_batch`'s replica walk
         does not skip unhealthy instances, which would black-hole the
         dead member's tenants until its descriptor was removed."""
+        from tempo_tpu.utils import tracing
+
         if self.cfg.generator_placement == "tenant":
             from tempo_tpu.fleet.placement import tenant_token
 
@@ -546,29 +548,42 @@ class Distributor:
             # may have moved the tenant mid-push — and retries with
             # jitter. Ambiguous failures stay failures: the client-level
             # idempotent retry (same X-Push-Id) already covered them.
-            last_owner = None
-            for attempt in range(3):
-                inst = self.generator_ring.owner_of(tenant_token(tenant))
-                if inst is None:
-                    break
-                try:
-                    send_fn(inst, list(range(n_items)))
-                    return
-                except Exception as e:
-                    if attempt == 2 or not _never_committed(e):
+            # ONE tee span for the whole walk (like the RPC client's
+            # one-span retry loop): owner moves widen it, never fork it.
+            with tracing.span_for_tenant("distributor.GeneratorTee",
+                                         tenant, n_items=n_items) as sp:
+                last_owner = None
+                for attempt in range(3):
+                    inst = self.generator_ring.owner_of(
+                        tenant_token(tenant))
+                    if inst is None:
                         break
-                    if last_owner == inst.id:
-                        # same owner still refusing: brief jittered
-                        # pause before the ring view names a new one
-                        time.sleep(0.05 * (1 + attempt)
-                                   * (0.5 + random.random()))
-                    last_owner = inst.id
-                    self.metrics["push_retries_total"] += 1
-            self.metrics["push_failures_total"] += 1
+                    if sp is not None:
+                        sp.attrs["owner"] = inst.id
+                    try:
+                        send_fn(inst, list(range(n_items)))
+                        return
+                    except Exception as e:
+                        if attempt == 2 or not _never_committed(e):
+                            break
+                        if last_owner == inst.id:
+                            # same owner still refusing: brief jittered
+                            # pause before the ring view names a new one
+                            time.sleep(0.05 * (1 + attempt)
+                                       * (0.5 + random.random()))
+                        last_owner = inst.id
+                        self.metrics["push_retries_total"] += 1
+                self.metrics["push_failures_total"] += 1
+                if sp is not None:
+                    sp.status_code = 2
+                    sp.attrs["error.message"] = "generator tee failed"
             return
         try:
-            do_batch(self.generator_ring, tokens, list(range(n_items)),
-                     send_fn, rf=self.cfg.generator_rf)
+            with tracing.span_for_tenant("distributor.GeneratorTee",
+                                         tenant, n_items=n_items):
+                do_batch(self.generator_ring, tokens,
+                         list(range(n_items)), send_fn,
+                         rf=self.cfg.generator_rf)
         except RuntimeError:
             self.metrics["push_failures_total"] += 1
 

@@ -34,6 +34,7 @@ from typing import Callable
 from tempo_tpu.fleet import RETRY_CAUSES, STATS, FleetConfig
 from tempo_tpu.fleet import checkpoint as ck
 from tempo_tpu.fleet.placement import TenantPlacement
+from tempo_tpu.utils import tracing
 
 _LOG = logging.getLogger("tempo_tpu.fleet")
 
@@ -148,7 +149,12 @@ class FleetController:
     # -- the watch tick ----------------------------------------------------
 
     def _held(self) -> list[str]:
-        return self.generator.tenants()
+        # the selftrace loopback tenant never participates in placement:
+        # its spans describe THIS process and must stay local to it —
+        # handing it off would interleave two processes' self-traces in
+        # one instance and checkpoint state the source can't replay
+        reserved = tracing.reserved_tenant()
+        return [t for t in self.generator.tenants() if t != reserved]
 
     def tick(self) -> None:
         """One ownership pass: hand off lost tenants, restore gained
@@ -248,7 +254,9 @@ class FleetController:
     def _handoff(self, tenant: str, new_owner: str) -> None:
         _LOG.info("fleet %s: handing off tenant %s to %s",
                   self.id, tenant, new_owner)
-        self._checkpoint(tenant, remove=True)
+        with tracing.span_for_tenant("fleet.handoff", tenant,
+                                     new_owner=new_owner):
+            self._checkpoint(tenant, remove=True)
         STATS["handoffs"] += 1
 
     def _orphan(self, tenant: str, inst) -> None:
@@ -288,8 +296,10 @@ class FleetController:
                         self._orphan(tenant, inst)
                     return
                 try:
-                    blob = ck.snapshot_instance(inst)
-                    self._write_checkpoint_blob(tenant, blob)
+                    with tracing.span_for_tenant("fleet.checkpoint",
+                                                 tenant, remove=True):
+                        blob = ck.snapshot_instance(inst)
+                        self._write_checkpoint_blob(tenant, blob)
                 except Exception:
                     # the pop already happened: a failed snapshot/write
                     # must not lose the accrued state or leak its pages
@@ -308,8 +318,10 @@ class FleetController:
         if inst is None:
             return
         self._shutdown_fence(inst)
-        blob = ck.snapshot_instance(inst)
-        self._write_checkpoint_blob(tenant, blob)
+        with tracing.span_for_tenant("fleet.checkpoint", tenant,
+                                     remove=False):
+            blob = ck.snapshot_instance(inst)
+            self._write_checkpoint_blob(tenant, blob)
         self._truncate_wal(tenant, inst)
 
     def _restore_owned(self) -> None:
@@ -351,7 +363,9 @@ class FleetController:
                     continue            # listed-then-consumed race: skip
                 inst = self.generator.instance(tenant)
                 try:
-                    stats = ck.restore_instance(inst, blob)
+                    with tracing.span_for_tenant("fleet.restore", tenant,
+                                                 blob=name):
+                        stats = ck.restore_instance(inst, blob)
                 except ValueError as e:
                     # CheckpointMismatch / sketch merge guard: poison —
                     # quarantine immediately, keep the blob for forensics

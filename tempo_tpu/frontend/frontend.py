@@ -65,13 +65,20 @@ class FrontendConfig:
 
 class _Job:
     __slots__ = ("job", "fn", "spec", "result", "error", "event", "_lock",
-                 "_claimed", "enqueued_at", "queue_wait", "stats")
+                 "_claimed", "enqueued_at", "queue_wait", "stats",
+                 "traceparent")
 
     def __init__(self, job: SearchJob, fn: Callable[[SearchJob], Any],
                  spec: dict | None = None):
         self.job = job
         self.fn = fn
         self.spec = spec      # JSON-safe descriptor for remote workers
+        # issuer's trace context, captured at construction: the worker
+        # thread (or remote stream executor) re-enters it so querier /
+        # tempodb spans join the REQUEST's tree, not the worker's —
+        # contextvars do not cross the pool boundary, this string does
+        from tempo_tpu.utils import tracing
+        self.traceparent = tracing.tracer().traceparent()
         self.result: Any = None
         self.error: Exception | None = None
         self.event = threading.Event()
@@ -110,8 +117,10 @@ class _Job:
         self.run_claimed()
 
     def run_claimed(self) -> None:
+        from tempo_tpu.utils import tracing
         try:
-            with querystats.scope(self.stats):
+            with tracing.adopted(self.traceparent), \
+                    querystats.scope(self.stats):
                 self.result = self.fn(self.job)
         except Exception as e:  # combiner decides whether partials suffice
             self.error = e
@@ -290,6 +299,10 @@ class Frontend:
         if not good:
             from tempo_tpu.utils import tracing
             trace_id = tracing.current_trace_id_hex()
+            # tail-keep: an SLO-missing request's WHOLE tree survives
+            # head sampling (the exemplar above only named the id; the
+            # buffered spans are what make it retrievable)
+            tracing.mark_keep()
         self.op_duration.observe(latency_s, (op,), trace_id=trace_id)
 
     @property
@@ -506,6 +519,13 @@ class Frontend:
         merged = dict(extra or {})
         if keep < 1.0:
             merged["ingestKeepFraction"] = round(keep, 4)
+        # selfTraceId: present ONLY when this request's self-trace tree
+        # was (or will be) kept by tail-keep — the line then links
+        # directly to a retrievable trace in the ops tenant (runbook
+        # "Reading the query log")
+        kept = tracing.kept_trace_id_hex()
+        if kept:
+            merged["selfTraceId"] = kept
         self.qlog.log_query(
             op=op, tenant=tenant, query=query,
             status="error" if error is not None else "ok",

@@ -22,6 +22,7 @@ from tempo_tpu.generator.instance import GeneratorConfig, GeneratorInstance
 from tempo_tpu.model.span_batch import SpanBatchBuilder
 from tempo_tpu.obs import Registry
 from tempo_tpu.overrides import Overrides
+from tempo_tpu.utils import tracing
 
 
 class Generator:
@@ -237,14 +238,21 @@ class Generator:
 
     def push_spans(self, tenant: str, spans: Sequence[dict],
                    durable: bool = True) -> None:
-        with self._tracked_push(tenant) as inst:
-            self._push_spans(inst, spans)
-            wal = self._wal_for(tenant)
-            if durable and wal is not None:
-                # bus-driven pushes pass durable=False: the bus commits
-                # offsets AFTER processing, so it IS the replay log and
-                # a WAL record would double-apply on crash recovery
-                wal.append_spans(tenant, spans)
+        # tenant-aware span: joins the adopted RPC tree on a remote
+        # member; for the reserved selftrace tenant it SUPPRESSES the
+        # whole ingest call-tree (WAL spans included) — ingesting our
+        # own spans must not produce more spans
+        with tracing.span_for_tenant("generator.Push", tenant,
+                                     n_spans=len(spans)):
+            with self._tracked_push(tenant) as inst:
+                self._push_spans(inst, spans)
+                wal = self._wal_for(tenant)
+                if durable and wal is not None:
+                    # bus-driven pushes pass durable=False: the bus
+                    # commits offsets AFTER processing, so it IS the
+                    # replay log and a WAL record would double-apply on
+                    # crash recovery
+                    wal.append_spans(tenant, spans)
 
     def _push_spans(self, inst: GeneratorInstance, spans: Sequence[dict],
                     now_s: "float | None" = None) -> None:
@@ -279,7 +287,9 @@ class Generator:
         re-scattering."""
         from tempo_tpu.model.otlp_batch import batch_from_otlp, stage_otlp
 
-        with self._tracked_push(tenant) as inst:
+        with tracing.span_for_tenant("generator.Push", tenant,
+                                     n_bytes=len(data)), \
+                self._tracked_push(tenant) as inst:
             # dedupe states: an int is acked AND durable (done); a
             # ("pending", n) tuple means a prior attempt scattered but
             # its WAL append failed — the retry must redo ONLY the

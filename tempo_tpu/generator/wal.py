@@ -53,7 +53,7 @@ import zlib
 
 import numpy as np
 
-from tempo_tpu.utils import faults
+from tempo_tpu.utils import faults, tracing
 
 _LOG = logging.getLogger("tempo_tpu.generator.wal")
 
@@ -673,31 +673,41 @@ class GeneratorWal:
 
     def append_view(self, tenant: str, view,
                     push_id: str | None = None) -> tuple[int, int]:
-        meta, arrays = view_record(view, self.now(), push_id=push_id)
-        return self._tw(tenant).append((meta, arrays),
-                                       interner=view.staged.interner)
+        # appends are spans (part of the request's tree via the ambient
+        # context): the acked-is-durable fsync IS request latency, and a
+        # kept SLO-miss trace shows exactly which append stalled it.
+        # Reserved-tenant ingest arrives inside the suppression guard,
+        # so self-ingest appends go untraced by construction.
+        with tracing.span("wal.append", kind="view", tenant=tenant):
+            meta, arrays = view_record(view, self.now(), push_id=push_id)
+            return self._tw(tenant).append((meta, arrays),
+                                           interner=view.staged.interner)
 
     def append_otlp(self, tenant: str, data: bytes, trusted: bool = False,
                     push_id: str | None = None) -> tuple[int, int]:
         """Raw-payload record for routes with no staged product (native
         staging unavailable): replay re-runs the normal OTLP push."""
-        meta = {"v": RECORD_VERSION, "kind": "otlp", "ts": self.now(),
-                "n": 0, "trusted": bool(trusted)}
-        if push_id:
-            meta["push_id"] = push_id
-        arrays = {"raw": np.frombuffer(data, np.uint8)}
-        return self._tw(tenant).append((meta, arrays))
+        with tracing.span("wal.append", kind="otlp", tenant=tenant,
+                          n_bytes=len(data)):
+            meta = {"v": RECORD_VERSION, "kind": "otlp", "ts": self.now(),
+                    "n": 0, "trusted": bool(trusted)}
+            if push_id:
+                meta["push_id"] = push_id
+            arrays = {"raw": np.frombuffer(data, np.uint8)}
+            return self._tw(tenant).append((meta, arrays))
 
     def append_spans(self, tenant: str, spans,
                      push_id: str | None = None) -> tuple[int, int]:
         """Dict-route record (push_spans without a staged product): the
         span dicts as wire-parity JSON (`rpc.spans_to_json` shape)."""
         from tempo_tpu.rpc import spans_to_json
-        meta = {"v": RECORD_VERSION, "kind": "spans", "ts": self.now(),
-                "n": len(spans), "spans": spans_to_json(list(spans))}
-        if push_id:
-            meta["push_id"] = push_id
-        return self._tw(tenant).append((meta, {}))
+        with tracing.span("wal.append", kind="spans", tenant=tenant,
+                          n_spans=len(spans)):
+            meta = {"v": RECORD_VERSION, "kind": "spans", "ts": self.now(),
+                    "n": len(spans), "spans": spans_to_json(list(spans))}
+            if push_id:
+                meta["push_id"] = push_id
+            return self._tw(tenant).append((meta, {}))
 
     # -- watermark / truncation / replay -----------------------------------
 
@@ -728,6 +738,18 @@ class GeneratorWal:
         bound = tw.next_seq - 1
         past_seq = max(past_seq, tw.checkpoint_floor())
         stats = {"batches": 0, "dead_letters": 0}
+        with tracing.span("wal.replay", tenant=tenant,
+                          past_seq=past_seq, bound=bound) as _sp:
+            self._replay_segments(tw, tenant, apply_fn, past_seq, bound,
+                                  stats)
+            if _sp is not None:
+                _sp.attrs["batches"] = stats["batches"]
+                _sp.attrs["dead_letters"] = stats["dead_letters"]
+        STATS["replay_lag_seconds"] = 0.0
+        return stats
+
+    def _replay_segments(self, tw, tenant: str, apply_fn, past_seq: int,
+                         bound: int, stats: dict) -> None:
         for name in tw.segments():
             seg_strings: list[str] = []
             for seq, payload in tw._read_segment(name):
@@ -756,8 +778,6 @@ class GeneratorWal:
                                    tenant, seq)
                     self._dead_letter(tenant, seq, payload, seg_strings)
                     stats["dead_letters"] += 1
-        STATS["replay_lag_seconds"] = 0.0
-        return stats
 
     def _dead_letter(self, tenant: str, seq: int, payload: bytes,
                      seg_strings: list[str]) -> None:

@@ -615,6 +615,12 @@ class Materializer:
         request with qlog's fingerprint-recurrence count."""
         if not self.cfg.enabled or not self.cfg.auto_subscribe:
             return
+        from tempo_tpu.utils import tracing
+        if tracing.is_reserved(tenant):
+            # the selftrace loopback tenant must never grow query-driven
+            # state: a grid over self-spans would emit spans of its own
+            # on every observe_batch, re-entering the loop it observes
+            return
         if recurrences < self.cfg.auto_subscribe_after:
             return
         sub, why = self.subscribe(tenant, query, step_s, origin="auto")

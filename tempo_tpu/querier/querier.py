@@ -23,6 +23,7 @@ from tempo_tpu.ops.hashing import token_for
 from tempo_tpu.overrides import Overrides
 from tempo_tpu.ring import Ring
 from tempo_tpu.traceql.engine import MetadataCombiner
+from tempo_tpu.utils import tracing
 
 
 class IngesterQueryClient(Protocol):
@@ -115,9 +116,13 @@ class Querier:
         t0 = time.perf_counter()
         querystats.add(blocks_scanned=1)
         try:
-            return self.db.search(tenant, query, limit=limit,
-                                  start_s=start_s, end_s=end_s,
-                                  metas=[meta], row_groups=row_groups)
+            with tracing.span_for_tenant(
+                    "querier.SearchBlock", tenant,
+                    block_id=str(meta.block_id),
+                    row_groups=len(row_groups) if row_groups else 0):
+                return self.db.search(tenant, query, limit=limit,
+                                      start_s=start_s, end_s=end_s,
+                                      metas=[meta], row_groups=row_groups)
         finally:
             self.block_scan_duration.observe(time.perf_counter() - t0,
                                              ("search",))
@@ -131,10 +136,14 @@ class Querier:
         t0 = time.perf_counter()
         querystats.add(blocks_scanned=1)
         try:
-            return self.db.query_range(tenant, req, metas=[meta],
-                                       row_groups=row_groups,
-                                       clip_start_ns=clip_start_ns,
-                                       clip_end_ns=clip_end_ns)
+            with tracing.span_for_tenant(
+                    "querier.QueryRangeBlock", tenant,
+                    block_id=str(meta.block_id),
+                    row_groups=len(row_groups) if row_groups else 0):
+                return self.db.query_range(tenant, req, metas=[meta],
+                                           row_groups=row_groups,
+                                           clip_start_ns=clip_start_ns,
+                                           clip_end_ns=clip_end_ns)
         finally:
             self.block_scan_duration.observe(time.perf_counter() - t0,
                                              ("metrics",))

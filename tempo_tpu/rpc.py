@@ -25,7 +25,7 @@ import uuid
 from typing import Sequence
 
 from tempo_tpu.ingest.encoding import decode_push, encode_push
-from tempo_tpu.utils import faults
+from tempo_tpu.utils import faults, tracing
 
 
 def _check_single_record(records: list[bytes]) -> bytes:
@@ -42,6 +42,12 @@ class _BaseClient:
               ctype: str = "application/x-tempo-push",
               headers: dict | None = None) -> dict:
         h = {"Content-Type": ctype, "X-Scope-OrgID": tenant}
+        # W3C context propagation (`main.go:252-258`): every internal
+        # hop carries the caller's traceparent so the receiver's spans
+        # join the SAME logical tree across processes
+        tp = tracing.tracer().traceparent()
+        if tp:
+            h["traceparent"] = tp
         if headers:
             h.update(headers)
         req = urllib.request.Request(self.base + path, data=body, headers=h)
@@ -52,7 +58,11 @@ class _BaseClient:
         url = self.base + path
         if params:
             url += "?" + urllib.parse.urlencode(params)
-        req = urllib.request.Request(url, headers={"X-Scope-OrgID": tenant})
+        h = {"X-Scope-OrgID": tenant}
+        tp = tracing.tracer().traceparent()
+        if tp:
+            h["traceparent"] = tp
+        req = urllib.request.Request(url, headers=h)
         with urllib.request.urlopen(req, timeout=self.timeout) as r:
             return json.loads(r.read() or b"{}")
 
@@ -135,19 +145,28 @@ class RemoteGeneratorClient(_BaseClient):
         re-resolves the ring owner on final failure."""
         push_id = uuid.uuid4().hex
         delay = 0.05
-        for attempt in range(retries + 1):
-            try:
-                if faults.ARMED:
-                    faults.fire("rpc.push")
-                res = self._post("/internal/generator/push_otlp", data,
-                                 tenant, ctype="application/x-protobuf",
-                                 headers={"X-Push-Id": push_id})
-                return int(res.get("spans", 0))
-            except Exception as e:
-                if attempt >= retries or not _push_retryable(e):
-                    raise
-                time.sleep(delay * (0.5 + random.random()))
-                delay = min(delay * 2, 1.0)
+        # ONE span for the whole retry loop: every attempt posts the
+        # same traceparent (captured inside this span by _post) AND the
+        # same X-Push-Id, so a deduped retry lands in the receiver as
+        # the same logical tree — retries widen one span, never fork a
+        # second tree
+        with tracing.span_for_tenant("rpc.push", tenant,
+                                     push_id=push_id) as sp:
+            for attempt in range(retries + 1):
+                try:
+                    if faults.ARMED:
+                        faults.fire("rpc.push")
+                    res = self._post("/internal/generator/push_otlp", data,
+                                     tenant, ctype="application/x-protobuf",
+                                     headers={"X-Push-Id": push_id})
+                    if sp is not None and attempt:
+                        sp.attrs["retries"] = attempt
+                    return int(res.get("spans", 0))
+                except Exception as e:
+                    if attempt >= retries or not _push_retryable(e):
+                        raise
+                    time.sleep(delay * (0.5 + random.random()))
+                    delay = min(delay * 2, 1.0)
 
     def query_range(self, tenant: str, req, clip_start_ns: int | None = None):
         from tempo_tpu.traceql.engine_metrics import TimeSeries

@@ -282,7 +282,8 @@ class Job:
 
     __slots__ = ("priority", "kernel", "merge_key", "arrays", "pads",
                  "n_rows", "dispatch", "fn", "tenant", "enqueue_t",
-                 "event", "result", "error", "stats", "wait_s")
+                 "event", "result", "error", "stats", "wait_s",
+                 "traceparent")
 
     def __init__(self, *, priority: int, kernel: str, merge_key=None,
                  arrays: "tuple | None" = None,
@@ -305,6 +306,10 @@ class Job:
         self.error: "BaseException | None" = None
         self.stats = stats     # caller's QueryStats, adopted by the worker
         self.wait_s = 0.0      # enqueue → execution-start (set by worker)
+        # submitter's trace context: the dispatch span LINKS the whole
+        # coalesced batch back to each contributing request's tree
+        # (fn jobs re-enter it so device work parents under the query)
+        self.traceparent = tracing.tracer().traceparent()
 
     def wait(self, timeout: "float | None" = None) -> bool:
         """Block until dispatched; re-raises the dispatch error, if any."""
@@ -915,11 +920,30 @@ class DeviceScheduler:
             h2d_bytes = sum(int(a.nbytes) for a in padded)
             # slow dispatches are findable by trace: same span surface
             # as distributor.push / frontend.Search (NoopTracer default
-            # costs one dict build per MERGED batch)
-            with tracing.span("sched.dispatch", kernel=g.kernel,
-                              bucket=bucket, rows=rows,
-                              shard=str(g.shards) if g.shards else ""):
+            # costs one dict build per MERGED batch). The span LINKS the
+            # coalesced batch back to each contributing request's tree
+            # (bounded: a batch is a fan-in, links are how OTel models
+            # it) and carries the devtime ledger identity — kernel,
+            # bucket, device_ns — so device time is attributable per
+            # trace. A single-tenant batch goes through the tenant-aware
+            # guard: an all-reserved-tenant batch (loopback self-ingest)
+            # must not re-trace itself.
+            attrs = {"kernel": g.kernel, "bucket": bucket, "rows": rows,
+                     "shard": str(g.shards) if g.shards else ""}
+            links = sorted({j.traceparent for j in chunk
+                            if j.traceparent is not None})
+            if links:
+                attrs["link.traceparents"] = ",".join(links[:8])
+            tenants = {j.tenant for j in chunk}
+            only = next(iter(tenants)) if len(tenants) == 1 else ""
+            cm = tracing.span_for_tenant("sched.dispatch", only, **attrs) \
+                if only else tracing.span("sched.dispatch", **attrs)
+            with cm as sp:
+                td0 = time.perf_counter()
                 g.dispatch(*padded)
+                if sp is not None:
+                    sp.attrs["device_ns"] = \
+                        int((time.perf_counter() - td0) * 1e9)
         except BaseException as e:           # noqa: BLE001 — propagated
             err = e
             self._note_dispatch_error(g.kernel, e)
@@ -983,8 +1007,14 @@ class DeviceScheduler:
             job.wait_s = max(self.now() - job.enqueue_t, 0.0)
         t0 = time.perf_counter()
         try:
-            with tracing.span("sched.dispatch", kernel=job.kernel,
-                              bucket=0, rows=0, shard=""):
+            # re-enter the submitter's trace context: query-route device
+            # work parents under the request's tree across the worker
+            # thread boundary (the row-job path links instead — a
+            # coalesced batch has many parents, a fn job has one)
+            with tracing.adopted(job.traceparent), \
+                    tracing.span_for_tenant("sched.dispatch", job.tenant,
+                                            kernel=job.kernel, bucket=0,
+                                            rows=0, shard="") as sp:
                 if job.stats is not None:
                     # adopt the caller's per-request QueryStats on this
                     # thread so the kernel's own recording (device_scan
@@ -993,6 +1023,9 @@ class DeviceScheduler:
                         job.result = job.fn()
                 else:
                     job.result = job.fn()
+                if sp is not None:
+                    sp.attrs["device_ns"] = \
+                        int((time.perf_counter() - t0) * 1e9)
         except BaseException as e:           # noqa: BLE001 — propagated
             # fn jobs have a waiting caller who re-raises and owns the
             # error surface; dispatch_errors stays a dropped-ingest-batch

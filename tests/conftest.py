@@ -70,6 +70,13 @@ def _reset_device_scheduler():
     from tempo_tpu.utils import faults
 
     faults.reset()
+    # the installed self-tracer is process-wide; a test that installs a
+    # SelfTracer (loopback App, propagation tests) must never leave it
+    # live — later tests would emit spans into a dead sink and trip the
+    # suppression/reserved-tenant guards in surprising places
+    from tempo_tpu.utils import tracing
+
+    tracing.install(tracing.NoopTracer())
 
 
 # ---------------------------------------------------------------------------

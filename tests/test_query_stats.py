@@ -330,8 +330,9 @@ def test_frontend_emits_exactly_one_query_complete_line(stack, caplog):
 
 
 def test_self_tracer_counts_failed_export_as_dropped():
-    """Satellite bugfix: flush() must not silently swallow export
-    failures — the batch is lost and `dropped` must say so."""
+    """Satellite bugfix: a failed export must not silently swallow the
+    batch NOR drop it immediately — it is held for exactly ONE retry on
+    the next flush tick (export_retries) before counting into `dropped`."""
     from tempo_tpu.utils import tracing
 
     tracer = tracing.SelfTracer("http://127.0.0.1:9", flush_interval_s=3600)
@@ -340,7 +341,10 @@ def test_self_tracer_counts_failed_export_as_dropped():
             pass
         assert tracer.dropped == 0
         assert tracer.flush() == 0               # unreachable endpoint
-        assert tracer.dropped == 1
+        assert tracer.dropped == 0               # held, not yet lost
+        assert tracer.stats["export_retries"] == 1
+        assert tracer.flush() == 0               # bounded retry fails too
+        assert tracer.dropped == 1               # NOW it's a counted loss
         assert tracer.exported == 0
     finally:
         tracer._stop.set()

@@ -1,0 +1,331 @@
+"""The self-tracing loop (runbook "Tracing Tempo with Tempo").
+
+Propagation invariants and the loopback ingest contract:
+
+- tail-keep: SLO-missing / errored trees survive a zero head-sample
+  rate, plain trees are sampled out WHOLE, late spans (async sched jobs
+  closing after the root) follow their trace's verdict;
+- an RPC push that retries under fault injection stays ONE logical span
+  tree (same traceparent, same X-Push-Id, one rpc.push span);
+- loopback: a process ingesting its OWN spans emits zero new spans
+  (recursion guard), refuses the reserved tenant on public push APIs,
+  and answers TraceQL search / metrics over its own behavior, with
+  SLO-missing request trees retrievable by the qlog `selfTraceId`.
+"""
+
+import json
+import logging
+import socket
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from tempo_tpu.model.otlp import encode_spans_otlp, spans_from_otlp_proto
+from tempo_tpu.utils import faults, tracing
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _decode_names(batches: list) -> list:
+    return [s["name"] for b in batches for s in spans_from_otlp_proto(b)]
+
+
+# -- tail-keep ---------------------------------------------------------------
+
+
+def test_tail_keep_slo_and_error_trees_survive_zero_rate():
+    """At head_sample_rate 0 nothing exports EXCEPT trees forced past
+    sampling: mark_keep() (the SLO-miss hook) and errored spans."""
+    batches: list = []
+    tr = tracing.SelfTracer(sink=batches.append, head_sample_rate=0.0,
+                            flush_interval_s=3600)
+    tracing.install(tr)
+    # plain tree: buffered until root close, then sampled out whole
+    with tracing.span("root-a"):
+        with tracing.span("child-a"):
+            pass
+        assert tracing.kept_trace_id_hex() is None
+    # SLO-miss analog: mark_keep forces the whole tree, and the verdict
+    # is knowable before root close (the qlog selfTraceId bridge)
+    with tracing.span("root-b") as rb:
+        with tracing.span("child-b"):
+            pass
+        tracing.mark_keep()
+        assert tracing.kept_trace_id_hex() == rb.trace_id.hex()
+    # an errored span forces its tree too
+    with pytest.raises(ValueError):
+        with tracing.span("root-c"):
+            raise ValueError("boom")
+    assert tr.flush() == 3
+    names = set(_decode_names(batches))
+    assert names == {"root-b", "child-b", "root-c"}
+    assert tr.stats["kept_traces"] == 2
+    assert tr.stats["sampled_spans"] == 2          # root-a + child-a
+    assert tr.stats["dropped_spans"] == 0          # sampling is not loss
+
+
+def test_late_spans_follow_their_trace_verdict():
+    """A span closing AFTER its trace finalized (async sched dispatch
+    outliving the request root) follows the cached keep verdict."""
+    batches: list = []
+    tr = tracing.SelfTracer(sink=batches.append, head_sample_rate=0.0,
+                            flush_interval_s=3600)
+    tracing.install(tr)
+    with tracing.span("kept-root") as root:
+        tracing.mark_keep()
+    tid = root.trace_id
+    assert tr.flush() == 1
+    # late arrival on the kept trace: adopted remote context, no open
+    # local parent — exports alone under the same trace id
+    with tracing.adopted(f"00-{tid.hex()}-{'ab' * 8}-01"):
+        with tracing.span("late-dispatch"):
+            pass
+    assert tr.flush() == 1
+    got = list(spans_from_otlp_proto(batches[-1]))
+    assert got[0]["name"] == "late-dispatch"
+    assert got[0]["trace_id"] == tid
+    # late arrival on a SAMPLED-OUT trace: silently follows the drop
+    with tracing.span("dropped-root") as dr:
+        pass
+    with tracing.adopted(f"00-{dr.trace_id.hex()}-{'cd' * 8}-01"):
+        with tracing.span("late-dropped"):
+            pass
+    assert tr.flush() == 0
+
+
+# -- RPC push retries: one logical tree --------------------------------------
+
+
+class _FlakyGenHandler(BaseHTTPRequestHandler):
+    """Scripted ring-owner: each entry of `script` is an HTTP status for
+    one POST (then 200s forever); headers of every attempt recorded."""
+
+    script: list = []
+    requests: list = []
+
+    def do_POST(self):  # noqa: N802
+        self.rfile.read(int(self.headers.get("Content-Length", 0) or 0))
+        type(self).requests.append(dict(self.headers.items()))
+        status = type(self).script.pop(0) if type(self).script else 200
+        body = json.dumps({"spans": 1}).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # noqa: A002
+        pass
+
+
+def test_rpc_push_retry_is_one_logical_tree():
+    """Fault-injected + 503'd retries of one generator push stay ONE
+    logical tree: every wire attempt carries the SAME X-Push-Id and the
+    SAME traceparent, and the client emits exactly one rpc.push span."""
+    from tempo_tpu.rpc import RemoteGeneratorClient
+
+    _FlakyGenHandler.script = [503]
+    _FlakyGenHandler.requests = []
+    srv = HTTPServer(("127.0.0.1", 0), _FlakyGenHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    batches: list = []
+    tr = tracing.SelfTracer(sink=batches.append, flush_interval_s=3600)
+    tracing.install(tr)
+    payload = encode_spans_otlp([dict(
+        trace_id=b"\x01" * 16, span_id=b"\x02" * 8, name="op",
+        service="svc", kind=2, status_code=0,
+        start_unix_nano=10**18, end_unix_nano=10**18 + 10**6)])
+    client = RemoteGeneratorClient(
+        f"http://127.0.0.1:{srv.server_address[1]}", timeout_s=10.0)
+    try:
+        # attempt 0 dies in-process (fault point, never reaches the
+        # wire), attempt 1 gets the scripted 503, attempt 2 lands
+        spec = faults.FaultSpec(point="rpc.push", probability=1.0, count=1)
+        with faults.use([spec]):
+            with tracing.span("push-root") as root:
+                assert client.push_otlp("t1", payload) == 1
+    finally:
+        srv.shutdown()
+    assert tr.flush() == 2                     # push-root + ONE rpc.push
+    got = list(spans_from_otlp_proto(b"".join(batches)))
+    pushes = [s for s in got if s["name"] == "rpc.push"]
+    assert len(pushes) == 1
+    assert pushes[0]["trace_id"] == root.trace_id
+    assert pushes[0]["attrs"]["retries"] == 2
+    # both wire attempts: same push id, same traceparent, root's trace
+    assert len(_FlakyGenHandler.requests) == 2
+    ids = {r.get("X-Push-Id") for r in _FlakyGenHandler.requests}
+    tps = {r.get("Traceparent") or r.get("traceparent")
+           for r in _FlakyGenHandler.requests}
+    assert len(ids) == 1 and None not in ids
+    assert len(tps) == 1
+    assert root.trace_id.hex() in next(iter(tps))
+
+
+# -- config bounds -----------------------------------------------------------
+
+
+def test_selftrace_config_check_bounds():
+    from tempo_tpu.app.config import Config
+
+    cfg = Config(target="all")
+    cfg.selftrace.enabled = True
+    assert not any("selftrace" in w for w in cfg.check())
+    cfg.selftrace.head_sample_rate = 1.5
+    cfg.selftrace.flush_interval_s = 0.0
+    cfg.selftrace.max_trace_spans = 1
+    cfg.selftrace.endpoint = "http://example:4318"
+    warnings = [w for w in cfg.check() if w.startswith("selftrace:")]
+    assert any("head_sample_rate" in w for w in warnings)
+    assert any("flush_interval_s" in w for w in warnings)
+    assert any("max_trace_spans" in w for w in warnings)
+    assert any("loopback wins" in w for w in warnings)
+    # loopback needs this process to HAVE a distributor
+    cfg2 = Config(target="querier")
+    cfg2.selftrace.enabled = True
+    assert any("selftrace" in w and "distributor" in w
+               for w in cfg2.check())
+
+
+# -- the loopback E2E proof --------------------------------------------------
+
+
+def test_loopback_e2e_self_observability(tmp_path):
+    """Single binary with `selftrace.enabled`: the process ingests its
+    own spans under the reserved ops tenant and (a) emits ZERO new spans
+    while doing so, (b) refuses the reserved tenant on public push,
+    (c) answers TraceQL search for its own sched.dispatch spans and a
+    quantile_over_time over self-span latency, and (d) an SLO-missing
+    request's tree is retrievable by the qlog line's selfTraceId."""
+    import time as _time
+
+    from tempo_tpu import sched
+    from tempo_tpu.app import App
+    from tempo_tpu.app.api import serve
+    from tempo_tpu.app.config import Config
+    from tempo_tpu.frontend.slos import SLOConfig
+    from tempo_tpu.obs.qlog import LOGGER_NAME
+
+    port = _free_port()
+    cfg = Config(target="all")
+    cfg.storage.backend = "mem"
+    cfg.storage.wal_path = str(tmp_path / "wal")
+    cfg.generator.localblocks.data_dir = str(tmp_path / "lb")
+    cfg.server.http_listen_port = port
+    cfg.selftrace.enabled = True
+    cfg.selftrace.flush_interval_s = 3600.0     # flush manually
+    # span-metrics gives the push path real device rows (the sched
+    # coalescer emits the dispatch spans the TraceQL proof searches
+    # for); local-blocks serves the metrics query over self-spans
+    cfg.overrides_defaults.generator.processors = ("span-metrics",
+                                                   "local-blocks")
+    assert not any("selftrace" in w for w in cfg.check())
+    app = App(cfg)
+    app.start_loops()
+    srv = serve(app, block=False)
+    base = f"http://127.0.0.1:{port}"
+    tr = tracing.tracer()
+    try:
+        assert tr.loopback and tracing.reserved_tenant() == "tempo-self"
+        assert tracing.is_reserved("tempo-self")
+
+        # (b) the reserved tenant is refused on the public push API
+        req = urllib.request.Request(
+            f"{base}/v1/traces", data=b"{}",
+            headers={"Content-Type": "application/json",
+                     "X-Scope-OrgID": "tempo-self"})
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=10)
+        assert err.value.code == 400
+
+        # drive traced work: HTTP push -> distributor -> tee ->
+        # generator, then a deliberately SLO-missing search
+        t0 = int((_time.time() - 3) * 1e9)
+        otlp = {"resourceSpans": [{"scopeSpans": [{"spans": [{
+            "traceId": "ab" * 16, "spanId": "cd" * 8, "name": "user-op",
+            "startTimeUnixNano": str(t0),
+            "endTimeUnixNano": str(t0 + 50_000_000)}]}]}]}
+        req = urllib.request.Request(
+            f"{base}/v1/traces", data=json.dumps(otlp).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=10).close()
+        sched.flush()                       # force async dispatch spans
+
+        app.frontend.qlog.sample_every = 1  # every line logs
+        app.frontend.slos.per_op["search"] = SLOConfig(duration_slo_s=1e-9)
+        logger = logging.getLogger(LOGGER_NAME)
+        records: list = []
+
+        class _Capture(logging.Handler):
+            def emit(self, rec):
+                records.append(rec.getMessage())
+
+        h = _Capture()
+        prev_level = logger.level
+        logger.setLevel(logging.INFO)
+        logger.addHandler(h)
+        try:
+            app.frontend.search("single-tenant", "{ }", limit=5)
+        finally:
+            logger.removeHandler(h)
+            logger.setLevel(prev_level)
+            app.frontend.slos.per_op.pop("search", None)
+        lines = [json.loads(x) for x in records]
+        kept = [r for r in lines if r.get("selfTraceId")]
+        assert kept, lines                  # (d) qlog carries the id
+        self_tid = kept[0]["selfTraceId"]
+
+        # (a) recursion guard: ingesting our own export emits no spans
+        spans_before = tr.stats["spans"]
+        assert tr.flush() > 0               # loopback into ourselves
+        sched.flush()                       # drain the self-ingest rows
+        assert tr.stats["spans"] == spans_before
+        assert tr.stats["loopback_batches"] >= 1
+
+        # (c) TraceQL search over our own dispatch spans, ops tenant
+        q = urllib.parse.quote(
+            '{ resource.service.name = "tempo-tpu" '
+            '&& name =~ "sched.dispatch" }')
+        req = urllib.request.Request(
+            f"{base}/api/search?q={q}",
+            headers={"X-Scope-OrgID": "tempo-self"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            found = json.loads(r.read())
+        assert found.get("traces"), found
+
+        # (c) metrics over self-span latency, ops tenant
+        now = _time.time()
+        q = urllib.parse.quote("{ } | quantile_over_time(duration, .5)")
+        req = urllib.request.Request(
+            f"{base}/api/metrics/query_range?q={q}"
+            f"&start={now - 300}&end={now}&step=300",
+            headers={"X-Scope-OrgID": "tempo-self"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            qr = json.loads(r.read())
+        assert qr.get("series"), qr
+
+        # (d) the SLO-missing tree, by its qlog selfTraceId
+        req = urllib.request.Request(
+            f"{base}/api/traces/{self_tid}",
+            headers={"X-Scope-OrgID": "tempo-self"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            tree = json.loads(r.read())
+        names = {s["name"] for s in tree["spans"]}
+        assert "frontend.Search" in names, names
+
+        # /status surfaces export health
+        with urllib.request.urlopen(f"{base}/status", timeout=10) as r:
+            status = json.loads(r.read())
+        assert status["selftrace"]["loopback"] is True
+        assert status["selftrace"]["tenant"] == "tempo-self"
+    finally:
+        srv.shutdown()
+        app.shutdown()
