@@ -3488,13 +3488,169 @@ def bench_selftrace() -> dict:
     return out
 
 
+def bench_structure() -> dict:
+    """Structural trace analytics (ISSUE 18): the critical-path /
+    error-propagation processor's ingest cost and kernel health on a
+    ~1M-span mixed-topology workload (deep 64-span chains, wide
+    64-span fans, random trees with errored subtrees).
+
+    Arms:
+    - ingest-path cost: the SAME span stream through span-metrics-only
+      vs span-metrics + trace-analytics, timing ONLY push_batch — the
+      ingest hot path, where analytics adds per-trace buffering. The
+      structural cuts themselves run at tick time on the housekeeping /
+      scheduler tier in production, never on the ingest path, so their
+      cost is measured and reported separately (structure_cut_ms_*,
+      structure_analysis_spans_per_sec), not hidden. Gate: < 10%
+      ingest-path cost.
+    - kernel health: cut cadence is fixed (64 pushes x 16 traces), so
+      every cut hits one compiled (n_pad, t_pad) shape. Gate: ZERO
+      structure-kernel recompiles after the warmup cut.
+    - oracle spot check: the device kernel vs the pure-Python reference
+      on sampled traces drawn from the same topology generator.
+    """
+    from tempo_tpu.generator.instance import (
+        GeneratorConfig, GeneratorInstance)
+    from tempo_tpu.generator.processors.traceanalytics import (
+        TraceAnalyticsConfig)
+    from tempo_tpu.model.span_batch import SpanBatchBuilder
+    from tempo_tpu.obs.jaxruntime import JIT_COMPILES
+    from tempo_tpu.ops import structure
+
+    spans_per_trace = 64
+    traces_per_push = 16
+    cut_every = 64                      # pushes per structural cut
+    n_pushes = int(os.environ.get("TEMPO_BENCH_STRUCTURE_PUSHES", 1024))
+    n_pushes = max(n_pushes - n_pushes % cut_every, cut_every)
+    total_spans = n_pushes * traces_per_push * spans_per_trace
+
+    def add_trace(b, rng, shape: int) -> None:
+        tid = rng.bytes(16)
+        sids = [rng.bytes(8) for _ in range(spans_per_trace)]
+        t0 = 10**18
+        for i in range(spans_per_trace):
+            if i == 0:
+                par = b""
+            elif shape == 0:            # deep chain
+                par = sids[i - 1]
+            elif shape == 1:            # wide fan
+                par = sids[0]
+            else:                       # random tree
+                par = sids[int(rng.integers(0, i))]
+            # shape 2 carries an errored subtree rooted mid-tree
+            err = shape == 2 and i >= spans_per_trace - 16
+            b.append(trace_id=tid, span_id=sids[i], parent_span_id=par,
+                     name=f"op-{i % 8}", service=f"svc-{i % 8}",
+                     kind=2, status_code=2 if err else 0,
+                     start_unix_nano=t0 + i * 1000,
+                     end_unix_nano=t0 + i * 1000
+                     + int(rng.lognormal(15, 1.0)))
+
+    def push_batch_for(inst, push_i: int):
+        rng = np.random.default_rng(push_i)
+        b = SpanBatchBuilder(inst.registry.interner)
+        for t in range(traces_per_push):
+            add_trace(b, rng, (push_i + t) % 3)
+        return b.build()
+
+    def run_arm(with_ta: bool) -> tuple[float, GeneratorInstance]:
+        procs = ("span-metrics", "trace-analytics") if with_ta \
+            else ("span-metrics",)
+        clock = [1000.0]
+        inst = GeneratorInstance(
+            "bench", GeneratorConfig(
+                processors=procs, ingestion_time_range_slack_s=0.0,
+                traceanalytics=TraceAnalyticsConfig(
+                    trace_idle_s=1.0, late_window_s=5.0,
+                    use_scheduler=False)),
+            now=lambda: clock[0])
+        # warmup at the exact steady shapes (spanmetrics fused update +
+        # one full-cadence structural cut) so compile time stays out of
+        # the throughput numbers and the recompile gate starts armed
+        for i in range(cut_every):
+            inst.push_batch(push_batch_for(inst, 10**6 + i))
+        inst.tick(immediate=True)
+        inst.drain()
+        pw: list = []                   # per-push ingest-path walls
+        cut_wall = 0.0                  # tick-time structural analysis
+        for i in range(n_pushes):
+            sb = push_batch_for(inst, i)    # build cost untimed
+            # the clock must ADVANCE like production wall time does, or
+            # the late-window bookkeeping never expires and the on-arm
+            # pays GC for an unboundedly growing recent-trace set
+            clock[0] += 0.05
+            t0 = time.perf_counter()
+            inst.push_batch(sb)
+            pw.append(time.perf_counter() - t0)
+            if (i + 1) % cut_every == 0:
+                t0 = time.perf_counter()
+                inst.tick(immediate=True)
+                cut_wall += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        inst.drain()
+        cut_wall += time.perf_counter() - t0
+        # median per-push x count: single-core GC / interference spikes
+        # land on arbitrary pushes; the median is the steady path cost
+        wall = float(np.median(pw)) * n_pushes
+        return wall, cut_wall, inst
+
+    wall_off, _, _ = run_arm(False)
+    compiles0 = JIT_COMPILES.value(("traceanalytics_structure",))
+    wall_on, cut_wall, inst_on = run_arm(True)
+    # warmup compiled the (65536, 1024) cut shape; the measured loop
+    # must not have added any
+    steady_compiles = int(
+        JIT_COMPILES.value(("traceanalytics_structure",)) - compiles0 - 1)
+    sps_off = total_spans / wall_off
+    sps_on = total_spans / wall_on
+    overhead_pct = (wall_on - wall_off) / wall_off * 100.0
+    n_cuts = n_pushes // cut_every
+    ta = inst_on.processors["trace-analytics"]
+    assert ta.spans_buffered == 0      # every trace cut and analyzed
+
+    # oracle spot check on sampled mixed-topology traces
+    rng = np.random.default_rng(42)
+    ob = SpanBatchBuilder(inst_on.registry.interner)
+    for t in range(12):
+        add_trace(ob, rng, t % 3)
+    sb = ob.build()
+    ns = sb.n                           # batch arrays are padded past n
+    grp = np.repeat(np.arange(12, dtype=np.int32), spans_per_trace)
+    err = sb.status_code[:ns] == 2
+    res = structure.analyze(grp, sb.span_id[:ns], sb.parent_span_id[:ns],
+                            sb.end_unix_nano[:ns], err, 12, 1024, 16)
+    ref = structure.reference_analysis(
+        grp, sb.span_id[:ns], sb.parent_span_id[:ns],
+        sb.end_unix_nano[:ns], err)
+    oracle_ok = all(
+        np.array_equal(res[k], ref[k])
+        for k in ("parent_row", "on_path", "bc", "ebc", "cyclic"))
+
+    accept = bool(overhead_pct < 10.0 and steady_compiles == 0
+                  and oracle_ok)
+    return {
+        "structure_total_spans": total_spans,
+        "structure_off_spans_per_sec": round(sps_off, 1),
+        "structure_on_spans_per_sec": round(sps_on, 1),
+        "structure_overhead_pct": round(overhead_pct, 2),
+        "structure_cut_traces": int(n_pushes * traces_per_push),
+        "structure_cut_ms_per_cut": round(cut_wall / n_cuts * 1000.0, 2),
+        "structure_analysis_spans_per_sec":
+            round(total_spans / cut_wall, 1),
+        "structure_steady_state_compiles": steady_compiles,
+        "structure_oracle_ok": oracle_ok,
+        "structure_accept_ok": accept,
+    }
+
+
 STAGES = {"e2e": bench_e2e_ingest, "kernel": bench_kernel,
           "query": bench_query, "obs": bench_obs, "sched": bench_sched,
           "saturation": bench_saturation, "multichip": bench_multichip,
           "pages": bench_pages, "moments": bench_moments,
           "paged_fused": bench_paged_fused, "soak": bench_soak,
           "fleet": bench_fleet, "matview": bench_matview,
-          "chaos": bench_chaos, "selftrace": bench_selftrace}
+          "chaos": bench_chaos, "selftrace": bench_selftrace,
+          "structure": bench_structure}
 
 
 def _cpu_env(env: dict) -> dict:
@@ -3924,6 +4080,20 @@ def main() -> int:
         "selftrace_steady_state_compiles": results.get(
             "selftrace_steady_state_compiles"),
         "selftrace_accept_ok": results.get("selftrace_accept_ok"),
+        # structural trace analytics (ISSUE 18): ingest cost of the
+        # critical-path/error-propagation tier on mixed topologies
+        "structure_off_spans_per_sec": results.get(
+            "structure_off_spans_per_sec"),
+        "structure_on_spans_per_sec": results.get(
+            "structure_on_spans_per_sec"),
+        "structure_overhead_pct": results.get("structure_overhead_pct"),
+        "structure_cut_ms_per_cut": results.get("structure_cut_ms_per_cut"),
+        "structure_analysis_spans_per_sec": results.get(
+            "structure_analysis_spans_per_sec"),
+        "structure_steady_state_compiles": results.get(
+            "structure_steady_state_compiles"),
+        "structure_oracle_ok": results.get("structure_oracle_ok"),
+        "structure_accept_ok": results.get("structure_accept_ok"),
     }
     if errors:
         extra["errors"] = errors

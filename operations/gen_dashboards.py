@@ -307,6 +307,24 @@ def dashboards() -> dict[str, dict]:
                   _rate("tempo_wal_replayed_batches_total"),
                   _rate("tempo_wal_dead_letters_total"),
                   "max(tempo_wal_replay_lag_seconds)"),
+                # structural trace analytics (runbook "Critical paths
+                # and error propagation"): which services BOUND request
+                # latency, which ROOT-CAUSE error cascades, and the
+                # trace-hygiene signals that say how much structure the
+                # analyzer could not trust
+                p("Critical-path seconds /s by service",
+                  _rate("tempo_critical_path_seconds_total", "service"),
+                  legend="{{service}}"),
+                p("Error root causes /s by root service",
+                  _rate("tempo_error_root_cause_total", "root_service"),
+                  legend="{{root_service}}"),
+                p("Trace hygiene /s: late, cycle, orphan spans",
+                  _rate("tempo_traceanalytics_late_spans_total"),
+                  _rate("tempo_traceanalytics_cycle_spans_total"),
+                  _rate("tempo_dataquality_orphan_spans_total")),
+                p("Traces analyzed /s + analysis p99",
+                  _rate("tempo_traceanalytics_cut_traces_total"),
+                  _p99("tempo_traceanalytics_analysis_seconds")),
             ]),
         "tempo-tpu-resources.json": dash(
             "Tempo-TPU / Resources",

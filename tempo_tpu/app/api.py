@@ -527,8 +527,10 @@ class Handler(BaseHTTPRequestHandler):
             if inst is None or not inst.try_track():
                 return self._reply(200, _json_bytes({"quantiles": []}))
             try:
-                proc = inst.processors.get("span-metrics")
-                if proc is None:
+                # ?proc=trace-analytics serves critical-path latency-
+                # share quantiles from the structural analytics sidecar
+                proc = inst.processors.get(q.get("proc", "span-metrics"))
+                if proc is None or not hasattr(proc, "quantile"):
                     return self._reply(200, _json_bytes({"quantiles": []}))
                 got = proc.quantile(float(q.get("q", 0.99)))
             finally:

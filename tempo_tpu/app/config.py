@@ -290,6 +290,40 @@ class Config:
                 "layout (pages.enabled: true) — serve time stays on f32 "
                 "state; see runbook 'Choosing the update kernel' for the "
                 "tier's documented tolerances")
+        ta = self.generator.traceanalytics
+        if ta.trace_idle_s <= 0:
+            warnings.append(
+                "generator.traceanalytics.trace_idle_s must be > 0: the "
+                "idle cut IS the trace-completion signal; 0 would analyze "
+                "every trace after its first push and count the rest of "
+                "its spans late")
+        if ta.late_window_s < 0:
+            warnings.append(
+                "generator.traceanalytics.late_window_s < 0: use 0 to "
+                "disable late-span counting, positive seconds to bound "
+                "the post-cut window")
+        if not (2 <= ta.max_spans_per_trace <= 65536):
+            warnings.append(
+                f"generator.traceanalytics.max_spans_per_trace "
+                f"({ta.max_spans_per_trace}) outside 2..65536: one span "
+                "cannot form an edge, beyond 64Ki a single trace owns "
+                "the whole analysis batch — spans past the cap count "
+                "late rather than grow the buffer unboundedly")
+        if ta.max_live_traces < 1:
+            warnings.append(
+                "generator.traceanalytics.max_live_traces must be >= 1: "
+                "the live buffer needs room for at least one trace "
+                "(overflow force-cuts the oldest quarter)")
+        if not (2 <= ta.moments_k <= 16):
+            warnings.append(
+                f"generator.traceanalytics.moments_k ({ta.moments_k}) "
+                "outside 2..16 (same bounds as the spanmetrics sketch) — "
+                "serve time clamps into range")
+        if not (0 < ta.share_min < ta.share_max <= 1.0):
+            warnings.append(
+                "generator.traceanalytics.share_{min,max} must satisfy "
+                "0 < min < max <= 1: latency shares are fractions of "
+                "the trace's end-to-end duration")
         mvc = self.matview
         if mvc.enabled:
             if mvc.window_steps < 2:

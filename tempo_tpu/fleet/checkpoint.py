@@ -86,6 +86,18 @@ def overrides_fingerprint(inst) -> str:
             "compact_state": bool(sm.compact_state),
         },
     }
+    if "trace-analytics" in inst.processors:
+        # conditional: tenants without the processor keep the exact
+        # fingerprints their pre-analytics checkpoints carry
+        ta = inst.cfg.traceanalytics
+        doc["traceanalytics"] = {
+            "enable_latency_share_sketch":
+                bool(ta.enable_latency_share_sketch),
+            "moments_k": int(ta.moments_k),
+            "sketch_max_series": int(ta.sketch_max_series),
+            "share_min": float(ta.share_min),
+            "share_max": float(ta.share_max),
+        }
     raw = json.dumps(doc, sort_keys=True).encode()
     return hashlib.sha256(raw).hexdigest()[:16]
 
@@ -327,6 +339,22 @@ def snapshot_instance(inst) -> bytes:
             meta["spanmetrics"]["family"] = proc.calls.name
             for k, v in srows.items():
                 arrays[f"__sketch__::{k}"] = v
+        # processor-keyed aux sidecars (generalized sketch slot): any
+        # processor exposing aux_checkpoint ships slot-aligned planes
+        # tied to one family's active-slot order (trace-analytics
+        # latency-share moments ride here)
+        for pname, proc in inst.processors.items():
+            fn = getattr(proc, "aux_checkpoint", None)
+            if fn is None:
+                continue
+            fam = proc.aux_family()
+            ameta, arows = fn(fam.table.active_slots())
+            if ameta is None:
+                continue
+            ameta["family"] = fam.name
+            meta.setdefault("aux", {})[pname] = ameta
+            for k, v in arows.items():
+                arrays[f"__aux__::{pname}::{k}"] = v
     blob = _encode(meta, arrays)
     STATS["checkpoint_seconds"] += time.perf_counter() - t0
     STATS["checkpoint_bytes"] += len(blob)
@@ -366,6 +394,17 @@ def restore_instance(inst, blob: bytes) -> dict:
             raise CheckpointMismatch(
                 "checkpoint carries sketch planes but this instance has "
                 "no span-metrics processor")
+    # aux guards follow the same no-write-before-validation discipline
+    aux_meta = meta.get("aux") or {}
+    aux_procs: dict = {}
+    for pname, ameta in aux_meta.items():
+        proc = inst.processors.get(pname)
+        if proc is None or getattr(proc, "aux_restore", None) is None:
+            raise CheckpointMismatch(
+                f"checkpoint carries aux planes for processor {pname!r} "
+                "which is not enabled on this instance")
+        proc.aux_meta_check(ameta)  # ValueError on layout mismatch
+        aux_procs[pname] = proc
     strings = meta.get("strings", [])
     idmap = reg.interner.intern_many(strings) if strings \
         else np.zeros(0, np.int32)
@@ -391,6 +430,7 @@ def restore_instance(inst, blob: bytes) -> dict:
                     f"({_family_kind(mt)}, {list(mt.label_names)})")
         calls_live_slots = None
         calls_ok = None
+        aux_slots: dict = {}  # processor name -> (slots, ok) of its family
         resolved: dict[str, tuple] = {}  # keys_of -> (slots, ok)
         for name, fam in meta["families"].items():
             mt = reg._metrics.get(name)
@@ -422,11 +462,22 @@ def restore_instance(inst, blob: bytes) -> dict:
             _family_restore(mt, slots[ok], rows)
             if sk_proc is not None and name == sk_proc.calls.name:
                 calls_live_slots, calls_ok = slots, ok
+            for pname in aux_procs:
+                if name == aux_meta[pname]["family"]:
+                    aux_slots[pname] = (slots, ok)
         if sk_proc is not None and calls_live_slots is not None:
             srows = {k[len("__sketch__::"):]: v for k, v in arrays.items()
                      if k.startswith("__sketch__::")}
             sk_proc.sketch_restore(meta["spanmetrics"], calls_live_slots,
                                    calls_ok, srows)
+        for pname, proc in aux_procs.items():
+            got = aux_slots.get(pname)
+            if got is None:
+                continue  # anchor family empty in the blob: nothing to merge
+            prefix = f"__aux__::{pname}::"
+            arows = {k[len(prefix):]: v for k, v in arrays.items()
+                     if k.startswith(prefix)}
+            proc.aux_restore(aux_meta[pname], got[0], got[1], arows)
     # merge WAL watermarks (max seq per member): the local replay must
     # skip records this blob's lineage already holds
     marks = getattr(inst, "wal_watermarks", None)

@@ -115,6 +115,20 @@ class Generator:
                 if sm_patch:
                     cfg.spanmetrics = dataclasses.replace(
                         cfg.spanmetrics, **sm_patch)
+                ta_patch = {}
+                if lim.generator.ta_trace_idle_s:
+                    ta_patch["trace_idle_s"] = lim.generator.ta_trace_idle_s
+                if lim.generator.ta_late_window_s:
+                    ta_patch["late_window_s"] = lim.generator.ta_late_window_s
+                if lim.generator.ta_max_live_traces:
+                    ta_patch["max_live_traces"] = \
+                        lim.generator.ta_max_live_traces
+                if lim.generator.ta_max_spans_per_trace:
+                    ta_patch["max_spans_per_trace"] = \
+                        lim.generator.ta_max_spans_per_trace
+                if ta_patch:
+                    cfg.traceanalytics = dataclasses.replace(
+                        cfg.traceanalytics, **ta_patch)
                 inst = GeneratorInstance(tenant, cfg, now=self.now)
                 inst._matview_limits = \
                     lambda t=tenant: self.overrides.for_tenant(t)

@@ -25,6 +25,10 @@ from tempo_tpu.generator.processors.spanmetrics import (
     SpanMetricsConfig,
     SpanMetricsProcessor,
 )
+from tempo_tpu.generator.processors.traceanalytics import (
+    TraceAnalyticsConfig,
+    TraceAnalyticsProcessor,
+)
 from tempo_tpu.generator.remote_write import RemoteWriteClient, RemoteWriteConfig
 from tempo_tpu.model.span_batch import SpanBatch
 from tempo_tpu.registry import ManagedRegistry, RegistryOverrides
@@ -42,6 +46,8 @@ class GeneratorConfig:
     registry: RegistryOverrides = dataclasses.field(default_factory=RegistryOverrides)
     spanmetrics: SpanMetricsConfig = dataclasses.field(default_factory=SpanMetricsConfig)
     servicegraphs: ServiceGraphsConfig = dataclasses.field(default_factory=ServiceGraphsConfig)
+    traceanalytics: TraceAnalyticsConfig = dataclasses.field(
+        default_factory=TraceAnalyticsConfig)
     remote_write: RemoteWriteConfig = dataclasses.field(default_factory=RemoteWriteConfig)
     localblocks: "LocalBlocksConfig" = dataclasses.field(
         default_factory=_lb_config)
@@ -163,6 +169,9 @@ class GeneratorInstance:
                 elif name == "service-graphs":
                     self.processors[name] = ServiceGraphsProcessor(
                         self.registry, self.cfg.servicegraphs)
+                elif name == "trace-analytics":
+                    self.processors[name] = TraceAnalyticsProcessor(
+                        self.registry, self.cfg.traceanalytics)
                 elif name == "local-blocks":
                     from tempo_tpu.generator.processors.localblocks import (
                         LocalBlocksProcessor)
@@ -314,6 +323,8 @@ class GeneratorInstance:
             if isinstance(proc, SpanMetricsProcessor):
                 proc.push_batch(sb, span_sizes,
                                 sample_weights=sample_weights)
+            elif isinstance(proc, TraceAnalyticsProcessor):
+                proc.push_batch(sb, sample_weights=sample_weights)
             else:
                 proc.push_batch(sb)
 
@@ -373,10 +384,14 @@ class GeneratorInstance:
     # -- maintenance -------------------------------------------------------
 
     def tick(self, immediate: bool = False) -> None:
-        """Background maintenance: localblocks cut/complete/flush pass."""
+        """Background maintenance: localblocks cut/complete/flush pass
+        and the trace-analytics idle-trace cut."""
         lb = self.processors.get("local-blocks")
         if lb is not None:
             lb.cut_tick(immediate=immediate)
+        ta = self.processors.get("trace-analytics")
+        if ta is not None:
+            ta.cut_tick(immediate=immediate)
 
     # -- reads (recent-data query entry points) ----------------------------
 

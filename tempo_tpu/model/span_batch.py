@@ -61,6 +61,22 @@ def _pad_width(k: int) -> int:
     return k
 
 
+def void_keys(*cols: np.ndarray) -> np.ndarray:
+    """One opaque fixed-width key per row over [n, w] byte columns.
+
+    Concatenates the columns and reinterprets each row as a single
+    `np.void` scalar — the vectorized replacement for per-row
+    `tobytes()` concatenation loops (servicegraphs edge keys, the
+    trace-analytics live-trace index). Void rows sort / unique /
+    searchsorted byte-lexicographically; `keys[i].item()` yields the
+    exact bytes the old per-row concatenation produced, for dict keys
+    (numpy 2 void SCALARS are unhashable, their `.item()` bytes are)."""
+    mats = [np.asarray(c) for c in cols]
+    mat = mats[0] if len(mats) == 1 else np.concatenate(mats, axis=1)
+    mat = np.ascontiguousarray(mat)
+    return mat.view(np.dtype((np.void, mat.shape[1]))).ravel()
+
+
 @dataclasses.dataclass
 class SpanBatch:
     """Host-resident SoA span batch. `n` real spans, arrays padded beyond."""

@@ -72,6 +72,12 @@ class GeneratorLimits:
     lb_max_block_duration_s: float = 60.0
     lb_max_block_bytes: int = 500_000_000
     lb_flush_to_storage: bool = False
+    # trace-analytics knobs (0 = the process default from
+    # generator.traceanalytics)
+    ta_trace_idle_s: float = 0.0
+    ta_late_window_s: float = 0.0
+    ta_max_live_traces: int = 0
+    ta_max_spans_per_trace: int = 0
 
 
 @dataclasses.dataclass

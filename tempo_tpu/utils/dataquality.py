@@ -19,6 +19,55 @@ REASON_PAST = "disparate_past_time"
 _FUTURE_S = 2 * 3600.0          # dataquality.go thresholds
 _PAST_S = 14 * 24 * 3600.0
 
+# ---------------------------------------------------------------------------
+# orphan-parent spans — process-wide, fed by the trace-analytics cut
+# ---------------------------------------------------------------------------
+#
+# A span with a non-zero parent id whose parent never arrived within its
+# trace by cut time. These previously vanished silently; the structural
+# analytics tier both needs the signal (an orphan invalidates its
+# subtree's critical path) and surfaces it here for operators. Process-
+# wide like the RUNTIME families: orphanhood is decided per cut, not per
+# App, and the counter must exist (for the dashboard drift gate) even in
+# processes that never enable the processor.
+
+_orphan_lock = threading.Lock()
+_orphan_spans: dict[str, int] = {}      # tenant -> total
+
+
+def note_orphan_spans(tenant: str, n: int) -> None:
+    if n <= 0:
+        return
+    with _orphan_lock:
+        _orphan_spans[tenant] = _orphan_spans.get(tenant, 0) + int(n)
+
+
+def orphan_spans_snapshot() -> dict[str, int]:
+    with _orphan_lock:
+        return dict(_orphan_spans)
+
+
+def reset_orphan_spans() -> None:
+    """Test hook: counters are process-wide and monotonic."""
+    with _orphan_lock:
+        _orphan_spans.clear()
+
+
+def _register_orphan_counter() -> None:
+    from tempo_tpu.obs.jaxruntime import RUNTIME
+
+    RUNTIME.counter_func(
+        "tempo_dataquality_orphan_spans_total",
+        lambda: [((t,), float(v)) for t, v in orphan_spans_snapshot().items()
+                 if v],
+        help="Spans whose non-zero parent span id never resolved within "
+             "their trace by analytics cut time (trace-analytics "
+             "processor; subtree excluded from critical-path attribution)",
+        labels=("tenant",))
+
+
+_register_orphan_counter()
+
 
 class DataQuality:
     """Per-tenant warning counters, exposed on /metrics as
@@ -69,4 +118,6 @@ class DataQuality:
 
 
 __all__ = ["DataQuality", "REASON_FUTURE", "REASON_PAST",
-           "REASON_OUTSIDE_INGESTION_SLACK", "REASON_BLOCK_OUTSIDE_SLACK"]
+           "REASON_OUTSIDE_INGESTION_SLACK", "REASON_BLOCK_OUTSIDE_SLACK",
+           "note_orphan_spans", "orphan_spans_snapshot",
+           "reset_orphan_spans"]

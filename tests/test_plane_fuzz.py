@@ -444,6 +444,122 @@ def test_fuzz_compact_tier_within_tolerance():
             f"seed={SEED} {k}: {va} vs {qb[k]}"
 
 
+# -- trace-analytics structural-plane differential arm ------------------------
+#
+# The write-plane gate for the structural tier (critical-path seconds,
+# error root-cause counts, latency-share moments): random
+# push/cut/purge/collect/quantile scripts across randomized trace DAGs
+# must be BIT-identical (1) between the paged and dense layouts and
+# (2) between the direct dispatch route and the device-scheduler route
+# (one coalesced job per plane per cut) — including evict rounds that
+# zero share-sketch rows and the immediate reuse of freed pages/slots.
+
+def _ta_make_world(paged: bool, use_sched: bool):
+    from tempo_tpu.generator.processors.traceanalytics import (
+        TraceAnalyticsConfig, TraceAnalyticsProcessor)
+    from tempo_tpu.registry import pages as device_pages
+    from tempo_tpu.registry.registry import ManagedRegistry, RegistryOverrides
+
+    clock = [1000.0]
+    pool = device_pages.PagePool(device_pages.PagePoolConfig(
+        enabled=True, page_rows=16, arena_slots=1024)) if paged else None
+    with device_pages.use(pool):
+        reg = ManagedRegistry(
+            "ta", RegistryOverrides(max_active_series=64,
+                                    stale_duration_s=50.0),
+            now=lambda: clock[0])
+        proc = TraceAnalyticsProcessor(reg, TraceAnalyticsConfig(
+            trace_idle_s=1.0, use_scheduler=use_sched,
+            sketch_max_series=32))
+    return clock, reg, proc
+
+
+def _ta_batch(reg, rng: random.Random, n_traces: int):
+    from tempo_tpu.model.span_batch import SpanBatchBuilder
+
+    b = SpanBatchBuilder(reg.interner)
+    for _ in range(n_traces):
+        tid = rng.getrandbits(128).to_bytes(16, "big")
+        sids = [rng.getrandbits(64).to_bytes(8, "big")
+                for _ in range(rng.randrange(2, 7))]
+        t0 = 10**18
+        for i, sid in enumerate(sids):
+            par = b"" if i == 0 else sids[rng.randrange(0, i)]
+            if rng.random() < 0.05:          # orphan pointer
+                par = rng.getrandbits(64).to_bytes(8, "big")
+            b.append(trace_id=tid, span_id=sid, parent_span_id=par,
+                     name=f"op-{rng.randrange(8)}",
+                     service=f"svc-{rng.randrange(4)}",
+                     status_code=2 if rng.random() < 0.3 else 0,
+                     start_unix_nano=t0 + i,
+                     end_unix_nano=t0 + rng.randrange(10**6, 10**9))
+    return b.build()
+
+
+def test_fuzz_traceanalytics_paged_sched_differential():
+    from tempo_tpu import sched
+    from tempo_tpu.sched.scheduler import SchedConfig
+
+    sched.configure(SchedConfig(batch_window_ms=0.0))
+    n_ops = max(int(os.environ.get("TEMPO_FUZZ_CASES", 40)) // 2, 15)
+    # three worlds, two axes: paged-vs-dense (direct route) and
+    # direct-vs-scheduler (paged layout)
+    worlds = [_ta_make_world(paged=True, use_sched=False),
+              _ta_make_world(paged=False, use_sched=False),
+              _ta_make_world(paged=True, use_sched=True)]
+    script = random.Random(SEED + 9)
+    for step in range(n_ops):
+        op = script.choice(["push", "push", "cut", "cut", "purge",
+                            "collect", "quantile", "idle"])
+        seed = script.randrange(1 << 30)
+        nt = script.choice([3, 8, 20])
+        dt = script.choice([0.0, 2.0, 60.0])
+        ctx = f"seed={SEED} step={step} op={op}"
+        results = []
+        for clock, reg, proc in worlds:
+            rng = random.Random(seed)
+            clock[0] += dt
+            if op == "push":
+                proc.push_batch(_ta_batch(reg, rng, nt))
+                results.append(proc.spans_buffered)
+            elif op == "cut":
+                proc.cut_tick(immediate=script.random() < 0.5)
+                sched.flush()
+                results.append(len(proc._live))
+            elif op == "purge":
+                sched.flush()       # in-flight adds land before eviction
+                results.append(reg.purge_stale())
+            elif op == "collect":
+                sched.flush()
+                results.append(sorted(
+                    (s.name, s.labels, s.value)
+                    for s in reg.collect(step) if s.value == s.value))
+            elif op == "quantile":
+                results.append(proc.quantile(rng.choice([0.5, 0.9])))
+            else:
+                results.append(None)
+        assert results[0] == results[1] == results[2], ctx
+    # deterministic evict-reuse coda: cut and age out EVERYTHING, purge
+    # (zeroing the share rows of every evicted slot), then repopulate —
+    # the paged worlds recycle freed physical pages, the dense world
+    # reuses slots; answers must reflect ONLY the new stream
+    for clock, reg, proc in worlds:
+        proc.cut_tick(immediate=True)
+        sched.flush()
+        clock[0] += 1000.0
+        reg.purge_stale()
+        proc.push_batch(_ta_batch(reg, random.Random(SEED + 10), 12))
+        proc.cut_tick(immediate=True)
+        sched.flush()
+    finals = [sorted((s.name, s.labels, s.value)
+                     for s in w[1].collect(10**6) if s.value == s.value)
+              for w in worlds]
+    assert finals[0] == finals[1] == finals[2], f"seed={SEED} final collect"
+    qq = [w[2].quantile(0.9) for w in worlds]
+    assert qq[0] == qq[1] == qq[2], f"seed={SEED} final quantile"
+    assert qq[0], f"seed={SEED}: coda produced no share-quantile series"
+
+
 def _mx_make_world(paged: bool):
     from tempo_tpu.generator.processors.spanmetrics import (
         SpanMetricsConfig, SpanMetricsProcessor)

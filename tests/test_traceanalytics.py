@@ -1,0 +1,530 @@
+"""trace-analytics processor: structural critical-path + error propagation.
+
+Correctness contract: the vectorized device kernel (sorted-id parent
+resolution, lexicographic bounding-child argmax, log-depth pointer
+jumping) is differentially tested against a pure-Python oracle on random
+DAGs — fan-out/depth mixes, async gaps, overlapping children, injected
+cycles, orphans, duplicate span ids. Degradation contract: corrupt
+structure COUNTS (cycle/orphan/late counters), never hangs or skews.
+Durability contract: the share-moments sidecar rides fleet
+checkpoint/restore via the aux mechanism and WAL replay reproduces
+planes bit-identically.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tempo_tpu.fleet import checkpoint as ck
+from tempo_tpu.generator.instance import GeneratorConfig, GeneratorInstance
+from tempo_tpu.generator.processors import traceanalytics as ta_mod
+from tempo_tpu.generator.processors.traceanalytics import (
+    TraceAnalyticsConfig,
+)
+from tempo_tpu.model.span_batch import SpanBatchBuilder, void_keys
+from tempo_tpu.ops import structure
+
+T0 = 1_700_000_000.0
+
+
+def _ns(s: float) -> int:
+    return int(s * 1e9)
+
+
+def _bucket(n: int, lo: int) -> int:
+    import math
+    return 1 << math.ceil(math.log2(max(n, lo)))
+
+
+# ---------------------------------------------------------------------------
+# kernel vs oracle differential
+# ---------------------------------------------------------------------------
+
+
+def _gen_structure_batch(n_traces: int, rng) -> tuple:
+    """Random DAG batch with the full corruption menu: orphans, 2-cycles,
+    duplicate span ids, async gaps (children ending after parents)."""
+    grp, sid, pid, start, end, err = [], [], [], [], [], []
+    for t in range(n_traces):
+        n = int(rng.integers(1, 30))
+        ids = rng.integers(1, 2**63, size=n, dtype=np.int64).view(np.uint64)
+        rows = []
+        base = int(rng.integers(0, 10**9)) * 1000
+        for i in range(n):
+            # extra roots model broken instrumentation (multi-root traces)
+            p = 0 if i == 0 or rng.random() < 0.1 \
+                else int(ids[rng.integers(0, i)])
+            s = base + int(rng.integers(0, 10**6))
+            e = s + int(rng.integers(1, 10**6))  # may overlap/outlive parent
+            rows.append((int(ids[i]), p, s, e, rng.random() < 0.3))
+        if rng.random() < 0.3:  # orphan: parent id that resolves nowhere
+            rows.append((int(rng.integers(1, 2**62)),
+                         int(rng.integers(2**62, 2**63)), base, base + 5,
+                         True))
+        if rng.random() < 0.3:  # 2-cycle: spans parenting each other
+            a = int(rng.integers(1, 2**62))
+            b = int(rng.integers(1, 2**62))
+            rows.append((a, b, base, base + 10, False))
+            rows.append((b, a, base, base + 11, True))
+        if rng.random() < 0.2:  # duplicate span id (last definition wins)
+            dup = rows[int(rng.integers(0, len(rows)))]
+            rows.append((dup[0], dup[1], base + 3, base + 7, False))
+        for (i8, p8, s, e, er) in rows:
+            grp.append(t)
+            sid.append(np.frombuffer(np.uint64(i8).tobytes(), np.uint8))
+            pid.append(np.frombuffer(np.uint64(p8).tobytes(), np.uint8))
+            start.append(s)
+            end.append(e)
+            err.append(er)
+    return (np.array(grp, np.int32), np.stack(sid), np.stack(pid),
+            np.array(start, np.int64), np.array(end, np.int64),
+            np.array(err, bool))
+
+
+def test_structure_kernel_matches_oracle():
+    """Device kernel exactly equals the pure-Python reference on random
+    corrupt DAGs: parent rows, path membership, bounding children,
+    errored bounding children, cycle flags, anchors, root causes (on the
+    settled mask), and int64 self-times."""
+    rng = np.random.default_rng(0)
+    for trial in range(12):
+        nt = int(rng.integers(1, 12))
+        grp, sid, pid, start, end, err = _gen_structure_batch(nt, rng)
+        n = len(grp)
+        res = structure.analyze(grp, sid, pid, end, err, nt,
+                                _bucket(n, 256), _bucket(nt, 16))
+        ref = structure.reference_analysis(grp, sid, pid, end, err)
+        for k in ("parent_row", "on_path", "bc", "ebc", "cyclic", "anchor"):
+            assert np.array_equal(res[k], ref[k]), (trial, k)
+        # root cause compared on the settled mask (the same mask the
+        # processor attributes under) — and the masks themselves agree
+        ok = err & ~res["cyclic"] & (res["ebc"][np.clip(res["rc"], 0,
+                                                        n - 1)] < 0)
+        ok_ref = err & ~ref["cyclic"] & (ref["ebc"][np.clip(ref["rc"], 0,
+                                                            n - 1)] < 0)
+        assert np.array_equal(ok, ok_ref), trial
+        assert np.array_equal(res["rc"][ok], ref["rc"][ok]), trial
+        assert np.array_equal(structure.self_times_ns(start, end, res),
+                              structure.self_times_ns(start, end, ref)), trial
+
+
+def test_structure_padding_invariance():
+    """Results must not depend on the pow-2 pad sizes."""
+    rng = np.random.default_rng(7)
+    grp, sid, pid, start, end, err = _gen_structure_batch(5, rng)
+    n = len(grp)
+    a = structure.analyze(grp, sid, pid, end, err, 5,
+                          _bucket(n, 256), _bucket(5, 16))
+    b = structure.analyze(grp, sid, pid, end, err, 5,
+                          _bucket(n, 256) * 4, _bucket(5, 16) * 2)
+    for k in a:
+        assert np.array_equal(a[k], b[k]), k
+
+
+# ---------------------------------------------------------------------------
+# processor end-to-end
+# ---------------------------------------------------------------------------
+
+
+def _ta_cfg(**kw) -> GeneratorConfig:
+    ta = dict(trace_idle_s=1.0, late_window_s=30.0)
+    ta.update(kw)
+    return GeneratorConfig(processors=("trace-analytics",),
+                           traceanalytics=TraceAnalyticsConfig(**ta))
+
+
+def _known_trace(b: SpanBatchBuilder, weights: list | None = None) -> None:
+    """root(svc-a, 10s) -> c1(svc-b, ends 9s, ERR) -> g1(svc-c, ends 8s,
+    ERR); root -> c2(svc-b, ends 5s). Critical path root->c1->g1 with
+    self-times 1s/1s/7s; both errors root-cause to svc-c."""
+    tid = b"\x01" * 16
+    b.append(trace_id=tid, span_id=b"\x01" * 8, name="root", service="svc-a",
+             start_unix_nano=_ns(T0), end_unix_nano=_ns(T0 + 10))
+    b.append(trace_id=tid, span_id=b"\x02" * 8, parent_span_id=b"\x01" * 8,
+             name="c1", service="svc-b", status_code=2,
+             start_unix_nano=_ns(T0 + 0.5), end_unix_nano=_ns(T0 + 9))
+    b.append(trace_id=tid, span_id=b"\x03" * 8, parent_span_id=b"\x02" * 8,
+             name="g1", service="svc-c", status_code=2,
+             start_unix_nano=_ns(T0 + 1), end_unix_nano=_ns(T0 + 8))
+    b.append(trace_id=tid, span_id=b"\x04" * 8, parent_span_id=b"\x01" * 8,
+             name="c2", service="svc-b",
+             start_unix_nano=_ns(T0 + 0.5), end_unix_nano=_ns(T0 + 5))
+
+
+def _collect(gi: GeneratorInstance) -> dict:
+    from tempo_tpu import sched
+    sched.flush()
+    return {(s.name, s.labels): s.value
+            for s in gi.registry.collect(ts_ms=1) if not s.is_stale_marker}
+
+
+def _val(samples: dict, name: str, **labels) -> float:
+    for (n, labs), v in samples.items():
+        if n == name and all((k, want) in labs
+                             for k, want in labels.items()):
+            return v
+    raise KeyError((name, labels, sorted(samples)))
+
+
+def test_processor_known_topology_attribution():
+    clock = [T0]
+    gi = GeneratorInstance("t1", _ta_cfg(), now=lambda: clock[0])
+    b = SpanBatchBuilder(gi.registry.interner)
+    _known_trace(b)
+    # corrupt second trace: a parent 2-cycle — counted, not attributed
+    tid2 = b"\x02" * 16
+    b.append(trace_id=tid2, span_id=b"\x0a" * 8, parent_span_id=b"\x0b" * 8,
+             name="x", service="svc-a",
+             start_unix_nano=_ns(T0), end_unix_nano=_ns(T0 + 1))
+    b.append(trace_id=tid2, span_id=b"\x0b" * 8, parent_span_id=b"\x0a" * 8,
+             name="y", service="svc-a",
+             start_unix_nano=_ns(T0), end_unix_nano=_ns(T0 + 1))
+    gi.push_batch(b.build())
+    clock[0] += 2
+    gi.tick()
+    got = _collect(gi)
+    cp = "tempo_critical_path_seconds_total"
+    assert _val(got, cp, service="svc-a", operation="root") == \
+        pytest.approx(1.0)
+    assert _val(got, cp, service="svc-b", operation="c1") == \
+        pytest.approx(1.0)
+    assert _val(got, cp, service="svc-c", operation="g1") == \
+        pytest.approx(7.0)
+    # c2 is off-path: no series
+    with pytest.raises(KeyError):
+        _val(got, cp, operation="c2")
+    rc = "tempo_error_root_cause_total"
+    assert _val(got, rc, service="svc-b", root_service="svc-c") == 1.0
+    assert _val(got, rc, service="svc-c", root_service="svc-c") == 1.0
+    assert ta_mod._cycle_spans.get("t1") == 2.0
+    assert ta_mod._cut_traces.get("t1") == 2.0
+    # share quantile surface: g1 bounds 70% of its trace's duration
+    q = gi.processors["trace-analytics"].quantile(0.5)
+    shares = {dict(lab)["operation"]: v for lab, v in q.items()}
+    assert shares["g1"] == pytest.approx(0.7, abs=0.05)
+    assert shares["root"] == pytest.approx(0.1, abs=0.05)
+
+
+def test_processor_weighted_attribution():
+    """Horvitz-Thompson sample weights scale both planes linearly."""
+    clock = [T0]
+    gi = GeneratorInstance("t1", _ta_cfg(), now=lambda: clock[0])
+    b = SpanBatchBuilder(gi.registry.interner)
+    _known_trace(b)
+    gi.push_batch(b.build(), sample_weights=np.full(4, 3.0, np.float32))
+    clock[0] += 2
+    gi.tick()
+    got = _collect(gi)
+    assert _val(got, "tempo_critical_path_seconds_total",
+                service="svc-c", operation="g1") == pytest.approx(21.0)
+    assert _val(got, "tempo_error_root_cause_total",
+                service="svc-c", root_service="svc-c") == 3.0
+
+
+def test_late_spans_counted_not_reattributed():
+    clock = [T0]
+    gi = GeneratorInstance("t1", _ta_cfg(late_window_s=10.0),
+                           now=lambda: clock[0])
+    b = SpanBatchBuilder(gi.registry.interner)
+    _known_trace(b)
+    gi.push_batch(b.build())
+    clock[0] += 2
+    gi.tick()
+    base = _collect(gi)
+    # a straggler for the already-cut trace: counted late, planes frozen
+    b2 = SpanBatchBuilder(gi.registry.interner)
+    b2.append(trace_id=b"\x01" * 16, span_id=b"\x05" * 8,
+              parent_span_id=b"\x01" * 8, name="late", service="svc-b",
+              start_unix_nano=_ns(T0), end_unix_nano=_ns(T0 + 20))
+    gi.push_batch(b2.build())
+    clock[0] += 1
+    gi.tick()
+    assert ta_mod._late_spans.get("t1") == 1.0
+    assert _collect(gi) == base
+    # past the late window the key expires and the id becomes a NEW
+    # (single-span) trace — the documented re-open semantics
+    clock[0] += 20
+    gi.tick()
+    gi.push_batch(b2.build())
+    assert ta_mod._late_spans.get("t1") == 1.0
+
+
+def test_orphan_spans_feed_dataquality_counter():
+    from tempo_tpu.utils import dataquality as dq
+    clock = [T0]
+    gi = GeneratorInstance("t1", _ta_cfg(), now=lambda: clock[0])
+    b = SpanBatchBuilder(gi.registry.interner)
+    _known_trace(b)
+    b.append(trace_id=b"\x01" * 16, span_id=b"\x06" * 8,
+             parent_span_id=b"\xee" * 8, name="lost", service="svc-b",
+             start_unix_nano=_ns(T0), end_unix_nano=_ns(T0 + 1))
+    gi.push_batch(b.build())
+    clock[0] += 2
+    gi.tick()
+    assert dq.orphan_spans_snapshot().get("t1") == 1
+
+
+def test_max_spans_per_trace_overflow_counts_late():
+    clock = [T0]
+    gi = GeneratorInstance("t1", _ta_cfg(max_spans_per_trace=8),
+                           now=lambda: clock[0])
+    b = SpanBatchBuilder(gi.registry.interner)
+    tid = b"\x03" * 16
+    for i in range(12):
+        b.append(trace_id=tid, span_id=bytes([i + 1]) * 8,
+                 parent_span_id=b"" if i == 0 else bytes([1]) * 8,
+                 name="op", service="svc",
+                 start_unix_nano=_ns(T0), end_unix_nano=_ns(T0 + 1))
+    gi.push_batch(b.build())
+    assert gi.processors["trace-analytics"].spans_buffered == 8
+    assert ta_mod._late_spans.get("t1") == 4.0
+
+
+def test_max_live_traces_cuts_oldest_early():
+    clock = [T0]
+    gi = GeneratorInstance("t1", _ta_cfg(max_live_traces=8),
+                           now=lambda: clock[0])
+    b = SpanBatchBuilder(gi.registry.interner)
+    for i in range(16):
+        b.append(trace_id=bytes([i + 1]) * 16, span_id=b"\x01" * 8,
+                 name="op", service="svc",
+                 start_unix_nano=_ns(T0), end_unix_nano=_ns(T0 + 1))
+    gi.push_batch(b.build())
+    p = gi.processors["trace-analytics"]
+    assert len(p._live) <= 8
+    assert ta_mod._cut_traces.get("t1", 0) >= 8
+
+
+# ---------------------------------------------------------------------------
+# servicegraphs vectorized keys (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_void_keys_match_byte_concatenation():
+    """The np.void fast path must produce EXACTLY the bytes the old
+    per-span `tobytes() + tobytes()` concatenation produced — the edge
+    store is keyed by these bytes across pushes."""
+    rng = np.random.default_rng(3)
+    tid = rng.integers(0, 256, (50, 16), dtype=np.uint8)
+    sid = rng.integers(0, 256, (50, 8), dtype=np.uint8)
+    keys = void_keys(tid, sid)
+    for i in range(50):
+        assert keys[i].item() == tid[i].tobytes() + sid[i].tobytes()
+    # single-column form too (trace grouping in trace-analytics)
+    k1 = void_keys(tid)
+    assert k1[0].item() == tid[0].tobytes()
+    # vectorized ops the processors rely on behave like bytes equality
+    order = np.argsort(keys, kind="stable")
+    py = sorted(range(50), key=lambda i: keys[i].item())
+    assert order.tolist() == py
+
+
+# ---------------------------------------------------------------------------
+# fleet checkpoint/restore + WAL replay
+# ---------------------------------------------------------------------------
+
+
+def _random_push(gi: GeneratorInstance, seed: int, n_traces: int = 10,
+                 now: float = T0) -> None:
+    rng = np.random.default_rng(seed)
+    b = SpanBatchBuilder(gi.registry.interner)
+    for _ in range(n_traces):
+        tid = rng.bytes(16)
+        sids = [rng.bytes(8) for _ in range(6)]
+        for i in range(6):
+            par = b"" if i == 0 else sids[int(rng.integers(0, i))]
+            b.append(trace_id=tid, span_id=sids[i], parent_span_id=par,
+                     name=f"op-{i % 3}", service=f"svc-{i % 2}",
+                     status_code=int(rng.random() < 0.3) * 2,
+                     start_unix_nano=_ns(now) + i * 1000,
+                     end_unix_nano=_ns(now) + int(rng.integers(10**6,
+                                                               10**9)))
+    gi.push_batch(b.build())
+
+
+def test_checkpoint_roundtrip_aux_planes_bit_identical():
+    """Fresh-instance restore is add-to-zero: counter planes AND the
+    share-moments sidecar (aux mechanism) round-trip bit-identically."""
+    clock = [T0]
+    a = GeneratorInstance("t1", _ta_cfg(), now=lambda: clock[0])
+    _random_push(a, 1)
+    clock[0] += 5
+    a.tick(immediate=True)
+    from tempo_tpu import sched
+    sched.flush()
+    blob = ck.snapshot_instance(a)
+    b = GeneratorInstance("t1", _ta_cfg(), now=lambda: clock[0])
+    stats = ck.restore_instance(b, blob)
+    assert stats["dropped"] == 0 and stats["series"] > 0
+    assert _collect(b) == _collect(a)
+    qa = a.processors["trace-analytics"].quantile(0.9)
+    assert qa and b.processors["trace-analytics"].quantile(0.9) == qa
+
+
+def test_checkpoint_merge_into_nonempty_adds():
+    clock = [T0]
+    a = GeneratorInstance("t1", _ta_cfg(), now=lambda: clock[0])
+    _random_push(a, 1)
+    clock[0] += 5
+    a.tick(immediate=True)
+    from tempo_tpu import sched
+    sched.flush()
+    want = _collect(a)
+    blob = ck.snapshot_instance(a)
+    c = GeneratorInstance("t1", _ta_cfg(), now=lambda: clock[0])
+    _random_push(c, 2, now=clock[0])
+    clock[0] += 5
+    c.tick(immediate=True)
+    sched.flush()
+    before = _collect(c)
+    ck.restore_instance(c, blob)
+    after = _collect(c)
+    for k, v in want.items():
+        assert after[k] == pytest.approx(before.get(k, 0.0) + v, rel=1e-5)
+
+
+def test_checkpoint_refuses_sketch_config_mismatch():
+    """The traceanalytics fingerprint block: a sketch-enabled blob must
+    not merge into a sketch-disabled instance (and the block is absent
+    entirely for tenants without the processor — their fingerprints are
+    unchanged by this feature)."""
+    clock = [T0]
+    a = GeneratorInstance("t1", _ta_cfg(), now=lambda: clock[0])
+    _random_push(a, 1)
+    clock[0] += 5
+    a.tick(immediate=True)
+    from tempo_tpu import sched
+    sched.flush()
+    blob = ck.snapshot_instance(a)
+    d = GeneratorInstance(
+        "t1", _ta_cfg(enable_latency_share_sketch=False),
+        now=lambda: clock[0])
+    with pytest.raises(ck.CheckpointMismatch):
+        ck.restore_instance(d, blob)
+    # the blob actually carries aux planes under the processor key
+    meta, arrays = ck._decode(blob)
+    assert meta["aux"]["trace-analytics"]["family"] == \
+        "tempo_critical_path_seconds_total"
+    assert any(k.startswith("__aux__::trace-analytics::") for k in arrays)
+
+
+def test_wal_replay_reproduces_planes_bit_identically(tmp_path):
+    """Kill-shape recovery: replaying the ingest WAL and cutting
+    reproduces the analytics planes and quantile surface exactly —
+    live (un-cut) traces are WAL state, not checkpoint state."""
+    from tempo_tpu.generator.generator import Generator
+    from tempo_tpu.generator.wal import GeneratorWal, IngestWalConfig
+    from tempo_tpu.model.otlp import encode_spans_otlp
+    from tempo_tpu.overrides import Overrides
+    from tempo_tpu.overrides.limits import Limits
+    from tempo_tpu import sched
+
+    lim = Limits()
+    lim.generator.processors = ("trace-analytics",)
+    lim.generator.ingestion_time_range_slack_s = 0.0
+    lim.generator.collection_interval_s = 3600.0
+
+    def mkgen():
+        wal = GeneratorWal(IngestWalConfig(enabled=True,
+                                           dir=str(tmp_path / "wal")))
+        return Generator(
+            GeneratorConfig(
+                traceanalytics=TraceAnalyticsConfig(trace_idle_s=1.0)),
+            instance_id="m0", overrides=Overrides(defaults=lim), wal=wal)
+
+    rng = np.random.default_rng(9)
+    spans = []
+    for _ in range(8):
+        tid = rng.bytes(16)
+        sids = [rng.bytes(8) for _ in range(5)]
+        for i in range(5):
+            spans.append(dict(
+                trace_id=tid, span_id=sids[i],
+                parent_span_id=b"" if i == 0
+                else sids[int(rng.integers(0, i))],
+                name=f"op-{i % 3}", service=f"svc-{i % 2}",
+                status_code=int(rng.random() < 0.3) * 2,
+                start_unix_nano=_ns(T0) + i,
+                end_unix_nano=_ns(T0) + int(rng.integers(10**6, 10**9))))
+    g1 = mkgen()
+    g1.push_otlp("t1", encode_spans_otlp(spans))
+    g1.instance("t1").tick(immediate=True)
+    sched.flush()
+    want = _collect(g1.instance("t1"))
+    want_q = g1.instance("t1").processors["trace-analytics"].quantile(0.9)
+    assert want_q
+
+    g2 = mkgen()  # abandoned g1: no shutdown, no checkpoint
+    assert g2.replay_wal_all()["batches"] == 1
+    g2.instance("t1").tick(immediate=True)
+    sched.flush()
+    assert _collect(g2.instance("t1")) == want
+    assert g2.instance("t1").processors["trace-analytics"].quantile(0.9) \
+        == want_q
+
+
+def test_quantile_endpoint_serves_latency_shares(tmp_path):
+    """/internal/generator/quantile?proc=trace-analytics serves the
+    critical-path latency-share quantiles over HTTP — the same maxent
+    surface the processor's quantile() computes — and the default proc
+    stays span-metrics (absent here: empty, not an error)."""
+    import json
+    import socket
+    import time as _time
+    import urllib.request
+
+    from tempo_tpu.app import App
+    from tempo_tpu.app.api import serve
+    from tempo_tpu.app.config import Config
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    cfg = Config()
+    cfg.storage.backend = "mem"
+    cfg.storage.wal_path = str(tmp_path / "wal")
+    cfg.server.http_listen_port = port
+    app = App(cfg)
+    app.overrides.set_tenant_patch("single-tenant", {
+        "generator": {"processors": ["trace-analytics"]}})
+    srv = serve(app, block=False)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        rng = np.random.default_rng(3)
+        now_ns = int(_time.time() * 1e9)
+        spans = []
+        tid = rng.bytes(16)
+        sids = [rng.bytes(8) for _ in range(6)]
+        for i in range(6):
+            spans.append(dict(
+                trace_id=tid, span_id=sids[i],
+                parent_span_id=b"" if i == 0 else sids[i - 1],
+                name=f"op-{i % 2}", service="svc", kind=2, status_code=0,
+                start_unix_nano=now_ns + i,
+                end_unix_nano=now_ns + (6 - i) * 10**6))
+        from tempo_tpu.model.otlp import encode_spans_otlp
+        req = urllib.request.Request(
+            f"{base}/v1/traces", data=encode_spans_otlp(spans),
+            headers={"Content-Type": "application/x-protobuf"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+        app.generator.instance("single-tenant").tick(immediate=True)
+        with urllib.request.urlopen(
+                f"{base}/internal/generator/quantile"
+                "?proc=trace-analytics&q=0.5", timeout=10) as r:
+            doc = json.loads(r.read())
+        got = {tuple(tuple(kv) for kv in e["labels"]): e["value"]
+               for e in doc["quantiles"]}
+        want = app.generator.instance("single-tenant") \
+            .processors["trace-analytics"].quantile(0.5)
+        assert got and got == {tuple(k): v for k, v in want.items()}
+        # default proc (span-metrics) is not enabled for this tenant
+        with urllib.request.urlopen(
+                f"{base}/internal/generator/quantile?q=0.5",
+                timeout=10) as r:
+            assert json.loads(r.read())["quantiles"] == []
+    finally:
+        srv.shutdown()
+        app.shutdown()
