@@ -3643,6 +3643,191 @@ def bench_structure() -> dict:
     }
 
 
+def bench_coldtier() -> dict:
+    """Device-accelerated cold tier (ISSUE 19): compaction on device vs
+    the host compactor, and historical queries folded from sketch
+    sidecars vs a full block rescan.
+
+    Arms:
+    - compaction: N overlapping RF1 blocks (duplicate trace ids across
+      blocks, duplicate spans within traces) compacted by the host
+      heapq/combine_spans path vs the device decode-once/two-sort path.
+      Parity gate: reader row-for-row bit equality of the outputs.
+      Speedup gate (accelerator only): >=3x; the CPU backend runs the
+      same XLA kernel without the hardware the route targets, so there
+      the run is parity-gated only.
+    - historical quantile: a window 10x the warm tier, every block
+      carrying a sidecar. quantile_over_time via the sidecar fold vs the
+      same query with folds disabled (full rescan). Gates: fold answer
+      within the moments error gate of the exact per-span oracle
+      (min(rel, rank-shift) <= 0.05) and >=10x faster than the rescan
+      arm — warm-read latency for cold data.
+    - kernel health: ZERO compaction_merge recompiles after the warmup
+      compaction (pad_pow2 buckets the merge shape).
+    """
+    from tempo_tpu.backend.mem import MemBackend
+    from tempo_tpu.block.reader import BackendBlock
+    from tempo_tpu.db import CompactorConfig, TempoDB, TempoDBConfig
+    from tempo_tpu.db import compactor as comp
+    from tempo_tpu.frontend import Frontend, FrontendConfig
+    from tempo_tpu.obs.jaxruntime import JIT_COMPILES
+    from tempo_tpu.querier import Querier
+    from tempo_tpu.querier.querier import QuerierConfig
+    from tempo_tpu.ring import Ring
+    import jax
+
+    platform = jax.devices()[0].platform
+    n_blocks = int(os.environ.get("TEMPO_BENCH_COLDTIER_BLOCKS", 8))
+    traces_per_block = int(os.environ.get(
+        "TEMPO_BENCH_COLDTIER_TRACES", 3000))
+    t_base = 1_700_000_000.0
+    rng = np.random.default_rng(19)
+
+    def mkblocks():
+        """Overlapping blocks: half of each block's traces are shared
+        with the next block (dup trace ids AND dup spans — the RF
+        overlap compaction exists to dedup)."""
+        pool = []
+        for i in range(traces_per_block * (n_blocks + 1) // 2):
+            tid = rng.bytes(16)
+            t0 = int((t_base + (i % 997)) * 1e9)
+            spans = [{"trace_id": tid, "span_id": rng.bytes(8),
+                      "name": f"op-{i % 8}", "service": f"svc-{i % 4}",
+                      "start_unix_nano": t0,
+                      "end_unix_nano": t0 + int(rng.lognormal(17, 0.5))}
+                     for _ in range(2)]
+            pool.append((tid, spans))
+        half = traces_per_block // 2
+        return [sorted(pool[b * half:(b * half) + traces_per_block],
+                       key=lambda t: t[0]) for b in range(n_blocks)]
+
+    blocks = mkblocks()
+
+    def seed():
+        be = MemBackend()
+        db = TempoDB(be, be, TempoDBConfig(row_group_rows=2000))
+        for blk in blocks:
+            db.write_block("t1", blk, replication_factor=1)
+        db.poll_now()
+        return be, sorted(db.blocks("t1"), key=lambda m: m.block_id)
+
+    cfg = CompactorConfig()
+    total_spans = sum(len(s) for blk in blocks for _, s in blk)
+
+    # warmup: compile the merge kernel at the measured pad bucket
+    be_w, metas_w = seed()
+    comp.compact_device(be_w, be_w, "t1", metas_w, cfg)
+    compiles0 = JIT_COMPILES.value(("compaction_merge",))
+
+    be_h, metas_h = seed()
+    t0 = time.perf_counter()
+    out_h = comp.compact(be_h, be_h, "t1", metas_h, cfg)
+    host_wall = time.perf_counter() - t0
+
+    be_d, metas_d = seed()
+    stats = {"blocks": 0, "spans": 0, "device_seconds": 0.0,
+             "sidecars_written": 0}
+    t0 = time.perf_counter()
+    out_d = comp.compact_device(be_d, be_d, "t1", metas_d, cfg, stats)
+    device_wall = time.perf_counter() - t0
+    steady_compiles = int(JIT_COMPILES.value(("compaction_merge",))
+                          - compiles0)
+
+    def rows(be, metas):
+        got = []
+        for m in sorted(metas, key=lambda m: m.min_trace_id):
+            tb = BackendBlock(be, m).parquet_file().read()
+            cols = {c: tb.column(c).to_pylist() for c in tb.schema.names}
+            got.extend(zip(*[cols[c] for c in sorted(cols)]))
+        return got
+
+    parity_ok = rows(be_h, out_h) == rows(be_d, out_d)
+    speedup = host_wall / max(device_wall, 1e-9)
+
+    # -- historical quantile: 10x warm window from sidecar folds --------
+    warm_s = 900.0
+    hist_s = warm_s * 10.0
+    clock = [t_base + hist_s + warm_s]
+    now = lambda: clock[0]
+    be_q = MemBackend()
+    db_q = TempoDB(be_q, be_q, TempoDBConfig(row_group_rows=2000), now=now)
+    durs = []
+    hist_blocks = 12
+    spans_per_hist = 4000
+    for b in range(hist_blocks):
+        traces = []
+        for i in range(spans_per_hist):
+            tid = rng.bytes(16)
+            d = float(rng.lognormal(np.log(50e6), 0.5))   # ns
+            durs.append(d)
+            t0_ns = int((t_base + b * hist_s / hist_blocks + i % 500) * 1e9)
+            traces.append((tid, [{
+                "trace_id": tid, "span_id": rng.bytes(8),
+                "name": f"op-{i % 8}", "service": f"svc-{b % 4}",
+                "start_unix_nano": t0_ns,
+                "end_unix_nano": t0_ns + int(d)}]))
+        db_q.write_block("t1", sorted(traces, key=lambda t: t[0]),
+                         replication_factor=1)
+    db_q.poll_now()
+    db_q.backfill_sidecars_once("t1", limit=hist_blocks)
+    db_q.poll_now()
+    ring = Ring(replication_factor=1, now=now)
+    q = Querier(db_q, ring, {}, cfg=QuerierConfig(rf=1))
+    fe_fold = Frontend(db_q, q, cfg=FrontendConfig(), now=now)
+    fe_scan = Frontend(db_q, q, cfg=FrontendConfig(sidecar_folds=False),
+                       now=now)
+    qstr = "{ } | quantile_over_time(duration, .5, .9)"
+    win = dict(start_s=t_base - 60, end_s=t_base + hist_s,
+               step_s=hist_s + 60)
+
+    t0 = time.perf_counter()
+    scan_series = fe_scan.query_range("t1", qstr, **win)
+    rescan_wall = time.perf_counter() - t0
+    fe_fold.query_range("t1", qstr, **win)      # warm the fold cache path
+    db_q.planes._folds.clear()                  # ...but time cold folds
+    t0 = time.perf_counter()
+    fold_series = fe_fold.query_range("t1", qstr, **win)
+    fold_wall = time.perf_counter() - t0
+    fold_speedup = rescan_wall / max(fold_wall, 1e-9)
+
+    darr = np.asarray(durs) / 1e9
+    fold_vals = {dict(s.labels)["p"]: float(np.nansum(s.samples))
+                 for s in fold_series}
+    gate_err = 0.0
+    for qv in (0.5, 0.9):
+        exact = float(np.quantile(darr, qv))
+        rel = abs(fold_vals[qv] - exact) / exact
+        rank = abs(float(np.mean(darr <= fold_vals[qv])) - qv)
+        gate_err = max(gate_err, min(rel, rank))
+    quantile_ok = gate_err <= 0.05
+    folds = db_q.compaction_stats["sidecar_folds"]
+    fallbacks = db_q.compaction_stats["sidecar_fallbacks"]
+
+    accept = bool(parity_ok and quantile_ok and steady_compiles == 0
+                  and fold_speedup >= 10.0
+                  and (platform == "cpu" or speedup >= 3.0))
+    return {
+        "coldtier_platform": platform,
+        "coldtier_blocks": n_blocks,
+        "coldtier_spans": total_spans,
+        "coldtier_host_compact_s": round(host_wall, 3),
+        "coldtier_device_compact_s": round(device_wall, 3),
+        "coldtier_compact_speedup_x": round(speedup, 2),
+        "coldtier_device_kernel_s": round(stats["device_seconds"], 3),
+        "coldtier_parity_ok": parity_ok,
+        "coldtier_sidecars_written": stats["sidecars_written"],
+        "coldtier_hist_rescan_ms": round(rescan_wall * 1000.0, 1),
+        "coldtier_hist_fold_ms": round(fold_wall * 1000.0, 1),
+        "coldtier_hist_fold_speedup_x": round(fold_speedup, 1),
+        "coldtier_hist_quantile_gate_err": round(gate_err, 4),
+        "coldtier_hist_folds": folds,
+        "coldtier_hist_fallbacks": fallbacks,
+        "coldtier_steady_state_compiles": steady_compiles,
+        "coldtier_accept_ok": accept,
+        "coldtier_hist_series": len(scan_series),
+    }
+
+
 STAGES = {"e2e": bench_e2e_ingest, "kernel": bench_kernel,
           "query": bench_query, "obs": bench_obs, "sched": bench_sched,
           "saturation": bench_saturation, "multichip": bench_multichip,
@@ -3650,7 +3835,7 @@ STAGES = {"e2e": bench_e2e_ingest, "kernel": bench_kernel,
           "paged_fused": bench_paged_fused, "soak": bench_soak,
           "fleet": bench_fleet, "matview": bench_matview,
           "chaos": bench_chaos, "selftrace": bench_selftrace,
-          "structure": bench_structure}
+          "structure": bench_structure, "coldtier": bench_coldtier}
 
 
 def _cpu_env(env: dict) -> dict:
@@ -4094,6 +4279,18 @@ def main() -> int:
             "structure_steady_state_compiles"),
         "structure_oracle_ok": results.get("structure_oracle_ok"),
         "structure_accept_ok": results.get("structure_accept_ok"),
+        # device cold tier (ISSUE 19): compaction speedup + parity,
+        # sidecar-fold historical quantile vs rescan
+        "coldtier_compact_speedup_x": results.get(
+            "coldtier_compact_speedup_x"),
+        "coldtier_parity_ok": results.get("coldtier_parity_ok"),
+        "coldtier_hist_fold_speedup_x": results.get(
+            "coldtier_hist_fold_speedup_x"),
+        "coldtier_hist_quantile_gate_err": results.get(
+            "coldtier_hist_quantile_gate_err"),
+        "coldtier_steady_state_compiles": results.get(
+            "coldtier_steady_state_compiles"),
+        "coldtier_accept_ok": results.get("coldtier_accept_ok"),
     }
     if errors:
         extra["errors"] = errors

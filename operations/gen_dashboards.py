@@ -359,6 +359,15 @@ def dashboards() -> dict[str, dict]:
                   _p99("tempo_metrics_generator_collect_duration_seconds")),
                 p("Compaction cycle p99",
                   _p99("tempo_compactor_cycle_duration_seconds")),
+                p("Compaction throughput (blocks, spans /s)",
+                  _rate("tempo_compaction_blocks_total"),
+                  _rate("tempo_compaction_spans_total")),
+                p("Compaction device seconds + sidecars written /s",
+                  _rate("tempo_compaction_device_seconds_total"),
+                  _rate("tempo_compaction_sidecars_written_total")),
+                p("Sidecar folds vs scan fallbacks /s",
+                  _rate("tempo_compaction_sidecar_folds_total"),
+                  _rate("tempo_compaction_sidecar_fallbacks_total")),
             ]),
         "tempo-tpu-sched.json": dash(
             "Tempo-TPU / Device scheduler",

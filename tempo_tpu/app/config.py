@@ -208,6 +208,18 @@ class Config:
             warnings.append(f"unknown storage backend {self.storage.backend!r}")
         if self.compactor.retention_s and self.compactor.retention_s < 3600:
             warnings.append("compactor.retention_s < 1h deletes data quickly")
+        if not (0 <= self.sched.compaction_min_share <= 0.5):
+            warnings.append(
+                "sched.compaction_min_share must be in [0, 0.5]: 0 lets "
+                "sustained ingest starve compaction forever, above 0.5 "
+                "compaction-class work outranks the foreground classes "
+                "it exists to yield to")
+        if self.compactor.backfill_sidecars < 0:
+            warnings.append("compactor.backfill_sidecars < 0: use 0 to "
+                            "disable the per-sweep sidecar backfill")
+        if self.compactor.backfill_sidecars > 64:
+            warnings.append("compactor.backfill_sidecars > 64 full-block "
+                            "reads per sweep competes with query reads")
         if self.sched.enabled and self.sched.batch_window_ms > 100:
             warnings.append("sched.batch_window_ms > 100ms adds that much "
                             "to ingest-visible metrics latency per batch")

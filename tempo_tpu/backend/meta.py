@@ -72,6 +72,10 @@ class BlockMeta:
     dedicated_columns: list[DedicatedColumn] = dataclasses.field(default_factory=list)
     min_trace_id: str = ""             # hex; trace-id shard pruning (includeBlock)
     max_trace_id: str = ""
+    # a sketch sidecar (block/sidecar.py) sits next to the block — the
+    # poller-visible marker the historical fold path keys off; absent in
+    # pre-sidecar metas (from_json drops unknown keys both ways)
+    sidecar: bool = False
 
     @staticmethod
     def new(tenant: str, block_id: str | None = None, **kw: Any) -> "BlockMeta":

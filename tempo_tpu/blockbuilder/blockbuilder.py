@@ -24,6 +24,10 @@ class BlockBuilderConfig:
     consume_cycle_records: int = 1000        # per-cycle fetch budget
     max_block_objects: int = 100_000
     dedicated_columns: tuple = ()
+    # emit the sketch sidecar (block/sidecar.py) at cut time, while the
+    # spans are still resident — the compactor only backfills blocks that
+    # predate this knob
+    sidecars: bool = True
 
 
 class BlockBuilder:
@@ -80,9 +84,18 @@ class BlockBuilder:
             traces.sort(key=lambda t: t[0])
             cap = max(self.cfg.max_block_objects, 1)
             for lo in range(0, len(traces), cap):
-                write_block(self.writer, tenant, traces[lo: lo + cap],
-                            dedicated_columns=self.cfg.dedicated_columns,
-                            replication_factor=1)
+                chunk = traces[lo: lo + cap]
+                meta = write_block(self.writer, tenant, chunk,
+                                   dedicated_columns=self.cfg.dedicated_columns,
+                                   replication_factor=1)
+                if self.cfg.sidecars:
+                    from tempo_tpu.backend.meta import write_block_meta
+                    from tempo_tpu.block.sidecar import (
+                        sidecar_from_traces, write_sidecar)
+                    write_sidecar(self.writer, tenant, meta.block_id,
+                                  sidecar_from_traces(chunk))
+                    meta.sidecar = True
+                    write_block_meta(self.writer, meta)
                 self.blocks_flushed += 1
         next_offset = recs[-1].offset + 1
         if cg is not None:

@@ -54,6 +54,11 @@ class Compactor:
         for tenant in self.db.blocklist.tenants():
             try:
                 done += self.db.compact_tenant_once(tenant, owns=self.owns)
+                # low-priority sidecar backfill for pre-sidecar blocks —
+                # rides the compaction sched class so sustained ingest
+                # only reaches it via the min-share valve
+                if self.owns(f"sidecars/{tenant}"):
+                    done += self.db.backfill_sidecars_once(tenant)
                 if self.owns(f"retention/{tenant}"):
                     self.db.retention_once(tenant)
             except Exception:

@@ -13,12 +13,13 @@ from __future__ import annotations
 import dataclasses
 import heapq
 import logging
+import time
 from typing import Callable, Iterable, Iterator
 
 from tempo_tpu.backend import meta as bm
 from tempo_tpu.backend.raw import RawReader, RawWriter
 from tempo_tpu.block.reader import BackendBlock, _rows_to_spans
-from tempo_tpu.block.writer import write_block
+from tempo_tpu.block.writer import write_block, write_block_from_table
 from tempo_tpu.model.combine import combine_spans
 
 import numpy as np
@@ -37,6 +38,17 @@ class CompactorConfig:
     max_block_bytes: int = 100 << 30
     compacted_grace_s: float = 3600.0  # retention grace for compacted markers
     retention_s: float = 14 * 86400.0
+    # device cold tier (runbook "Compacting on device"): merge/dedup/
+    # re-sort input blocks on device (`ops/compact.py`, one columnar
+    # decode per input) instead of the host heapq merge; any failure
+    # falls back to the host path for that group, warn-once
+    device: bool = True
+    # emit a sketch sidecar (block/sidecar.py) next to every compaction
+    # output — the historical-fold tier's per-block summary
+    sidecars: bool = True
+    # compactor sweeps also backfill sidecars for pre-existing blocks
+    # (low-priority compaction-class work), this many per tenant sweep
+    backfill_sidecars: int = 2
 
 
 class TimeWindowBlockSelector:
@@ -134,6 +146,145 @@ def compact(r: RawReader, w: RawWriter, tenant: str,
     log.info("compacted %d blocks -> %d (tenant=%s level=%d)",
              len(inputs), len(out_metas), tenant, level)
     return out_metas
+
+
+# ---------------------------------------------------------------------------
+# device route: decode once → merge/dedup/re-sort on device → stream back
+# ---------------------------------------------------------------------------
+
+def _id_matrix(col, width: int) -> np.ndarray:
+    """Arrow binary column → [n, width] uint8 (one join, no per-row numpy)."""
+    vals = col.to_numpy(zero_copy_only=False)
+    joined = b"".join(bytes(v).ljust(width, b"\0")[:width] for v in vals)
+    return np.frombuffer(joined, np.uint8).reshape(len(vals), width)
+
+
+def _write_merged(w: RawWriter, tenant: str, table, order: np.ndarray,
+                  inputs: list[bm.BlockMeta], cfg: CompactorConfig,
+                  stats: dict | None) -> list[bm.BlockMeta]:
+    """Permute the concatenated input table into merged order and write
+    size-targeted output blocks (+ sidecars) — the host `flush` loop's
+    trace/byte budgets applied to trace RUNS of the merged order."""
+    import pyarrow as pa
+
+    level = max(m.compaction_level for m in inputs) + 1
+    est_bytes_per_span = max(
+        sum(m.size_bytes for m in inputs)
+        // max(sum(m.total_spans for m in inputs), 1), 1)
+    out = table.take(pa.array(order, type=pa.int64()))
+    tid_np = out.column("trace_id").to_numpy(zero_copy_only=False)
+    n = len(tid_np)
+    # trace run boundaries in merged order (order is tid-grouped)
+    starts = [0] + [i for i in range(1, n)
+                    if bytes(tid_np[i]) != bytes(tid_np[i - 1])]
+    starts.append(n)
+    out_metas: list[bm.BlockMeta] = []
+    lo_t = 0
+    while lo_t < len(starts) - 1:
+        # host-flush boundary semantics: add whole traces until the
+        # trace/byte budget trips ON the trace just added (inclusive)
+        hi_t = lo_t
+        while hi_t < len(starts) - 1:
+            hi_t += 1
+            if (hi_t - lo_t >= cfg.max_block_objects
+                    or (starts[hi_t] - starts[lo_t]) * est_bytes_per_span
+                    >= cfg.max_block_bytes):
+                break
+        lo_r, hi_r = starts[lo_t], starts[hi_t]
+        chunk = out.slice(lo_r, hi_r - lo_r)
+        # dense per-block trace index (writer normally derives it from
+        # the trace grouping; the permuted table carries stale values)
+        run_lens = np.diff(starts[lo_t:hi_t + 1])
+        chunk = chunk.set_column(
+            chunk.schema.get_field_index("trace_idx"), "trace_idx",
+            pa.array(np.repeat(np.arange(len(run_lens), dtype=np.int64),
+                               run_lens)))
+        trace_ids = [bytes(tid_np[starts[t]]) for t in range(lo_t, hi_t)]
+        meta = write_block_from_table(
+            w, tenant, chunk, trace_ids,
+            dedicated_columns=inputs[0].dedicated_columns,
+            compaction_level=level,
+            replication_factor=inputs[0].replication_factor)
+        if cfg.sidecars:
+            write_sidecar_for_table(w, tenant, meta, chunk, stats)
+        out_metas.append(meta)
+        lo_t = hi_t
+    return out_metas
+
+
+def write_sidecar_for_table(w: RawWriter, tenant: str, meta: bm.BlockMeta,
+                            table, stats: dict | None = None) -> None:
+    """Build + write the sketch sidecar from block-resident columns and
+    flip the meta marker (blocks are born with sidecars on this path)."""
+    from tempo_tpu.block import sidecar as sdc
+
+    sc = sdc.build_sidecar(
+        table.column("service").to_numpy(zero_copy_only=False),
+        table.column("name").to_numpy(zero_copy_only=False),
+        table.column("duration_ns").to_numpy(),
+        _id_matrix(table.column("trace_id"), 16))
+    sdc.write_sidecar(w, tenant, meta.block_id, sc)
+    meta.sidecar = True
+    bm.write_block_meta(w, meta)
+    if stats is not None:
+        stats["sidecars_written"] += 1
+
+
+def compact_device(r: RawReader, w: RawWriter, tenant: str,
+                   inputs: list[bm.BlockMeta], cfg: CompactorConfig,
+                   stats: dict | None = None,
+                   dispatch: Callable | None = None) -> list[bm.BlockMeta]:
+    """Device-route `compact`: each input block is decoded ONCE into the
+    concatenated columnar table, the merge/dedup/re-sort permutation is
+    computed on device (`ops/compact.merge_order` — bit-compatible with
+    the host heapq/combine_spans contract), and outputs stream back
+    through the standard writer with sketch sidecars attached.
+
+    `dispatch` wraps the device call (the sched compaction-class hook);
+    raises on any decode/schema surprise — callers fall back to the
+    host `compact`.
+    """
+    import pyarrow as pa
+
+    from tempo_tpu.ops import compact as cops
+
+    blocks = [BackendBlock(r, m) for m in inputs]
+    tables = [b.parquet_file().read() for b in blocks]
+    table = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+    out_metas: list[bm.BlockMeta] = []
+    if table.num_rows:
+        tid = _id_matrix(table.column("trace_id"), 16)
+        sid = _id_matrix(table.column("span_id"), 8)
+        t0 = time.monotonic()
+        run = dispatch if dispatch is not None else (lambda fn: fn())
+        order = run(lambda: cops.merge_order(tid, sid))
+        dt = time.monotonic() - t0
+        out_metas = _write_merged(w, tenant, table, order, inputs, cfg, stats)
+        if stats is not None:
+            stats["device_seconds"] += dt
+    for m in inputs:
+        bm.mark_block_compacted(r, w, m.block_id, tenant)
+    if stats is not None:
+        stats["blocks"] += len(inputs)
+        stats["spans"] += int(table.num_rows)
+    log.info("device-compacted %d blocks -> %d (tenant=%s spans=%d)",
+             len(inputs), len(out_metas), tenant, table.num_rows)
+    return out_metas
+
+
+def backfill_sidecar(r: RawReader, w: RawWriter, tenant: str,
+                     meta: bm.BlockMeta, stats: dict | None = None) -> bool:
+    """Attach a sidecar to an existing block (columnar read of just the
+    four needed columns). Returns False when the block vanished
+    mid-backfill (compaction races are benign — the marker never flips)."""
+    try:
+        pf = BackendBlock(r, meta).parquet_file()
+        table = pf.read(columns=["trace_id", "service", "name",
+                                 "duration_ns"])
+    except Exception:
+        return False
+    write_sidecar_for_table(w, tenant, meta, table, stats)
+    return True
 
 
 def do_retention(r: RawReader, w: RawWriter, tenant: str,

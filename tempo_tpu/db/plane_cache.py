@@ -115,7 +115,8 @@ class PlaneCache:
     pinned decoded views from growing to max_blocks full blocks)."""
 
     def __init__(self, budget_bytes: int = 1 << 30, max_blocks: int = 64,
-                 host_budget_bytes: int = 4 << 30, mesh=None):
+                 host_budget_bytes: int = 4 << 30, mesh=None,
+                 max_folds: int = 1024):
         self.budget_bytes = budget_bytes
         self.max_blocks = max_blocks
         self.host_budget_bytes = host_budget_bytes
@@ -124,6 +125,14 @@ class PlaneCache:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        # sidecar-fold result cache: (tenant, block_id) → {window key →
+        # job-level series}. Keyed by block so compaction eviction (drop/
+        # drop_dead) can never leave a compacted-away block serving stale
+        # folds; bounded by total cached window entries, LRU by block.
+        self.max_folds = max_folds
+        self._folds: "OrderedDict[tuple, dict]" = OrderedDict()
+        self.fold_hits = 0
+        self.fold_misses = 0
 
     def get(self, block: BackendBlock) -> CachedBlock:
         key = (block.meta.tenant_id, block.meta.block_id)
@@ -153,12 +162,38 @@ class PlaneCache:
     def drop(self, tenant: str, block_id: str) -> None:
         with self._lock:
             self._entries.pop((tenant, block_id), None)
+            self._folds.pop((tenant, block_id), None)
 
     def drop_dead(self, tenant: str, live_block_ids: set) -> None:
         with self._lock:
             for key in [k for k in self._entries
                         if k[0] == tenant and k[1] not in live_block_ids]:
                 del self._entries[key]
+            for key in [k for k in self._folds
+                        if k[0] == tenant and k[1] not in live_block_ids]:
+                del self._folds[key]
+
+    # -- sidecar-fold results (block/sidecar.py) ---------------------------
+
+    def fold_get(self, tenant: str, block_id: str, fold_key) -> "list | None":
+        with self._lock:
+            per_block = self._folds.get((tenant, block_id))
+            got = None if per_block is None else per_block.get(fold_key)
+            if got is None:
+                self.fold_misses += 1
+                return None
+            self._folds.move_to_end((tenant, block_id))
+            self.fold_hits += 1
+            return got
+
+    def fold_put(self, tenant: str, block_id: str, fold_key,
+                 series: list) -> None:
+        with self._lock:
+            self._folds.setdefault((tenant, block_id), {})[fold_key] = series
+            self._folds.move_to_end((tenant, block_id))
+            while (sum(len(d) for d in self._folds.values()) > self.max_folds
+                   and len(self._folds) > 1):
+                self._folds.popitem(last=False)
 
     def _evict_locked(self) -> None:
         while len(self._entries) > self.max_blocks:
@@ -183,4 +218,7 @@ class PlaneCache:
                 "host_budget_bytes": self.host_budget_bytes,
                 "hits": self.hits,
                 "misses": self.misses,
+                "fold_entries": sum(len(d) for d in self._folds.values()),
+                "fold_hits": self.fold_hits,
+                "fold_misses": self.fold_misses,
             }

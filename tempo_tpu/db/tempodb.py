@@ -76,6 +76,17 @@ class TempoDB:
         # read-plane routing counters: how many block scans took the fused
         # device path vs the host engine (tests + /metrics)
         self.plane_stats = {"fused_metric_blocks": 0, "host_metric_blocks": 0}
+        # device cold tier: compaction + sidecar-fold counters (tests,
+        # /metrics, and the bench `coldtier` stage all read these)
+        self.compaction_stats = {
+            "blocks": 0,             # input blocks through the device route
+            "spans": 0,              # spans merged/deduped on device
+            "device_seconds": 0.0,   # wall time inside the merge dispatch
+            "sidecars_written": 0,   # compaction outputs + backfills
+            "sidecar_folds": 0,      # historical blocks answered by folds
+            "sidecar_fallbacks": 0,  # fold-eligible blocks that re-scanned
+        }
+        self._device_compact_warned = False
         self.obs = registry if registry is not None else Registry()
         self._register_obs(self.obs)
 
@@ -117,6 +128,24 @@ class TempoDB:
         self.compaction_duration = reg.histogram(
             "tempo_compactor_cycle_duration_seconds",
             "One per-tenant compaction sweep (selection + block rewrites)")
+
+        def comp_stat(key):
+            return lambda: [((), self.compaction_stats[key])]
+
+        for key, hlp in (
+                ("blocks", "Input blocks compacted via the device route"),
+                ("spans", "Spans merged/deduped/re-sorted on device"),
+                ("device_seconds",
+                 "Wall seconds inside device compaction-merge dispatches"),
+                ("sidecars_written",
+                 "Sketch sidecars written (compaction outputs, block cuts, "
+                 "backfills)"),
+                ("sidecar_folds",
+                 "Historical query blocks answered by sidecar folds"),
+                ("sidecar_fallbacks",
+                 "Fold-eligible blocks that fell back to the host scan")):
+            reg.counter_func(f"tempo_compaction_{key}_total", comp_stat(key),
+                             help=hlp)
 
     # -- writer ------------------------------------------------------------
 
@@ -422,12 +451,104 @@ class TempoDB:
             key = f"{tenant}-{group[0].block_id}"
             if not owns(key):
                 continue
-            out = comp.compact(self.r, self.w, tenant, group, self.cfg.compactor)
+            out = self._compact_group(tenant, group)
             self.blocklist.update(
                 tenant, add=out, remove=group,
                 compacted_add=[bm.CompactedBlockMeta(m, self.now()) for m in group])
+            # compacted-away inputs must not serve stale cached state:
+            # drop their parquet handles, device planes, AND any cached
+            # sidecar-fold results immediately (not at the next poll)
+            for m in group:
+                self._block_cache.pop((tenant, m.block_id), None)
+                if self.planes is not None:
+                    self.planes.drop(tenant, m.block_id)
             done += 1
         self.compaction_duration.observe(time.perf_counter() - t0)
+        return done
+
+    def _compact_group(self, tenant: str, group: list) -> list:
+        """Device-route compaction of one input group, host fallback on
+        any decode/schema surprise (warn-once)."""
+        cfg = self.cfg.compactor
+        if cfg.device:
+            try:
+                return comp.compact_device(
+                    self.r, self.w, tenant, group, cfg,
+                    stats=self.compaction_stats,
+                    dispatch=self._compaction_dispatch(tenant))
+            except Exception:
+                if not self._device_compact_warned:
+                    self._device_compact_warned = True
+                    log.exception(
+                        "device compaction failed; host fallback "
+                        "(tenant=%s, logged once)", tenant)
+        return comp.compact(self.r, self.w, tenant, group, cfg)
+
+    def _compaction_dispatch(self, tenant: str):
+        """Compaction-class admission to the shared device scheduler:
+        merge dispatches queue BEHIND ingest/query work (and behind the
+        anti-starvation floor, sched.compaction_min_share)."""
+        from tempo_tpu import sched
+
+        return lambda fn: sched.run(fn, kernel="compaction_merge",
+                                    priority=sched.PRIO_COMPACTION,
+                                    tenant=tenant)
+
+    # -- sketch sidecars: historical folds + backfill ----------------------
+
+    def sidecar_plan(self, query: str):
+        """FoldPlan when `query` is answerable from sidecars, else None."""
+        from tempo_tpu.block import sidecar as sdc
+
+        return sdc.eligible_plan(query)
+
+    def sidecar_series(self, tenant: str, req, meta, plan,
+                       clip_end_ns: int | None = None):
+        """One historical block answered from its sidecar: job-level
+        TimeSeries for the frontend combiner, or None → caller re-scans
+        (missing/unreadable/domain-mismatched sidecar). Fold results ride
+        the plane cache keyed by (block, query window) and are evicted
+        with the block on compaction."""
+        from tempo_tpu.block import sidecar as sdc
+
+        fkey = (req.query, req.start_ns, req.end_ns, req.step_ns,
+                clip_end_ns or 0)
+        if self.planes is not None:
+            hit = self.planes.fold_get(tenant, meta.block_id, fkey)
+            if hit is not None:
+                self.compaction_stats["sidecar_folds"] += 1
+                return hit
+        sc = sdc.read_sidecar(self.r, tenant, meta.block_id)
+        series = None if sc is None else sdc.fold_series(
+            sc, meta, req, plan, clip_end_ns)
+        if series is None:
+            self.compaction_stats["sidecar_fallbacks"] += 1
+            return None
+        self.compaction_stats["sidecar_folds"] += 1
+        if self.planes is not None:
+            self.planes.fold_put(tenant, meta.block_id, fkey, series)
+        return series
+
+    def backfill_sidecars_once(self, tenant: str,
+                               limit: int | None = None) -> int:
+        """Attach sidecars to up to `limit` existing blocks without one
+        (low-priority compaction-class work; the compactor service calls
+        this each sweep so history converges to fold-served)."""
+        cfg = self.cfg.compactor
+        if limit is None:
+            limit = cfg.backfill_sidecars
+        if limit <= 0 or not cfg.sidecars:
+            return 0
+        run = self._compaction_dispatch(tenant)
+        done = 0
+        for m in self.blocklist.metas(tenant):
+            if done >= limit:
+                break
+            if m.sidecar:
+                continue
+            if run(lambda m=m: comp.backfill_sidecar(
+                    self.r, self.w, tenant, m, self.compaction_stats)):
+                done += 1
         return done
 
     def retention_once(self, tenant: str) -> tuple[list, list]:

@@ -54,6 +54,10 @@ class FrontendConfig:
     # localblocks / blockbuilder output (the reference's rule); None admits
     # all blocks for single-writer deployments whose blocks are deduped
     metrics_block_rf: int | None = 1
+    # historical metrics from sketch sidecars: blocks entirely behind the
+    # cutoff whose sidecar can answer the query fold on the request
+    # thread (no scan jobs); blocks without a sidecar fall back to jobs
+    sidecar_folds: bool = True
     slo: dict[str, SLOConfig] = dataclasses.field(default_factory=dict)
     # structured query log (obs/qlog.py): errors always log; queries over
     # the sketch-estimated `qlog_slow_quantile` latency log as slow;
@@ -727,6 +731,28 @@ class Frontend:
         # `metrics_query_range_sharder.go:125-190`)
         cutoff_s = self.now() - self.cfg.query_backend_after_s
         cutoff_ns = int(cutoff_s * 1e9)
+        # sidecar fold tier (block/sidecar.py): for a fold-eligible
+        # rate()/quantile_over_time(duration) query, blocks entirely
+        # behind the cutoff that carry a sketch sidecar are answered by
+        # folding ~15 floats per series instead of scanning spans. The
+        # tier only engages when some block will ACTUALLY fold (meta
+        # flags are enough to decide — no sidecar reads yet); quantiles
+        # then ride the moments axis END TO END — generator shards, scan
+        # fallbacks and folds all emit __moment series, or the combiner
+        # would mix them with log2 __bucket partials and emit the
+        # ("p", q) output series twice
+        plan = (self.db.sidecar_plan(query)
+                if self.cfg.sidecar_folds and start_s < cutoff_s else None)
+        metas: list = []
+        if start_s < cutoff_s:
+            metas = prune_blocks_rf(
+                self.db.blocks(tenant, start_s, min(end_s, cutoff_s)),
+                self.cfg.metrics_block_rf)
+        if plan is not None and not any(
+                m.sidecar and m.end_time * 1e9 < cutoff_ns for m in metas):
+            plan = None
+        if plan is not None and plan.quantile:
+            req = dataclasses.replace(req, moments=True)
         comb = SeriesCombiner(metrics_kind(query), req.n_steps)
         nbytes = 0
         if end_s > cutoff_s and self.generator_query_range is not None:
@@ -739,11 +765,24 @@ class Frontend:
             # blockbuilder output) — ingester RF3 blocks hold every trace 3x
             # (`blockMetasForSearch(..., rf=1)` sharder :190). Configurable
             # for RF-deduped (compacted single-writer) setups.
-            metas = prune_blocks_rf(
-                self.db.blocks(tenant, start_s, min(end_s, cutoff_s)),
-                self.cfg.metrics_block_rf)
             querystats.add(total_blocks=len(metas))
-            jobs = query_range_jobs(tenant, metas, start_s,
+            # folds run inline on the request thread — each is a handful
+            # of host flops over sidecar rows; blocks without a usable
+            # sidecar (or straddling the moving cutoff) fall back to jobs
+            scan_metas = []
+            for m in metas:
+                got = None
+                if plan is not None and m.sidecar \
+                        and m.end_time * 1e9 < cutoff_ns:
+                    got = self.db.sidecar_series(tenant, req, m, plan,
+                                                 clip_end_ns=cutoff_ns)
+                if got is None:
+                    scan_metas.append(m)
+                else:
+                    comb.add_all(got)
+            if len(scan_metas) != len(metas) and on_partial is not None:
+                on_partial(comb.final(req))
+            jobs = query_range_jobs(tenant, scan_metas, start_s,
                                     min(end_s, cutoff_s), step_s,
                                     self.cfg.metrics_target_bytes_per_job)
 
@@ -762,7 +801,8 @@ class Frontend:
                     return None
                 return (f"qj:{tenant}:{m.block_id}:{_qhash(query)}:"
                         f"{','.join(map(str, j.row_groups))}:"
-                        f"{req.start_ns}:{req.end_ns}:{req.step_ns}")
+                        f"{req.start_ns}:{req.end_ns}:{req.step_ns}"
+                        f"{':m' if req.moments else ''}")
 
             nbytes += self._run_jobs(
                 tenant, jobs,
@@ -774,6 +814,7 @@ class Frontend:
                     "kind": "query_range_block", "tenant": tenant,
                     "query": query, "start_ns": req.start_ns,
                     "end_ns": req.end_ns, "step_ns": req.step_ns,
+                    "moments": req.moments,
                     "meta": j.meta.to_json(),
                     "row_groups": list(j.row_groups),
                     "clip_end_ns": cutoff_ns},

@@ -290,7 +290,8 @@ def execute_job_spec(querier, spec: dict):
 
         req = QueryRangeRequest(
             query=spec["query"], start_ns=spec["start_ns"],
-            end_ns=spec["end_ns"], step_ns=spec["step_ns"])
+            end_ns=spec["end_ns"], step_ns=spec["step_ns"],
+            moments=bool(spec.get("moments", False)))
         series = querier.query_range_block(
             spec["tenant"], req, meta, rgs,
             clip_start_ns=spec.get("clip_start_ns"),

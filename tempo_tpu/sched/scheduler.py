@@ -145,6 +145,14 @@ class SchedConfig:
     tuning_window_min_ms: float = 0.25
     tuning_window_max_ms: float = 8.0
     tuning_interval_s: float = 0.5      # how often a kernel's choice refits
+    # compaction-class minimum dispatch share: compaction jobs normally
+    # run only when ingest/query are fully idle, which under SUSTAINED
+    # load is NEVER — the cold tier would starve forever. With share s,
+    # after ceil(1/s) consecutive drain cycles that skipped a waiting
+    # compaction job, one is force-dispatched (so compaction gets at
+    # least ~s of drain cycles under saturation). 0 restores pure
+    # idle-only dispatch. Bounded (0, 0.5] by config.check().
+    compaction_min_share: float = 0.05
 
 
 def fraction_for_pressure(pressure: float, start: float,
@@ -381,6 +389,11 @@ class DeviceScheduler:
         self.padding_waste_shard: dict[tuple[str, str], int] = {}
         self.bucket_warmups: dict[str, int] = {}
         self.dispatch_errors = 0
+        # compaction-class anti-starvation: consecutive drains that left
+        # a non-empty compaction queue untouched, and how many jobs the
+        # minimum-dispatch-share floor force-dispatched (guarded by _cond)
+        self._comp_starved = 0
+        self.comp_forced_total = 0
         self.occupancy_sum: dict[str, float] = {}
         self._warm_buckets: set[tuple] = set()
         # pressure → keep-fraction controller state (EWMA-smoothed; see
@@ -780,6 +793,19 @@ class DeviceScheduler:
                     and not self._groups) or force:
                 comp_fns = list(self._queues[PRIO_COMPACTION])
                 self._queues[PRIO_COMPACTION].clear()
+                self._comp_starved = 0
+            elif self._queues[PRIO_COMPACTION]:
+                # anti-starvation floor (compaction_min_share): sustained
+                # ingest/query pressure means the idle-only branch above
+                # never fires; after 1/share consecutive starved drains,
+                # force ONE compaction job through — a bounded minimum
+                # dispatch share that can't invert priorities
+                self._comp_starved += 1
+                share = self.cfg.compaction_min_share
+                if share > 0.0 and self._comp_starved * share >= 1.0:
+                    comp_fns = [self._queues[PRIO_COMPACTION].popleft()]
+                    self._comp_starved = 0
+                    self.comp_forced_total += 1
             n = (len(ready) + len(ingest_fns) + len(query_fns)
                  + len(comp_fns))
             n_ing = sum(len(g.jobs) for g in ready) + len(ingest_fns)
@@ -1246,6 +1272,12 @@ RUNTIME.counter_func(
     [((), float(_default.dispatch_errors))],
     help="Scheduler dispatches that raised (fire-and-forget ingest "
          "batches were DROPPED; also logged on tempo_tpu.sched)")
+RUNTIME.counter_func(
+    "tempo_sched_compaction_forced_dispatches_total",
+    lambda: [] if _default is None else
+    [((), float(_default.comp_forced_total))],
+    help="Compaction jobs force-dispatched by the anti-starvation floor "
+         "(sched.compaction_min_share) while ingest/query stayed busy")
 RUNTIME.gauge_func(
     "tempo_sched_tuned_window_ms",
     lambda: [] if _default is None else

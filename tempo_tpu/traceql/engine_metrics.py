@@ -132,6 +132,12 @@ class QueryRangeRequest:
     end_ns: int
     step_ns: int
     exemplars: int = 100
+    # force the moments aggregation axis for this request regardless of
+    # the process-global tier: the frontend sets it when the sidecar fold
+    # path serves part of the window, so generator + scan-fallback shards
+    # emit __moment series that combine with the folds instead of log2
+    # __bucket series that would double-count the ("p", q) output
+    moments: bool = False
 
     @property
     def n_steps(self) -> int:
@@ -365,7 +371,8 @@ class MetricsEvaluator:
         # of the [series, steps, 64] log2 grid (histogram_over_time
         # keeps buckets — its OUTPUT is the buckets)
         self._moments = (k == A.MetricsKind.QUANTILE_OVER_TIME
-                         and msk.query_moments_active())
+                         and (msk.query_moments_active()
+                              or getattr(req, "moments", False)))
         self._hist = k in (A.MetricsKind.QUANTILE_OVER_TIME,
                            A.MetricsKind.HISTOGRAM_OVER_TIME) \
             and not self._moments

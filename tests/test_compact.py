@@ -1,0 +1,393 @@
+"""Device cold-tier compaction + sketch sidecars (ops/compact.py,
+block/sidecar.py, db/compactor.py device route, frontend fold tier).
+
+Differential coverage per the ISSUE: device merge vs the host compactor
+on random overlapping blocks (dup trace ids, dup span ids, empty/tiny
+blocks) with reader bit-parity; sidecar-fold quantile vs a full-rescan
+oracle within the moments error gate; sched compaction-class
+anti-starvation; plane-cache fold eviction on compaction.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tempo_tpu.backend.mem import MemBackend
+from tempo_tpu.block.reader import BackendBlock
+from tempo_tpu.block.sidecar import (
+    build_sidecar,
+    eligible_plan,
+    merge_sidecars,
+    read_sidecar,
+)
+from tempo_tpu.db import CompactorConfig, TempoDB, TempoDBConfig
+from tempo_tpu.db import compactor as comp
+from tempo_tpu.frontend import Frontend, FrontendConfig
+from tempo_tpu.ops import compact as cops
+from tempo_tpu.querier import Querier
+from tempo_tpu.querier.querier import QuerierConfig
+from tempo_tpu.ring import Ring
+
+T0 = 1_700_000_000.0
+
+
+def mkspan(tid, sid, name="op", svc="svc", t0_s=T0, dur_ms=50.0):
+    t0 = int(t0_s * 1e9)
+    return {"trace_id": tid, "span_id": sid, "name": name, "service": svc,
+            "start_unix_nano": t0, "end_unix_nano": t0 + int(dur_ms * 1e6)}
+
+
+# ---------------------------------------------------------------------------
+# merge kernel vs pure-python reference
+# ---------------------------------------------------------------------------
+
+def test_merge_order_matches_reference_fuzz():
+    rng = np.random.default_rng(11)
+    for trial in range(6):
+        n = int(rng.integers(1, 400))
+        # few distinct ids → many duplicate (tid, sid) pairs across
+        # "blocks" (rows), the exact shape compaction dedups
+        tid = rng.integers(0, 30, (n, 16)).astype(np.uint8)
+        sid = rng.integers(0, 4, (n, 8)).astype(np.uint8)
+        got = cops.merge_order(tid, sid)
+        ref = cops.reference_merge_order(tid, sid)
+        assert np.array_equal(got, np.asarray(ref)), trial
+
+
+def test_merge_order_empty_and_single():
+    z16 = np.zeros((0, 16), np.uint8)
+    z8 = np.zeros((0, 8), np.uint8)
+    assert len(cops.merge_order(z16, z8)) == 0
+    one = cops.merge_order(np.ones((1, 16), np.uint8),
+                           np.ones((1, 8), np.uint8))
+    assert np.array_equal(one, [0])
+
+
+def test_merge_order_byte_lexicographic():
+    # big-endian limbs: byte 0 must outrank byte 15 (the host oracle
+    # sorts by bytes(tid); structure.id_limbs' native order would not)
+    a = np.zeros((2, 16), np.uint8)
+    a[0, 15] = 1   # 00..01
+    a[1, 0] = 1    # 01..00
+    sid = np.arange(2, dtype=np.uint8).repeat(8).reshape(2, 8)
+    order = cops.merge_order(a, sid)
+    assert list(order) == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# device compaction vs host compactor: reader bit-parity
+# ---------------------------------------------------------------------------
+
+def _overlapping_blocks(rng, n_blocks=3, n_traces=25):
+    """Blocks sharing trace ids, with duplicated spans (RF overlap) and
+    one near-empty block."""
+    pool = []
+    for i in range(n_traces):
+        tid = bytes(rng.integers(0, 8, 16).astype(np.uint8))
+        spans = [mkspan(tid, bytes(rng.integers(0, 256, 8).astype(np.uint8)),
+                        svc=f"svc-{i % 3}", t0_s=T0 + i,
+                        dur_ms=float(rng.integers(1, 500)))
+                 for _ in range(int(rng.integers(1, 4)))]
+        pool.append((tid, spans))
+    blocks = []
+    for b in range(n_blocks):
+        lo = int(rng.integers(0, n_traces // 2))
+        hi = int(rng.integers(lo + 1, n_traces + 1))
+        blk = [(tid, [dict(s) for s in spans]) for tid, spans in pool[lo:hi]]
+        blocks.append(sorted(blk, key=lambda t: t[0]))
+    blocks.append(sorted(pool[:1], key=lambda t: t[0]))   # tiny block
+    return blocks
+
+
+def _read_rows(be, metas):
+    rows = []
+    for m in sorted(metas, key=lambda m: m.min_trace_id):
+        tb = BackendBlock(be, m).parquet_file().read()
+        cols = {c: tb.column(c).to_pylist() for c in tb.schema.names}
+        rows.extend(zip(*[cols[c] for c in sorted(cols)]))
+    return rows
+
+
+def test_device_compaction_bit_parity_with_host():
+    rng = np.random.default_rng(5)
+    blocks = _overlapping_blocks(rng)
+
+    def build():
+        be = MemBackend()
+        db = TempoDB(be, be, TempoDBConfig(row_group_rows=16))
+        for blk in blocks:
+            db.write_block("t1", blk, replication_factor=1)
+        db.poll_now()
+        return be, sorted(db.blocks("t1"), key=lambda m: m.block_id)
+
+    cfg = CompactorConfig()
+    be_h, metas_h = build()
+    be_d, metas_d = build()
+    out_h = comp.compact(be_h, be_h, "t1", metas_h, cfg)
+    stats = {"blocks": 0, "spans": 0, "device_seconds": 0.0,
+             "sidecars_written": 0}
+    out_d = comp.compact_device(be_d, be_d, "t1", metas_d, cfg, stats)
+    assert _read_rows(be_h, out_h) == _read_rows(be_d, out_d)
+    assert stats["blocks"] == len(metas_d) and stats["spans"] > 0
+    # sidecars born with the merged block, meta marker flipped
+    assert all(m.sidecar for m in out_d)
+    assert read_sidecar(be_d, "t1", out_d[0].block_id) is not None
+
+
+def test_device_compaction_block_split_parity():
+    # multiple output blocks: the trace/byte flush budgets must cut the
+    # merged run at the same trace boundaries as the host loop
+    rng = np.random.default_rng(9)
+    blocks = _overlapping_blocks(rng, n_blocks=2, n_traces=30)
+    cfg = CompactorConfig(max_block_objects=7)
+
+    def run(device):
+        be = MemBackend()
+        db = TempoDB(be, be, TempoDBConfig(row_group_rows=16))
+        for blk in blocks:
+            db.write_block("t1", blk, replication_factor=1)
+        db.poll_now()
+        metas = sorted(db.blocks("t1"), key=lambda m: m.block_id)
+        if device:
+            return _read_rows(be, comp.compact_device(
+                be, be, "t1", metas, cfg)), be
+        return _read_rows(be, comp.compact(be, be, "t1", metas, cfg)), be
+
+    (rows_h, _), (rows_d, _) = run(False), run(True)
+    assert rows_h == rows_d
+
+
+def test_db_device_route_and_cache_eviction():
+    """compact_tenant_once through the device route evicts the inputs'
+    plane-cache entries AND their cached fold results (satellite: the
+    compact-then-query path can never serve stale folds)."""
+    be = MemBackend()
+    db = TempoDB(be, be, TempoDBConfig(row_group_rows=16))
+    rng = np.random.default_rng(2)
+    for blk in _overlapping_blocks(rng, n_blocks=2, n_traces=10):
+        db.write_block("t1", blk, replication_factor=1)
+    db.poll_now()
+    inputs = db.blocks("t1")
+    assert len(inputs) >= 2
+    # warm the plane cache + the fold cache for every input block
+    for m in inputs:
+        db.planes.get(BackendBlock(be, m))
+        db.planes.fold_put("t1", m.block_id, ("win",), [])
+        assert db.planes.fold_get("t1", m.block_id, ("win",)) == []
+    n = db.compact_tenant_once("t1")
+    assert n >= 1
+    assert db.compaction_stats["blocks"] >= 2
+    assert db.compaction_stats["device_seconds"] > 0.0
+    for m in inputs:
+        assert db.planes.peek("t1", m.block_id) is None
+        assert db.planes.fold_get("t1", m.block_id, ("win",)) is None
+
+
+# ---------------------------------------------------------------------------
+# sidecars: build/merge, backfill, fold vs rescan oracle
+# ---------------------------------------------------------------------------
+
+def test_sidecar_merge_and_cardinality():
+    rng = np.random.default_rng(4)
+    tid = rng.integers(0, 256, (400, 16)).astype(np.uint8)
+    svc = np.array(["a", "b"] * 200)
+    nam = np.array(["x"] * 400)
+    dur = rng.integers(10_000, 10_000_000, 400)
+    sc = build_sidecar(svc, nam, dur, tid)
+    assert sc.total_spans == 400 and set(sc.series) == {("a", "x"),
+                                                        ("b", "x")}
+    est = sc.trace_cardinality()
+    assert 0.8 * 400 <= est <= 1.2 * 400
+    both = merge_sidecars(sc, sc)
+    assert both.total_spans == 800
+    # self-merge is idempotent for distinct-count (HLL max-merge)
+    assert abs(both.trace_cardinality() - est) < 1e-6
+
+
+def test_eligible_plan_gating():
+    assert eligible_plan("{ } | rate()") is not None
+    p = eligible_plan("{ } | quantile_over_time(duration, .5) "
+                      "by (resource.service.name)")
+    assert p is not None and p.quantile and p.group_axes == ("service",)
+    # conditions, non-duration attrs, unsupported group axes → no fold
+    assert eligible_plan('{ span.foo = "x" } | rate()') is None
+    assert eligible_plan(
+        "{ } | quantile_over_time(span.bytes, .5)") is None
+    assert eligible_plan("{ } | rate() by (span.foo)") is None
+    assert eligible_plan("{ } | histogram_over_time(duration)") is None
+
+
+def _fold_stack(rng, n_blocks=3, spans_per_block=60):
+    clock = [T0 + 3600.0]
+    now = lambda: clock[0]
+    be = MemBackend()
+    db = TempoDB(be, be, now=now)
+    durs = []
+    for blk in range(n_blocks):
+        traces = []
+        for i in range(spans_per_block):
+            tid = bytes([blk * 64 + (i % 50), 9] + [0] * 14)
+            d = float(rng.lognormal(np.log(50), 0.5))
+            durs.append(d)
+            traces.append((tid, [mkspan(tid, bytes(
+                rng.integers(0, 256, 8).astype(np.uint8)),
+                svc=f"svc-{blk % 2}", t0_s=T0 + i * 3, dur_ms=d)]))
+        db.write_block("t1", sorted(traces, key=lambda t: t[0]),
+                       replication_factor=1)
+    db.poll_now()
+    assert db.backfill_sidecars_once("t1", limit=n_blocks) == n_blocks
+    db.poll_now()
+    ring = Ring(replication_factor=1, now=now)
+    q = Querier(db, ring, {}, cfg=QuerierConfig(rf=1))
+    return db, q, now, np.array(durs)
+
+
+def test_sidecar_fold_quantile_within_moments_gate():
+    rng = np.random.default_rng(17)
+    db, q, now, durs = _fold_stack(rng)
+    fe = Frontend(db, q, cfg=FrontendConfig(), now=now)
+    series = fe.query_range("t1", "{ } | quantile_over_time(duration, .5, .9)",
+                            start_s=T0 - 60, end_s=T0 + 600, step_s=660.0)
+    folds0 = db.compaction_stats["sidecar_folds"]
+    assert folds0 > 0 and db.compaction_stats["sidecar_fallbacks"] == 0
+    got = {dict(s.labels)["p"]: float(np.nansum(s.samples)) for s in series}
+    for qv in (0.5, 0.9):
+        exact = np.quantile(durs, qv) / 1e3          # ms → s
+        rel = abs(got[qv] - exact) / exact
+        rank = abs(np.mean(durs / 1e3 <= got[qv]) - qv)
+        assert min(rel, rank) <= 0.05, (qv, got[qv], exact, rel, rank)
+    # second query is served from the fold cache
+    fe.query_range("t1", "{ } | quantile_over_time(duration, .5, .9)",
+                   start_s=T0 - 60, end_s=T0 + 600, step_s=660.0)
+    assert db.planes.fold_hits > 0
+
+
+def test_sidecar_fold_rate_matches_rescan_exactly():
+    rng = np.random.default_rng(23)
+    db, q, now, _ = _fold_stack(rng, n_blocks=2, spans_per_block=40)
+    fe_fold = Frontend(db, q, cfg=FrontendConfig(), now=now)
+    fe_scan = Frontend(db, q, cfg=FrontendConfig(sidecar_folds=False),
+                       now=now)
+    for query in ("{ } | rate()",
+                  "{ } | rate() by (resource.service.name)"):
+        a = fe_fold.query_range("t1", query, start_s=T0 - 60,
+                                end_s=T0 + 600, step_s=660.0)
+        b = fe_scan.query_range("t1", query, start_s=T0 - 60,
+                                end_s=T0 + 600, step_s=660.0)
+        ta = {s.labels: float(np.nansum(s.samples)) for s in a}
+        tb = {s.labels: float(np.nansum(s.samples)) for s in b}
+        assert set(ta) == set(tb)
+        for k in ta:
+            assert ta[k] == pytest.approx(tb[k], rel=1e-9), (query, k)
+
+
+def test_fold_ineligible_block_falls_back_to_scan():
+    # one block loses its sidecar marker → that block scans, the others
+    # fold, and the combined answer still matches the all-scan answer
+    rng = np.random.default_rng(29)
+    db, q, now, _ = _fold_stack(rng, n_blocks=3, spans_per_block=30)
+    metas = db.blocklist.metas("t1")
+    metas[0].sidecar = False
+    fe = Frontend(db, q, cfg=FrontendConfig(), now=now)
+    fe_scan = Frontend(db, q, cfg=FrontendConfig(sidecar_folds=False),
+                       now=now)
+    a = fe.query_range("t1", "{ } | rate()", start_s=T0 - 60,
+                       end_s=T0 + 600, step_s=660.0)
+    b = fe_scan.query_range("t1", "{ } | rate()", start_s=T0 - 60,
+                            end_s=T0 + 600, step_s=660.0)
+    assert float(np.nansum(a[0].samples)) == pytest.approx(
+        float(np.nansum(b[0].samples)), rel=1e-9)
+
+
+def test_blockbuilder_emits_sidecar_at_cut():
+    from tempo_tpu.blockbuilder import BlockBuilder, BlockBuilderConfig
+    from tempo_tpu.ingest.bus import Bus
+    from tempo_tpu.ingest.encoding import produce_traces
+    from tempo_tpu.ops.hashing import token_for
+
+    be = MemBackend()
+    bus = Bus(n_partitions=1)
+    tid = b"\x42" * 16
+    mat = np.frombuffer(tid, np.uint8).reshape(1, 16)
+    produce_traces(bus, "t1", [(tid, [mkspan(tid, b"\x01" * 8)])],
+                   token_for("t1", mat))
+    bb = BlockBuilder(bus, be, BlockBuilderConfig())
+    assert bb.consume_cycle() == 1
+    db = TempoDB(be, be)
+    db.poll_now()
+    metas = db.blocks("t1")
+    assert len(metas) == 1 and metas[0].sidecar
+    sc = read_sidecar(be, "t1", metas[0].block_id)
+    assert sc is not None and sc.total_spans == 1
+
+
+def test_backfill_skips_done_and_respects_limit():
+    rng = np.random.default_rng(31)
+    be = MemBackend()
+    db = TempoDB(be, be)
+    for blk in _overlapping_blocks(rng, n_blocks=3, n_traces=6):
+        db.write_block("t1", blk, replication_factor=1)
+    db.poll_now()
+    assert db.backfill_sidecars_once("t1", limit=2) == 2
+    db.poll_now()
+    assert db.backfill_sidecars_once("t1", limit=10) == 2  # the rest
+    db.poll_now()
+    assert db.backfill_sidecars_once("t1", limit=10) == 0  # all done
+    assert db.compaction_stats["sidecars_written"] == 4
+
+
+# ---------------------------------------------------------------------------
+# sched: compaction-class minimum dispatch share
+# ---------------------------------------------------------------------------
+
+def _submit_compaction(sc, order, tag="compaction"):
+    from tempo_tpu import sched as S
+    job = S.Job(priority=S.PRIO_COMPACTION, kernel=tag,
+                fn=lambda: order.append(tag))
+    with sc._cond:
+        sc._queues[S.PRIO_COMPACTION].append(job)
+
+
+def test_compaction_min_share_survives_sustained_ingest():
+    from tempo_tpu.sched import DeviceScheduler, SchedConfig
+
+    sc = DeviceScheduler(SchedConfig(batch_window_ms=0.0,
+                                     compaction_min_share=0.25),
+                         start_worker=False)
+    order = []
+    _submit_compaction(sc, order)
+    for i in range(8):
+        sc.submit_rows("k", "m", (np.zeros(4, np.int32),), 4,
+                       lambda s: order.append("ingest"), pads=(-1,))
+        sc.drain_once()
+    # never a fully-idle drain, yet the share valve forced it through
+    assert "compaction" in order
+    assert order.index("compaction") <= int(1 / 0.25) + 1
+    assert sc.comp_forced_total >= 1
+
+
+def test_compaction_share_zero_starves_under_load():
+    from tempo_tpu.sched import DeviceScheduler, SchedConfig
+
+    sc = DeviceScheduler(SchedConfig(batch_window_ms=0.0,
+                                     compaction_min_share=0.0),
+                         start_worker=False)
+    order = []
+    _submit_compaction(sc, order)
+    for i in range(40):
+        sc.submit_rows("k", "m", (np.zeros(4, np.int32),), 4,
+                       lambda s: order.append("ingest"), pads=(-1,))
+        sc.drain_once()
+    assert "compaction" not in order      # strict idle-only semantics
+    sc.drain_once()                       # idle → finally runs
+    assert order[-1] == "compaction"
+
+
+def test_compaction_metrics_families_registered():
+    be = MemBackend()
+    db = TempoDB(be, be)
+    text = db.obs.render()
+    for fam in ("blocks", "spans", "device_seconds", "sidecars_written",
+                "sidecar_folds", "sidecar_fallbacks"):
+        assert f"tempo_compaction_{fam}_total" in text, fam

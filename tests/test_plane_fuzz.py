@@ -514,6 +514,9 @@ def test_fuzz_traceanalytics_paged_sched_differential():
         seed = script.randrange(1 << 30)
         nt = script.choice([3, 8, 20])
         dt = script.choice([0.0, 2.0, 60.0])
+        # drawn ONCE per step, not per world — a per-world draw can hand
+        # the three worlds different flags and diverge them spuriously
+        immediate = script.random() < 0.5
         ctx = f"seed={SEED} step={step} op={op}"
         results = []
         for clock, reg, proc in worlds:
@@ -523,7 +526,7 @@ def test_fuzz_traceanalytics_paged_sched_differential():
                 proc.push_batch(_ta_batch(reg, rng, nt))
                 results.append(proc.spans_buffered)
             elif op == "cut":
-                proc.cut_tick(immediate=script.random() < 0.5)
+                proc.cut_tick(immediate=immediate)
                 sched.flush()
                 results.append(len(proc._live))
             elif op == "purge":
