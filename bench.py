@@ -409,6 +409,14 @@ def bench_query() -> dict:
         s_host_ms = timed(lambda: db_host.search(
             "bench", '{ span.http.status_code >= 400 }', limit=20,
             start_s=t_base / 1e9, end_s=now_s))
+        # moments-tier quantile acceptance: with sketch=moments active,
+        # quantile_over_time must ride the fused moments grid (the
+        # warm-read overhang gate — fused blocks move, not host blocks)
+        from tempo_tpu.ops import moments as _mom
+        f0 = db.plane_stats.get("fused_metric_blocks", 0)
+        with _mom.use_query_tier("moments"):
+            qq_mom_ms = timed(lambda: db.query_range("bench", qreq))
+        mom_fused = db.plane_stats.get("fused_metric_blocks", 0) - f0
         fused = dict(db.plane_stats)
         scan = _bench_scan_plane(db)
         db.shutdown()
@@ -417,7 +425,12 @@ def bench_query() -> dict:
             "qr_quantile_ms": qq_ms,
             "query_range_host_ms": qr_host_ms, "search_host_ms": s_host_ms,
             "qr_quantile_host_ms": qq_host_ms,
+            "qr_quantile_moments_ms": qq_mom_ms,
+            "qr_quantile_moments_fused_blocks": mom_fused,
             "fused_metric_blocks": fused.get("fused_metric_blocks", 0),
+            "fallback_causes": {
+                k[len("fallback_"):]: v for k, v in fused.items()
+                if k.startswith("fallback_")},
             **scan}
 
 
@@ -675,6 +688,24 @@ def _bench_scan_plane(db) -> dict:
                 equal = False
                 break
     out["qr_grids_equal"] = equal
+    # batched host fallback (warm-read overhang acceptance: <= 1/4 of
+    # the per-view loop above): same views, same query, but observes
+    # stage on host and flush as ONE dispatch per grid — flush() is part
+    # of the measured cost, it IS the dispatch
+    evb = MetricsEvaluator(qr_req, batched=True)
+    for v in scan_views_list[:2]:
+        evb.observe(v)
+    evb.flush()                                     # compile warmup
+    evb = MetricsEvaluator(qr_req, batched=True)
+    t0 = time.time()
+    for v in scan_views_list:
+        evb.observe(v)
+    evb.flush()
+    out["qr_engine_observe_batched_1m_ms"] = (time.time() - t0) * 1000
+    eng_b = {dict(s.labels).get("resource.service.name"):
+             np.nan_to_num(np.asarray(s.samples)) for s in evb.results()}
+    out["qr_batched_equal"] = (set(eng) == set(eng_b) and all(
+        np.allclose(eng[k], eng_b[k], rtol=1e-5, atol=1e-3) for k in eng))
     return out
 
 

@@ -29,15 +29,18 @@ def main() -> int:
         problems = drift.check_drift(OPS_DIR, registries)
     finally:
         app.shutdown()
+    problems += drift.check_bail_causes(OPS_DIR)
     if problems:
         print("METRIC DRIFT:", file=sys.stderr)
         for p in problems:
             print(f"  - {p}", file=sys.stderr)
-        print("register the metric in tempo_tpu/obs (module families) or "
-              "fix the alert/dashboard expression", file=sys.stderr)
+        print("register the metric in tempo_tpu/obs (module families), "
+              "fix the alert/dashboard expression, or document the "
+              "fallback cause in operations/runbook.md", file=sys.stderr)
         return 1
     n = len(drift.referenced_metric_names(OPS_DIR))
-    print(f"ok: {n} referenced metric names all registered")
+    print(f"ok: {n} referenced metric names all registered; "
+          "fallback causes documented")
     return 0
 
 

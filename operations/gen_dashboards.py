@@ -141,6 +141,16 @@ def dashboards() -> dict[str, dict]:
                   "rate(tempo_read_plane_fused_metric_blocks_total[5m])"),
                 p("Host-fallback blocks /s",
                   "rate(tempo_read_plane_host_metric_blocks_total[5m])"),
+                # fused-vs-host routing ratio (runbook "Reading the read
+                # plane"): the warm-read overhang in one number — the
+                # TempoReadPlaneFallbackHigh alert fires when the host
+                # share of metric blocks stays above 25%
+                p("Host-fallback block share (alert fires > 25%)",
+                  "rate(tempo_read_plane_host_metric_blocks_total[5m]) /"
+                  " clamp_min("
+                  "rate(tempo_read_plane_fused_metric_blocks_total[5m])"
+                  " + rate(tempo_read_plane_host_metric_blocks_total[5m]),"
+                  " 1e-9)", unit="percentunit"),
                 p("Plane cache hit ratio",
                   _ratio("tempo_read_plane_cache_hits_total",
                          "tempo_read_plane_cache_misses_total")),

@@ -49,6 +49,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from tempo_tpu.block.fetch import _dict_codes
 from tempo_tpu.traceql import ast as A
 from tempo_tpu.traceql.eval import (BOOL, KIND, NUM, STATUS, STR, Col,
                                     eval_expr)
@@ -352,38 +353,6 @@ def _block_mask_kernel(n: int, pred_sig: tuple, extra_sig: tuple,
 # per-row-group opt-in plane (diagnostic; float32 numerics)
 # ---------------------------------------------------------------------------
 
-def _dict_codes(view, key: str, arrow_col):
-    """(codes[int32], dict values) — cached on the view; the arrow column
-    is usually already dictionary-encoded on disk, so this is an index
-    copy, not a re-encode. Nulls become the dictionary entry "None",
-    matching the numpy plane's astype(str) semantics exactly (a null name
-    DOES match `{ name = "None" }` there), so negation stays a plain
-    complement."""
-    cache = view.meta.setdefault("_dict_codes", {})
-    got = cache.get(key)
-    if got is None:
-        import pyarrow as pa
-
-        arr = arrow_col
-        if isinstance(arr, pa.ChunkedArray):
-            arr = arr.combine_chunks()
-        d = arr.dictionary_encode() if not pa.types.is_dictionary(arr.type) \
-            else arr
-        if isinstance(d, pa.ChunkedArray):
-            d = d.combine_chunks()
-        vals = ["" if v is None else str(v) for v in d.dictionary.to_pylist()]
-        idx = d.indices.to_numpy(zero_copy_only=False)
-        if idx.dtype.kind == "f":              # nulls present
-            try:
-                none_id = vals.index("None")
-            except ValueError:
-                none_id = len(vals)
-                vals = vals + ["None"]
-            codes = np.where(np.isnan(idx), none_id, idx).astype(np.int32)
-        else:
-            codes = np.asarray(idx, np.int32)
-        got = cache[key] = (codes, vals)
-    return got
 
 
 def _col_for(view, attr: A.Attribute):
@@ -736,42 +705,54 @@ class BlockScanPlane:
             self._cols[key] = ent
             return ent
 
-    # hard construction bound for composed two-key grids: label lists and
-    # code composition stay sane; the caller's max_groups applies per query
+    # hard construction bound for composed multi-key grids: label lists
+    # and code composition stay sane; the caller's max_groups applies per
+    # query
     _GROUP2_BUILD_CAP = 1 << 20
 
-    def _ensure_group2(self, e1, e2):
-        """("group2", codes_dev, labels, exists|None) for a two-key by():
-        codes compose as c1*|d2|+c2 on host at adoption (the engine's
-        `_group_slots` composition, engine_metrics.py), labels are
-        (v1, v2) tuples in the same slot order. Unobserved combos cost
-        grid rows but never emit (the obs-count gate). The whole
-        build runs under the plane lock like every other adoption (a
-        racing duplicate would double-count device_bytes)."""
+    def _ensure_groupn(self, exprs):
+        """("groupn", codes_dev, labels, exists|None) for a multi-key
+        by() (2 or 3 keys): codes compose mixed-radix on host at adoption
+        (c1*|d2|*|d3| + c2*|d3| + c3 — the engine's `group_slots`
+        composition, engine_metrics.py), labels are value tuples in the
+        same slot order (itertools.product iterates the last key fastest,
+        matching the composition). Unobserved combos cost grid rows but
+        never emit (the obs-count gate). The whole build runs under the
+        plane lock like every other adoption (a racing duplicate would
+        double-count device_bytes)."""
+        import itertools
+
         with self._lock:
-            key = ("group2", e1, e2)
+            key = ("groupn",) + tuple(exprs)
             if key in self._cols:
                 return self._cols[key]
             ent = None
-            h1 = self._host_group_codes(e1)
-            h2 = self._host_group_codes(e2)
-            if h1 is not None and h2 is not None:
-                n1, n2 = len(h1[1]), len(h2[1])
-                if 0 < n1 * n2 <= self._GROUP2_BUILD_CAP:
-                    codes = (h1[0].astype(np.int64) * n2
-                             + h2[0]).astype(np.int32)
-                    labels = [(l1, l2) for l1 in h1[1] for l2 in h2[1]]
+            hs = [self._host_group_codes(e) for e in exprs]
+            if all(h is not None for h in hs):
+                prod = 1
+                for h in hs:
+                    prod *= len(h[1])
+                if 0 < prod <= self._GROUP2_BUILD_CAP:
+                    codes = np.zeros(self.n, np.int64)
+                    for h in hs:
+                        codes = codes * len(h[1]) + h[0]
+                    labels = [tuple(p) for p in
+                              itertools.product(*[h[1] for h in hs])]
                     ex = None
-                    if h1[2] is not None or h2[2] is not None:
+                    if any(h[2] is not None for h in hs):
                         both = np.ones(self.n, bool)
-                        if h1[2] is not None:
-                            both &= h1[2]
-                        if h2[2] is not None:
-                            both &= h2[2]
+                        for h in hs:
+                            if h[2] is not None:
+                                both &= h[2]
                         ex = self._up(both)
-                    ent = ("group2", self._up(codes), labels, ex)
+                    ent = ("groupn", self._up(codes.astype(np.int32)),
+                           labels, ex)
             self._cols[key] = ent
             return ent
+
+    def _ensure_group2(self, e1, e2):
+        """Back-compat shim for the former two-key entry point."""
+        return self._ensure_groupn((e1, e2))
 
     def _ensure_value(self, attr):
         """("val", f32_dev, bucket_dev, exists|None): the measured column of
@@ -795,6 +776,35 @@ class BlockScanPlane:
                 ex = None if c.exists.all() else self._up(c.exists)
                 ent = ("val", self._up(scaled.astype(np.float32)),
                        self._up(buckets.astype(np.int32)), ex)
+            self._cols[key] = ent
+            return ent
+
+    def _ensure_value_log(self, attr):
+        """("vlog", z_dev, exists|None): clipped log values (ns domain)
+        for the moments-tier quantile grid — host float64 log at
+        adoption, f32 cast, the SAME computation MetricsEvaluator's
+        dispatch applies to its staged values, so fused and host moment
+        sums agree up to f32 scatter order (inside the moments error
+        gate). Missing rows log a placeholder 1.0; the value-exists
+        mask drops them before they reach the grid."""
+        import math
+
+        from tempo_tpu.ops import moments as msk
+
+        with self._lock:
+            key = ("vlog", attr)
+            if key in self._cols:
+                return self._cols[key]
+            ent = None
+            c = self._host_col(attr) if isinstance(attr, A.Attribute) else None
+            if c is not None and c.t == NUM and c.values.dtype != object:
+                v = np.asarray(c.values, np.float64)
+                z = np.log(np.clip(np.where(c.exists, v, 1.0),
+                                   math.exp(msk.QUERY_LO),
+                                   math.exp(msk.QUERY_HI))
+                           ).astype(np.float32)
+                ex = None if c.exists.all() else self._up(c.exists)
+                ent = ("vlog", self._up(z), ex)
             self._cols[key] = ent
             return ent
 
@@ -1063,7 +1073,8 @@ class BlockScanPlane:
                      start_ns: int, end_ns: int, step_ns: int,
                      clip_start_ns: int | None = None,
                      clip_end_ns: int | None = None,
-                     row_groups=None, max_groups: int = 65536):
+                     row_groups=None, max_groups: int = 65536,
+                     moments: bool = False):
         """The FULL device metrics path: predicate mask → exact time clip →
         step bucketing → per-group scatter into device grids, one fused
         dispatch over the resident block (SURVEY §3.4's hot loop with zero
@@ -1103,9 +1114,16 @@ class BlockScanPlane:
             A.MetricsKind.QUANTILE_OVER_TIME: "hist",
             A.MetricsKind.HISTOGRAM_OVER_TIME: "hist",
         }.get(m.kind)
+        if moments and m.kind == A.MetricsKind.QUANTILE_OVER_TIME:
+            # moments query tier: quantile accumulates a [G, steps, k+3]
+            # moment grid (k+1 Chebyshev sums + the two support-bound
+            # planes) instead of the log2 bucket axis — add-merge for
+            # the sums, max-merge for the bounds, both grid-shaped, so
+            # the same packed D2H and combiner conventions apply
+            kind_tag = "mom"
         if kind_tag is None or step_ns <= 0 or end_ns <= start_ns:
             return None, self._bail("shape")
-        if len(m.by) > 2:
+        if len(m.by) > 3:
             return None, self._bail("group")
         if not self._ensure_times():
             return None, self._bail("times")
@@ -1121,8 +1139,8 @@ class BlockScanPlane:
         sig, args, ints = plan
         esig, eargs, eints = extra
 
-        if len(m.by) == 2:
-            gent = self._ensure_group2(m.by[0], m.by[1])
+        if len(m.by) >= 2:
+            gent = self._ensure_groupn(tuple(m.by))
             if gent is None or len(gent[2]) > max_groups:
                 return None, self._bail("group")
             _, gcodes, glabels, gex = gent
@@ -1134,16 +1152,25 @@ class BlockScanPlane:
         else:
             gcodes, glabels, gex = None, [None], None
 
-        needs_value = kind_tag in ("min", "max", "sum", "avg", "hist")
+        from tempo_tpu.ops import moments as _mom
+        mom_cols = _mom.QUERY_K + 3
+        needs_value = kind_tag in ("min", "max", "sum", "avg", "hist", "mom")
         vargs = []
         if needs_value:
             if m.attr is None:
                 return None, self._bail("value")
-            vent = self._ensure_value(m.attr)
-            if vent is None:
-                return None, self._bail("value")
-            _, vvals, vbuckets, vex = vent
-            vargs = [vbuckets if kind_tag == "hist" else vvals]
+            if kind_tag == "mom":
+                vent = self._ensure_value_log(m.attr)
+                if vent is None:
+                    return None, self._bail("value")
+                _, zvals, vex = vent
+                vargs = [zvals]
+            else:
+                vent = self._ensure_value(m.attr)
+                if vent is None:
+                    return None, self._bail("value")
+                _, vvals, vbuckets, vex = vent
+                vargs = [vbuckets if kind_tag == "hist" else vvals]
             if vex is not None:
                 vargs.append(vex)
             v_has_ex = vex is not None
@@ -1152,8 +1179,8 @@ class BlockScanPlane:
 
         n_steps = max(int(-(-(end_ns - start_ns) // step_ns)), 1)
         n_groups = len(glabels)
-        if n_groups * n_steps * (64 if kind_tag == "hist" else 1) * 4 \
-                > 1 << 28:
+        grid_width = {"hist": 64, "mom": mom_cols}.get(kind_tag, 1)
+        if n_groups * n_steps * grid_width * 4 > 1 << 28:
             return None, self._bail("grid_size")
         delta_ns = self.time_base_ns - start_ns
         q_steps = delta_ns // step_ns              # exact whole steps (host)
@@ -1265,6 +1292,28 @@ class BlockScanPlane:
                     grid = jnp.zeros((n_groups, n_steps, 64), jnp.float32)
                     grid = grid.at[slots, steps, vcol].add(ones, mode="drop")
                     return pack(grid, cnt)
+                if kind_tag == "mom":
+                    # vcol is the clipped log-ns value; the Chebyshev
+                    # recurrence runs on device — the SAME basis the host
+                    # evaluator scatters — and the two support-bound
+                    # planes ride the last two columns of the one grid
+                    # (add-merge sums, max-merge bounds; non-matching
+                    # rows carry slot == n_groups and drop)
+                    c0 = (_mom.QUERY_LO + _mom.QUERY_HI) / 2.0
+                    h0 = (_mom.QUERY_HI - _mom.QUERY_LO) / 2.0
+                    sb = jnp.clip((vcol - c0) / h0, -1.0, 1.0)
+                    basis = jnp.stack(
+                        _mom.chebyshev_basis(sb, _mom.QUERY_K), axis=-1)
+                    mcols = jnp.arange(_mom.QUERY_K + 1, dtype=jnp.int32)
+                    grid = jnp.zeros((n_groups, n_steps, mom_cols),
+                                     jnp.float32)
+                    grid = grid.at[slots[:, None], steps[:, None],
+                                   mcols[None, :]].add(basis, mode="drop")
+                    grid = grid.at[slots, steps, _mom.QUERY_K + 1].max(
+                        vcol - _mom.QUERY_LO, mode="drop")
+                    grid = grid.at[slots, steps, _mom.QUERY_K + 2].max(
+                        _mom.QUERY_HI - vcol, mode="drop")
+                    return pack(grid, cnt)
                 vals = vcol
                 if kind_tag == "min":
                     grid = jnp.full((n_groups, n_steps), jnp.inf,
@@ -1313,7 +1362,8 @@ class BlockScanPlane:
                        *args, *eargs),
             kernel="plane_query_range_grid")
         main_shape = ((n_groups, n_steps, 64) if kind_tag == "hist"
-                      else (n_groups, n_steps))
+                      else (n_groups, n_steps, mom_cols)
+                      if kind_tag == "mom" else (n_groups, n_steps))
         return GridHandle(glabels, packed, main_shape,
                           (n_groups, n_steps)), None
 

@@ -89,6 +89,50 @@ def _np_str(arr: pa.ChunkedArray | pa.Array) -> np.ndarray:
     return np.asarray(arr.to_numpy(zero_copy_only=False), dtype=object)
 
 
+def _dict_codes(view, key: str, arrow_col):
+    """(codes[int32], dict values) — cached on the view; the arrow column
+    is usually already dictionary-encoded on disk, so this is an index
+    copy, not a re-encode. Nulls become the dictionary entry "None",
+    matching the numpy plane's astype(str) semantics exactly (a null name
+    DOES match `{ name = "None" }` there), so negation stays a plain
+    complement. Shared by the device plane's dictionary terms and the
+    Col sidecars view_from_table attaches for group_slots."""
+    cache = view.meta.setdefault("_dict_codes", {})
+    got = cache.get(key)
+    if got is None:
+        arr = arrow_col
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        d = arr.dictionary_encode() if not pa.types.is_dictionary(arr.type) \
+            else arr
+        if isinstance(d, pa.ChunkedArray):
+            d = d.combine_chunks()
+        vals = ["" if v is None else str(v) for v in d.dictionary.to_pylist()]
+        idx = d.indices.to_numpy(zero_copy_only=False)
+        if idx.dtype.kind == "f":              # nulls present
+            try:
+                none_id = vals.index("None")
+            except ValueError:
+                none_id = len(vals)
+                vals = vals + ["None"]
+            codes = np.where(np.isnan(idx), none_id, idx).astype(np.int32)
+        else:
+            codes = np.asarray(idx, np.int32)
+        got = cache[key] = (codes, vals)
+    return got
+
+
+def _dict_codes_meta(view, key: str, arrow_col):
+    """(codes, dict values) for a string column's Col sidecar, or
+    (None, None) when the encode fails — either way far cheaper than
+    the per-query object→unicode factorize it lets group_slots skip."""
+    try:
+        codes, vals = _dict_codes(view, key, arrow_col)
+    except Exception:
+        return None, None
+    return codes, vals
+
+
 def _list_parts(arr) -> tuple[np.ndarray, np.ndarray]:
     """(offsets[int64, n+1], flat numpy values) of a list array."""
     if isinstance(arr, pa.ChunkedArray):
@@ -185,8 +229,18 @@ def view_from_table(block: Optional[BackendBlock], tbl: pa.Table) -> ColumnView:
 
     view.set_col("duration", Col(NUM, dur.astype(float), ones))
     view.set_col("__startTime", Col(NUM, start.astype(float), ones))
-    view.set_col("name", Col(STR, _np_str(cols["name"]), ones))
-    view.set_col("resource.service.name", Col(STR, _np_str(cols["service"]), ones))
+    # name/service ride their on-disk dictionary codes alongside the
+    # object values: group_slots factorizes the int32 codes instead of
+    # astype("U")-converting the whole object column per query (nulls
+    # decode to None objects whose astype("U") is "None" — exactly the
+    # "None" dictionary entry _dict_codes mints)
+    ncodes, nvals = _dict_codes_meta(view, "name", cols["name"])
+    view.set_col("name", Col(STR, _np_str(cols["name"]), ones,
+                             codes=ncodes, code_values=nvals))
+    scodes, svals = _dict_codes_meta(view, "service", cols["service"])
+    view.set_col("resource.service.name",
+                 Col(STR, _np_str(cols["service"]), ones,
+                     codes=scodes, code_values=svals))
     kind = np.asarray(cols["kind"].to_numpy(), float)
     view.set_col("kind", Col(KIND, kind, ones))
     otlp_status = np.asarray(cols["status_code"].to_numpy(), np.int64)

@@ -289,7 +289,7 @@ class TempoDB:
         _, freq = compile_query(req.query, req.start_ns, req.end_ns)
         if metas is None:
             metas = self.blocks(tenant, req.start_ns / 1e9, req.end_ns / 1e9)
-        ev = MetricsEvaluator(req, clip_start_ns, clip_end_ns)
+        ev = MetricsEvaluator(req, clip_start_ns, clip_end_ns, batched=True)
         # the fused path is exact only when the pushdown IS the filter:
         # a single filter pipeline that is pure-AND (all_conditions, the
         # optimize() precondition of engine_metrics.go:885) or a pure OR
@@ -299,12 +299,7 @@ class TempoDB:
                    and (ev.fetch_req.all_conditions
                         or ev.fetch_req.pure_disjunction)
                    and all(isinstance(s, A.SpansetFilter) for s in ev.q.stages)
-                   and ev.m.kind != A.MetricsKind.COMPARE
-                   # moments query tier: the block plane's fused grid is
-                   # the log2 bucket axis — mixing bucket series with the
-                   # evaluator's moment series in one combine would be
-                   # meaningless, so quantile queries take the evaluator
-                   and not ev._moments)
+                   and ev.m.kind != A.MetricsKind.COMPARE)
         preds = [c for c in ev.fetch_req.conditions if c.op is not None]
         # phase 1: LAUNCH every supported block's fused grid (async — the
         # dispatches pipeline their device round trips) and run the host
@@ -324,7 +319,7 @@ class TempoDB:
                     labels, main, cnt, vcnt = handles.pop(0).fetch()
                 querystats.add(kernel_wall_ns=time.perf_counter_ns() - t0)
                 fused_parts.append(grid_series(ev.m, labels, main, cnt,
-                                               vcnt))
+                                               vcnt, moments=ev._moments))
 
         for m in metas:
             handle = cb = bail_cause = None
@@ -333,7 +328,8 @@ class TempoDB:
                 handle, bail_cause = cb.plane.metrics_grid(
                     ev.m, preds, ev.fetch_req.all_conditions,
                     req.start_ns, req.end_ns, req.step_ns,
-                    clip_start_ns, clip_end_ns, row_groups)
+                    clip_start_ns, clip_end_ns, row_groups,
+                    moments=ev._moments)
             if handle is not None:
                 self.plane_stats["fused_metric_blocks"] += 1
                 # the fused path never surfaces row bytes to the host —

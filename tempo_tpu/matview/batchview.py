@@ -29,13 +29,21 @@ from tempo_tpu.traceql.eval import (BOOL, KIND, NUM, STATUS, STR, Col,
                                     ColumnView)
 
 
-def _decode_ids(interner, ids: np.ndarray) -> np.ndarray:
-    """[n] int32 interned ids → [n] object strings (INVALID_ID → "")."""
+def _decode_ids_coded(interner, ids: np.ndarray):
+    """[n] int32 interned ids → (values, codes, code_values): object
+    strings plus the dictionary view (codes int32 into code_values,
+    INVALID_ID → ""). The dictionary rides the Col so `group_slots`
+    takes its code fast path instead of re-uniquing strings per view."""
     uniq, inv = np.unique(ids, return_inverse=True)
     strs = np.empty(len(uniq), object)
     for i, sid in enumerate(uniq.tolist()):
         strs[i] = "" if sid == INVALID_ID else interner.lookup(int(sid))
-    return strs[inv]
+    return strs[inv], inv.astype(np.int32), strs.tolist()
+
+
+def _decode_ids(interner, ids: np.ndarray) -> np.ndarray:
+    """[n] int32 interned ids → [n] object strings (INVALID_ID → "")."""
+    return _decode_ids_coded(interner, ids)[0]
 
 
 def _hex_rows(b: np.ndarray) -> np.ndarray:
@@ -96,17 +104,21 @@ def view_from_span_batch(sb: SpanBatch) -> ColumnView:
     end = sb.end_unix_nano[rows].astype(np.float64)
     view.set_col("__startTime", Col(NUM, start, ones))
     view.set_col("duration", Col(NUM, np.maximum(end - start, 0.0), ones))
-    view.set_col("name", Col(STR, _decode_ids(it, sb.name_id[rows]), ones))
-    service = _decode_ids(it, sb.service_id[rows])
-    view.set_col("resource.service.name", Col(STR, service, ones))
+    nvals, ncodes, ndict = _decode_ids_coded(it, sb.name_id[rows])
+    view.set_col("name", Col(STR, nvals, ones,
+                             codes=ncodes, code_values=ndict))
+    svals_, scodes, sdict = _decode_ids_coded(it, sb.service_id[rows])
+    view.set_col("resource.service.name",
+                 Col(STR, svals_, ones, codes=scodes, code_values=sdict))
     # OTLP wire status → traceql enum, vectorized (0/1/2 → unset/ok/error)
     sc = sb.status_code[rows]
     status = np.full(n, float(A.STATUS_UNSET))
     status[sc == 1] = float(A.STATUS_OK)
     status[sc == 2] = float(A.STATUS_ERROR)
     view.set_col("status", Col(STATUS, status, ones))
+    mvals, mcodes, mdict = _decode_ids_coded(it, sb.status_message_id[rows])
     view.set_col("statusMessage",
-                 Col(STR, _decode_ids(it, sb.status_message_id[rows]), ones))
+                 Col(STR, mvals, ones, codes=mcodes, code_values=mdict))
     view.set_col("kind", Col(KIND, sb.kind[rows].astype(np.float64), ones))
     view.set_resolver("trace:id", lambda: Col(
         STR, _hex_rows(sb.trace_id[rows]), np.ones(n, bool)))

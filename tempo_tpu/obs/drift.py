@@ -75,6 +75,35 @@ def check_drift(ops_dir: str, registries) -> list[str]:
     return problems
 
 
+_BAIL_RE = re.compile(r'_bail\("([a-z_]+)"\)')
+_RUNBOOK_CAUSE_RE = re.compile(r"^\| `([a-z_]+)` \|", re.MULTILINE)
+
+
+def check_bail_causes(ops_dir: str) -> list[str]:
+    """Static source↔runbook gate: every `_bail("<cause>")` string in
+    `block/device_scan.py` must have a row in the runbook's
+    fallback-cause table ("Reading the read plane"). A new refusal path
+    cannot ship without an operator-facing explanation — the same
+    one-source-of-truth guarantee the metric-name check gives
+    dashboards."""
+    repo = os.path.dirname(ops_dir)
+    scan_path = os.path.join(repo, "tempo_tpu", "block", "device_scan.py")
+    runbook_path = os.path.join(ops_dir, "runbook.md")
+    problems: list[str] = []
+    if not os.path.exists(scan_path) or not os.path.exists(runbook_path):
+        return [f"bail-cause gate: missing {scan_path} or {runbook_path}"]
+    with open(scan_path) as f:
+        causes = set(_BAIL_RE.findall(f.read()))
+    with open(runbook_path) as f:
+        documented = set(_RUNBOOK_CAUSE_RE.findall(f.read()))
+    for cause in sorted(causes - documented):
+        problems.append(
+            f'_bail("{cause}") in block/device_scan.py has no row in the '
+            f"runbook fallback-cause table (operations/runbook.md, "
+            f'"Reading the read plane")')
+    return problems
+
+
 def default_registries():
     """Boot a `target=all` in-memory App and return its registries —
     the canonical "what does a full process register" answer for the
@@ -95,4 +124,5 @@ def default_registries():
 
 
 __all__ = ["referenced_metric_names", "registered_metric_names",
-           "check_drift", "default_registries", "METRIC_NAME_RE"]
+           "check_drift", "check_bail_causes", "default_registries",
+           "METRIC_NAME_RE"]

@@ -216,9 +216,16 @@ def _scatter_moments_impl(mmt, mhi, mlo, slots, steps, z):
     return mmt, mhi, mlo
 
 
+def _add_dense_impl(grid, delta):
+    return grid + delta
+
+
 _scatter_add2 = instrumented_jit(_scatter_add2_impl,
                                  name="engine_scatter_add2",
                                  donate_argnums=0)
+_add_dense = instrumented_jit(_add_dense_impl,
+                              name="engine_add_dense",
+                              donate_argnums=0)
 _scatter_min2 = instrumented_jit(_scatter_min2_impl,
                                  name="engine_scatter_min2",
                                  donate_argnums=0)
@@ -233,14 +240,16 @@ _scatter_moments = instrumented_jit(_scatter_moments_impl,
                                     donate_argnums=(0, 1, 2))
 
 
-def _sched_scatter(fn, *args):
+def _sched_scatter(fn, *args, kernel: str = "engine_metrics_scatter"):
     """Run one grid-scatter dispatch through the shared device scheduler
     (query class): ingest batches order ahead, the dispatch is counted,
     and an idle scheduler adds zero latency (inline fast path). Direct
-    call when no scheduler is configured."""
+    call when no scheduler is configured. `kernel` names the devtime
+    ledger class — the batched flush dispatches under its own name so
+    the cost model learns its (much larger) bucket sizes separately."""
     from tempo_tpu import sched
 
-    return sched.run(lambda: fn(*args), kernel="engine_metrics_scatter")
+    return sched.run(lambda: fn(*args), kernel=kernel)
 
 
 def _pad_pow2(n: int, lo: int = 256) -> int:
@@ -288,12 +297,20 @@ def matching_rows(q: A.Pipeline, fetch_req, need_second_pass: bool,
     if not need_second_pass:
         from tempo_tpu.block.fetch import condition_mask
 
-        return np.flatnonzero(condition_mask(view, fetch_req))
+        mask = condition_mask(view, fetch_req)
+        if mask.all():   # unfiltered scan: arange beats the mask walk
+            return np.arange(len(mask), dtype=np.int64)
+        return np.flatnonzero(mask)
     stripped = A.Pipeline(q.stages)  # pipeline minus metrics stage
     spansets = evaluate_pipeline(stripped, view)
     if not spansets:
         return np.empty(0, np.int64)
     return np.unique(np.concatenate([ss.rows for ss in spansets]))
+
+
+# composed-key bincount ceiling: beyond this unique-combo product the
+# dense count array would dwarf the row vectors and np.unique wins
+_COMPOSE_BINCOUNT_CAP = 1 << 22
 
 
 def group_slots(by, series: SeriesIndex, view: ColumnView,
@@ -310,13 +327,49 @@ def group_slots(by, series: SeriesIndex, view: ColumnView,
     cols = [(str(e), eval_expr(view, e)) for e in by]
     keep = np.ones(len(rows), bool)
     for _, c in cols:
-        keep &= c.exists[rows]  # spans missing a group key are dropped
-    kept = rows[keep]
+        # spans missing a group key are dropped; fully-present columns
+        # (the common case for intrinsics) skip the per-row gather
+        if not c.exists.all():
+            keep &= c.exists[rows]
+    kept = rows if keep.all() else rows[keep]
     if len(kept) == 0:
         return keep, np.zeros(0, np.int32)
+    if len(cols) == 1 and cols[0][1].codes is not None \
+            and cols[0][1].code_values is not None:
+        # single dictionary-coded key (the dominant group shape): map
+        # dict id → series slot through one LUT — two O(n) passes
+        # (bincount + gather), no compose round trip
+        name, c = cols[0]
+        ck = c.codes if len(kept) == len(c.codes) else c.codes[kept]
+        cv = c.code_values
+        u_ids = np.flatnonzero(np.bincount(ck, minlength=len(cv)))
+        uslots = series.lookup(
+            [((name, _fmt_label(cv[cid], c.t)),) for cid in u_ids.tolist()])
+        slot_lut = np.zeros(len(cv), np.int32)
+        slot_lut[u_ids] = uslots
+        return keep, slot_lut[ck]
     codes: list[np.ndarray] = []
     uniqs: list[tuple[str, np.ndarray, str]] = []
     for name, c in cols:
+        if c.codes is not None and c.code_values is not None:
+            # dictionary/interner sidecar: factorize int32 codes instead
+            # of converting the object column to unicode per query. The
+            # ids are already dense in [0, len(code_values)), so a
+            # bincount + LUT gather (all O(n), no sort) replaces
+            # np.unique's argsort; flatnonzero yields the same ascending
+            # id order unique would. Any code→string mapping yields
+            # identical series keys (SeriesIndex dedupes by key tuple).
+            ck = c.codes if len(kept) == len(c.codes) else c.codes[kept]
+            cv = c.code_values
+            u_ids = np.flatnonzero(np.bincount(ck, minlength=len(cv)))
+            lut = np.zeros(len(cv), np.int64)
+            lut[u_ids] = np.arange(len(u_ids))
+            u = np.empty(len(u_ids), object)
+            for k, cid in enumerate(u_ids.tolist()):
+                u[k] = cv[cid]
+            codes.append(lut[ck])
+            uniqs.append((name, u, c.t))
+            continue
         vals = c.values[kept]
         if vals.dtype == object:    # python-object compares are O(n) py
             vals = vals.astype("U")
@@ -324,15 +377,39 @@ def group_slots(by, series: SeriesIndex, view: ColumnView,
         codes.append(inv.astype(np.int64))
         uniqs.append((name, u, c.t))
     comp = codes[0]
+    prod = len(uniqs[0][1])
     for code, (_, u, _) in zip(codes[1:], uniqs[1:]):
         comp = comp * len(u) + code
-    ucomp, first, inv = np.unique(comp, return_index=True,
-                                  return_inverse=True)
-    tuples = [
-        tuple((name, _fmt_label(u[codes[k][fi]], t))
-              for k, (name, u, t) in enumerate(uniqs))
-        for fi in first.tolist()
-    ]
+        prod *= len(u)
+    if prod <= _COMPOSE_BINCOUNT_CAP:
+        # composed codes are bounded by the per-column unique-count
+        # product: when that fits, the same bincount + LUT trick avoids
+        # the O(n log n) unique over 1M-row scans. Each unique combo
+        # decomposes back into per-column unique indices by division
+        # (the mixed-radix inverse of the compose above).
+        ucomp = np.flatnonzero(np.bincount(comp, minlength=prod))
+        lut = np.zeros(prod, np.int64)
+        lut[ucomp] = np.arange(len(ucomp))
+        inv = lut[comp]
+        tuples = []
+        for v in ucomp.tolist():
+            parts = []
+            for _, u, _ in reversed(uniqs[1:]):
+                v, ci = divmod(v, len(u))
+                parts.append(ci)
+            parts.append(v)
+            parts.reverse()
+            tuples.append(tuple(
+                (name, _fmt_label(u[ci], t))
+                for (name, u, t), ci in zip(uniqs, parts)))
+    else:
+        ucomp, first, inv = np.unique(comp, return_index=True,
+                                      return_inverse=True)
+        tuples = [
+            tuple((name, _fmt_label(u[codes[k][fi]], t))
+                  for k, (name, u, t) in enumerate(uniqs))
+            for fi in first.tolist()
+        ]
     uslots = series.lookup(tuples)
     return keep, uslots[inv].astype(np.int32)
 
@@ -346,8 +423,17 @@ class MetricsEvaluator:
 
     def __init__(self, req: QueryRangeRequest,
                  clip_start_ns: int | None = None,
-                 clip_end_ns: int | None = None):
+                 clip_end_ns: int | None = None,
+                 batched: bool = False):
         self.req = req
+        # batched observation (the host-fallback path of db/tempodb.py):
+        # observe() stages each view's (slots, steps, vals) vectors on
+        # host and flush() issues ONE padded scatter dispatch per grid
+        # over the concatenation — per-view H2D + dispatch becomes a
+        # single device round per query. compare() keeps its per-view
+        # dispatches (its series mint per (attr, value) row-wise).
+        self._batched = bool(batched)
+        self._staged: list[tuple] = []
         # observation clip: sub-requests (backend jobs vs generator window)
         # keep the FULL step grid but only observe spans inside their slice,
         # so combiner tensor-adds line up and the cutoff dedupes sources
@@ -435,11 +521,24 @@ class MetricsEvaluator:
         st = view.col("__startTime")
         if st is None:
             return
-        ts = st.values[rows]
-        step = ((ts - self.req.start_ns) / self.req.step_ns).astype(np.int64)
-        inside = (step >= 0) & (step < self.n_steps) & \
-                 (ts >= self.clip_start_ns) & (ts < self.clip_end_ns)
-        rows, step = rows[inside], step[inside]
+        # all-true masks skip their gathers: a resident scan observing a
+        # covering window would otherwise pay several 1M-row boolean
+        # gathers that move nothing (the .all() probe is ~10× cheaper)
+        ts = st.values if len(rows) == len(st.values) else st.values[rows]
+        # floor (not truncate): step >= 0 must mean ts >= start exactly,
+        # so the ts bound checks below can be skipped when they are
+        # implied by the step bounds
+        step = np.floor((ts - self.req.start_ns) /
+                        self.req.step_ns).astype(np.int32)
+        inside = (step >= 0) & (step < self.n_steps)
+        # the ts bounds only cut when the clip window is narrower than
+        # the step grid itself (sharded sub-requests); the unclipped
+        # case skips two more 1M-row comparison passes
+        grid_end = self.req.start_ns + self.n_steps * self.req.step_ns
+        if self.clip_start_ns > self.req.start_ns or self.clip_end_ns < grid_end:
+            inside &= (ts >= self.clip_start_ns) & (ts < self.clip_end_ns)
+        if not inside.all():
+            rows, step = rows[inside], step[inside]
         if len(rows) == 0:
             return
 
@@ -454,10 +553,10 @@ class MetricsEvaluator:
             self.series.lookup([()])
         else:
             keep, slots = grouped
-            rows, step = rows[keep], step[keep]
+            if not keep.all():
+                rows, step = rows[keep], step[keep]
             if len(rows) == 0:
                 return
-        self._ensure_capacity()
 
         vals = None
         if self.m.attr is not None:
@@ -465,7 +564,9 @@ class MetricsEvaluator:
             if c.t != NUM:
                 return
             vexists = c.exists[rows]
-            rows, step, slots = rows[vexists], step[vexists], slots[vexists]
+            if not vexists.all():
+                rows, step, slots = (rows[vexists], step[vexists],
+                                     slots[vexists])
             if len(rows) == 0:
                 return
             vals = c.values[rows].astype(np.float64)
@@ -478,16 +579,102 @@ class MetricsEvaluator:
                     and _is_duration_attr(self.m.attr):
                 vals = vals / 1e9
 
+        if self._batched:
+            # stage and return: slot ids are already minted (series
+            # capacity only grows), so the flush pass can concatenate
+            # across views and pad against the FINAL capacity
+            self._staged.append((slots, step, vals))
+            self._note_exemplars(view, rows, slots)
+            return
+        self._dispatch(slots, step, vals)
+        self._note_exemplars(view, rows, slots)
+
+    def flush(self) -> None:
+        """Drain batched staging: concatenate every staged view's
+        (slots, steps, vals) vectors and issue ONE dispatch per grid
+        (`results()` calls this, so explicit use is only needed for
+        mid-query grid reads).
+
+        Add-mergeable kinds (count/rate/sum/avg/histogram) fold the
+        concatenation into a DENSE grid-shaped delta with one host
+        bincount pass — grid + delta is the scatter, so the device round
+        ships [cap, steps(, buckets)] floats instead of row vectors and
+        the dispatch cost no longer scales with row count at all.
+        Order-insensitive min/max and the moments recurrence keep the
+        padded row scatter, still one dispatch per grid per flush."""
+        if not self._staged:
+            return
+        staged, self._staged = self._staged, []
+        with querystats.stage("engine_eval"):
+            if self._flush_dense(staged):
+                return
+            slots = np.concatenate([s for s, _, _ in staged])
+            step = np.concatenate([t for _, t, _ in staged])
+            vals = (np.concatenate([v for _, _, v in staged])
+                    if staged[0][2] is not None else None)
+            self._dispatch(slots, step, vals, kernel_suffix="_batched")
+
+    def _flush_dense(self, staged: list[tuple]) -> bool:
+        """Dense-delta flush for the add-merge kinds: fold each staged
+        chunk into the grid-shaped delta (no 1M-row concatenation) and
+        ship it in one device add per grid. False → caller falls back
+        to the padded row scatter."""
+        k = self.m.kind
+        if self._moments or k in (A.MetricsKind.MIN_OVER_TIME,
+                                  A.MetricsKind.MAX_OVER_TIME):
+            return False
+        want_sum = k in (A.MetricsKind.SUM_OVER_TIME,
+                         A.MetricsKind.AVG_OVER_TIME)
+        want_count = k in (A.MetricsKind.RATE, A.MetricsKind.COUNT_OVER_TIME,
+                           A.MetricsKind.AVG_OVER_TIME)
+        if not (self._hist or want_sum or want_count):
+            return False
+        self._ensure_capacity()
+        cap, S = self._cap, self.n_steps
+        deltas: dict[str, np.ndarray] = {}
+
+        def fold(name, m, flat, weights=None):
+            d = deltas.get(name)
+            if d is None:
+                d = deltas[name] = np.zeros(m, np.float64)
+            d += np.bincount(flat, weights=weights, minlength=m)
+
+        for slots, step, vals in staged:
+            flat = slots * np.int32(S) + step  # int32: cap*S is tiny
+            if self._hist:
+                b = log2_bucket_np(vals).astype(np.int64)
+                fold("hist", cap * S * HBUCKETS,
+                     flat.astype(np.int64) * HBUCKETS + b)
+            if want_sum:
+                fold("sum", cap * S, flat, vals)
+            if want_count:
+                fold("count", cap * S, flat)
+        shape = (cap, S, HBUCKETS) if self._hist else (cap, S)
+        for name, d in deltas.items():
+            self._grids[name] = _sched_scatter(
+                _add_dense, self._grids[name],
+                jnp.asarray(d.astype(np.float32).reshape(shape)),
+                kernel="engine_metrics_scatter_batched")
+        return True
+
+    def _dispatch(self, slots: np.ndarray, step: np.ndarray,
+                  vals, kernel_suffix: str = "") -> None:
+        """One padded scatter round per grid over row-aligned update
+        vectors — the shared tail of the per-view and batched paths."""
+        self._ensure_capacity()
+        n = len(slots)
         # pad update vectors to pow2 sizes: stable shapes → one jit cache
         # entry per bucket. Padding rows use slot index == capacity, which is
         # out of bounds and dropped (mode="drop"); never -1 (jax wraps it).
-        size = _pad_pow2(len(rows), 64)
-        pad = size - len(rows)
+        size = _pad_pow2(n, 64)
+        pad = size - n
         jslots = jnp.asarray(np.pad(slots, (0, pad), constant_values=self._cap))
         jsteps = jnp.asarray(np.pad(step.astype(np.int32), (0, pad)))
-        ones = jnp.asarray(np.pad(np.ones(len(rows), np.float32), (0, pad)))
+        ones = jnp.asarray(np.pad(np.ones(n, np.float32), (0, pad)))
         jvals = (jnp.asarray(np.pad(vals.astype(np.float32), (0, pad)))
                  if vals is not None else None)
+        _scatter = lambda fn, *args: _sched_scatter(
+            fn, *args, kernel="engine_metrics_scatter" + kernel_suffix)
         k = self.m.kind
         if self._moments:
             # ~15 floats per (series, step) instead of 64 buckets: ship
@@ -502,31 +689,30 @@ class MetricsEvaluator:
             jz = jnp.asarray(np.pad(z, (0, pad),
                                     constant_values=msk.QUERY_LO))
             (self._grids["mmt"], self._grids["mhi"],
-             self._grids["mlo"]) = _sched_scatter(
+             self._grids["mlo"]) = _scatter(
                 _scatter_moments, self._grids["mmt"], self._grids["mhi"],
                 self._grids["mlo"], jslots, jsteps, jz)
         elif self._hist:
             b = jnp.asarray(np.pad(log2_bucket_np(vals), (0, pad)))
-            self._grids["hist"] = _sched_scatter(
+            self._grids["hist"] = _scatter(
                 _scatter_add3, self._grids["hist"], jslots, jsteps, b, ones)
         elif k in (A.MetricsKind.RATE, A.MetricsKind.COUNT_OVER_TIME):
-            self._grids["count"] = _sched_scatter(
+            self._grids["count"] = _scatter(
                 _scatter_add2, self._grids["count"], jslots, jsteps, ones)
         elif k == A.MetricsKind.MIN_OVER_TIME:
-            self._grids["min"] = _sched_scatter(
+            self._grids["min"] = _scatter(
                 _scatter_min2, self._grids["min"], jslots, jsteps, jvals)
         elif k == A.MetricsKind.MAX_OVER_TIME:
-            self._grids["max"] = _sched_scatter(
+            self._grids["max"] = _scatter(
                 _scatter_max2, self._grids["max"], jslots, jsteps, jvals)
         elif k == A.MetricsKind.SUM_OVER_TIME:
-            self._grids["sum"] = _sched_scatter(
+            self._grids["sum"] = _scatter(
                 _scatter_add2, self._grids["sum"], jslots, jsteps, jvals)
         elif k == A.MetricsKind.AVG_OVER_TIME:
-            self._grids["sum"] = _sched_scatter(
+            self._grids["sum"] = _scatter(
                 _scatter_add2, self._grids["sum"], jslots, jsteps, jvals)
-            self._grids["count"] = _sched_scatter(
+            self._grids["count"] = _scatter(
                 _scatter_add2, self._grids["count"], jslots, jsteps, ones)
-        self._note_exemplars(view, rows, slots)
 
     def _matching_rows(self, view: ColumnView) -> np.ndarray:
         return matching_rows(self.q, self.fetch_req,
@@ -582,6 +768,7 @@ class MetricsEvaluator:
     def results(self) -> list[TimeSeries]:
         """Job-level series (AggregateModeSum — raw sums, no rate division;
         the frontend applies final math after combining)."""
+        self.flush()
         out: list[TimeSeries] = []
         nseries = len(self.series)
         if nseries == 0:
@@ -645,22 +832,31 @@ class MetricsEvaluator:
 
 
 def grid_series(m: A.MetricsAggregate, labels: list, main: np.ndarray,
-                cnt: np.ndarray, vcnt: np.ndarray) -> list[TimeSeries]:
+                cnt: np.ndarray, vcnt: np.ndarray,
+                moments: bool = False) -> list[TimeSeries]:
     """Device metrics grids → job-level TimeSeries, with the exact emission
     semantics of `MetricsEvaluator.results()`: a series exists iff its
     group matched the filter at least once (obs cnt row nonzero — even
     when the measured attribute was missing on every matching span, like
     the host registry); histogram kinds emit one series per nonzero log2
     bucket; avg emits the companion `__meta: count` series counting VALUED
-    spans (vcnt). Labels ride pre-formatted from the plane's factorization
-    (same `_fmt_label` path)."""
+    spans (vcnt). With `moments` (the moments query tier), quantile's
+    `main` is the fused [G, steps, k+3] moment grid and emission follows
+    the evaluator's moments branch: group gated on a nonzero weighted
+    count (moment column 0), per-column gating, bounds unconditional.
+    Labels ride pre-formatted from the plane's factorization (same
+    `_fmt_label` path)."""
     group_names = tuple(str(e) for e in m.by)
     k = m.kind
-    hist = k in (A.MetricsKind.QUANTILE_OVER_TIME,
-                 A.MetricsKind.HISTOGRAM_OVER_TIME)
+    mom = moments and k == A.MetricsKind.QUANTILE_OVER_TIME
+    hist = not mom and k in (A.MetricsKind.QUANTILE_OVER_TIME,
+                             A.MetricsKind.HISTOGRAM_OVER_TIME)
     out: list[TimeSeries] = []
     for gi, lbl in enumerate(labels):
-        if not cnt[gi].any():
+        if mom:
+            if not main[gi, :, 0].any():
+                continue
+        elif not cnt[gi].any():
             continue
         if not group_names:
             key = ()
@@ -668,7 +864,18 @@ def grid_series(m: A.MetricsAggregate, labels: list, main: np.ndarray,
             key = ((group_names[0], lbl),)
         else:   # multi-key: lbl is a value tuple in by() order
             key = tuple(zip(group_names, lbl))
-        if hist:
+        if mom:
+            k1 = main.shape[2] - 2     # k+1 moment cols, then hi, lo
+            for j in range(k1):
+                col = main[gi, :, j]
+                if col.any():
+                    out.append(TimeSeries(key + ((_LABEL_MOMENT, str(j)),),
+                                          col.astype(np.float64)))
+            out.append(TimeSeries(key + ((_LABEL_MOMENT, "hi"),),
+                                  main[gi, :, k1].astype(np.float64)))
+            out.append(TimeSeries(key + ((_LABEL_MOMENT, "lo"),),
+                                  main[gi, :, k1 + 1].astype(np.float64)))
+        elif hist:
             for b in range(HBUCKETS):
                 col = main[gi, :, b]
                 if col.any():
@@ -994,7 +1201,7 @@ def query_range(req: QueryRangeRequest,
                 view_iter: Iterable[tuple[ColumnView, np.ndarray]],
                 ) -> list[TimeSeries]:
     """Single-node convenience: evaluate + combine + final in one call."""
-    ev = MetricsEvaluator(req)
+    ev = MetricsEvaluator(req, batched=True)
     for view, cand in view_iter:
         if len(cand) == 0:
             continue

@@ -38,10 +38,20 @@ _STATIC_T = {
 
 @dataclasses.dataclass
 class Col:
-    """One evaluated column: typed values + existence mask."""
+    """One evaluated column: typed values + existence mask.
+
+    String columns that originate dictionary- or interner-encoded MAY
+    carry their integer codes alongside the materialized values:
+    `codes[i]` indexes `code_values` and `str(code_values[codes[i]])`
+    equals `values[i].astype("U")` row-for-row. Group factorization
+    (`engine_metrics.group_slots`) then runs np.unique over int32 codes
+    instead of paying an O(n) per-query object→unicode conversion; every
+    other consumer ignores the sidecar fields."""
     t: str
     values: np.ndarray
     exists: np.ndarray
+    codes: Optional[np.ndarray] = None        # int32, parallel to values
+    code_values: Optional[list] = None        # code id → string
 
     @staticmethod
     def const(t: str, value, n: int) -> "Col":

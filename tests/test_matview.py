@@ -627,3 +627,46 @@ def test_zero_steady_state_recompiles_on_append():
         clock[0] += 10
     sched.flush()
     assert compiles() == warm
+
+
+def test_batchview_dict_codes_parity():
+    """view_from_span_batch attaches interner dictionary sidecars to its
+    string intrinsics, and group factorization over the codes assigns
+    the SAME series keys as the string path (the codes are an
+    optimization, never a semantic change)."""
+    import dataclasses as dc
+
+    from tempo_tpu.matview.batchview import view_from_span_batch
+    from tempo_tpu.traceql.engine_metrics import SeriesIndex, group_slots
+    from tempo_tpu.traceql.parser import parse
+
+    b = SpanBatchBuilder()
+    for i in range(64):
+        b.append(trace_id=bytes([i % 7 + 1]) * 16, span_id=bytes([2]) * 8,
+                 name=f"op-{i % 5}", service=f"svc-{i % 3}",
+                 status_code=0,
+                 start_unix_nano=int(T0 * 1e9) + i,
+                 end_unix_nano=int(T0 * 1e9) + i + 1000)
+    view = view_from_span_batch(b.build())
+
+    for key in ("name", "resource.service.name", "statusMessage"):
+        c = view.col(key)
+        assert c.codes is not None and c.code_values is not None
+        got = [str(c.code_values[int(cd)]) for cd in c.codes]
+        assert got == [str(v) for v in c.values]
+
+    by = parse(
+        "{ } | rate() by (name, resource.service.name)").metrics.by
+    rows = np.arange(view.n, dtype=np.int64)
+    si_code, si_str = SeriesIndex(), SeriesIndex()
+    keep_c, slots_c = group_slots(list(by), si_code, view, rows)
+    for key in ("name", "resource.service.name"):
+        view.set_col(key, dc.replace(view.col(key),
+                                     codes=None, code_values=None))
+    keep_s, slots_s = group_slots(list(by), si_str, view, rows)
+    assert np.array_equal(keep_c, keep_s)
+    lab_c = {si_code.keys[int(s)] for s in np.unique(slots_c)}
+    lab_s = {si_str.keys[int(s)] for s in np.unique(slots_s)}
+    assert lab_c == lab_s == {
+        (("name", f"op-{i}"), ("resource.service.name", f"svc-{j}"))
+        for i in range(5) for j in range(3)}

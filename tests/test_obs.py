@@ -338,6 +338,34 @@ def test_ops_metric_names_registered(server, tmp_path):
     assert drift.check_drift(str(bogus), [app.obs, RUNTIME]) == []
 
 
+def test_bail_causes_documented(tmp_path):
+    """The fallback-cause gate: every `_bail(...)` string in
+    device_scan.py has a row in the runbook's cause table, and an
+    undocumented cause is caught."""
+    import os
+    import shutil
+
+    import tempo_tpu.app.api as api_mod
+    from tempo_tpu.obs import drift
+
+    ops_dir = os.path.abspath(os.path.join(
+        os.path.dirname(api_mod.__file__), "..", "..", "operations"))
+    assert drift.check_bail_causes(ops_dir) == []
+    # negative: strip one documented cause from a runbook copy
+    repo2 = tmp_path / "repo"
+    (repo2 / "operations").mkdir(parents=True)
+    (repo2 / "tempo_tpu" / "block").mkdir(parents=True)
+    shutil.copy(
+        os.path.join(os.path.dirname(ops_dir),
+                     "tempo_tpu", "block", "device_scan.py"),
+        repo2 / "tempo_tpu" / "block" / "device_scan.py")
+    runbook = open(os.path.join(ops_dir, "runbook.md")).read()
+    (repo2 / "operations" / "runbook.md").write_text(
+        runbook.replace("| `grid_size` |", "| `gridsize_typo` |"))
+    problems = drift.check_bail_causes(str(repo2 / "operations"))
+    assert len(problems) == 1 and "grid_size" in problems[0]
+
+
 def test_slow_request_exemplar_carries_trace_id(server):
     """A frontend op that misses its SLO stamps the active self-tracing
     span's trace id onto the histogram observation (the exemplar bridge:

@@ -86,8 +86,9 @@ def _filter(rng: random.Random) -> str:
 
 
 def _metrics(rng: random.Random) -> str:
+    # 3-key arity exercises the composed mixed-radix group codes
     by_keys = rng.sample(["resource.service.name", "name", "span.region",
-                          "kind"], k=rng.choice([0, 1, 1, 2]))
+                          "kind"], k=rng.choice([0, 1, 1, 2, 3]))
     by = f" by ({', '.join(by_keys)})" if by_keys else ""
     agg = rng.choice(["rate()", "count_over_time()",
                       "min_over_time(duration)", "max_over_time(duration)",
@@ -169,6 +170,130 @@ def test_fuzz_query_range_parity(fuzz_dbs):
         for k in b:
             np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-4,
                                        err_msg=f"{ctx} series={k}")
+
+
+def test_fuzz_moments_tier_query_range_parity(fuzz_dbs):
+    """The warm-read differential arm: the SAME random grammar (kind ×
+    by-arity × predicates) under the moments query tier. Gates: count
+    kinds stay bit-identical between the fused plane and the host
+    engine; quantile series (solved off the moment rows both ways) stay
+    inside the tier's error envelope; and the run must actually ride
+    the moments grids (fused blocks move)."""
+    from tempo_tpu.ops import moments as M
+
+    dev, host = fuzz_dbs
+    rng = random.Random(SEED + 11)
+    fused0 = dev.plane_stats.get("fused_metric_blocks", 0)
+    quantile_fused = 0
+    # case 0 is pinned: a fused-eligible quantile shape, so the
+    # rode-the-moments-grid assertion below cannot depend on the draw
+    pinned = ("{ } | quantile_over_time(duration, .5, .99)"
+              " by (resource.service.name)")
+    with M.use_query_tier("moments"):
+        for case in range(max(N_QUERIES // 4, 8)):
+            q = pinned if case == 0 else _metrics(rng)
+            w0 = T0 + rng.choice([0, -120, 37, 333])
+            w1 = w0 + rng.choice([900, 301, 1500])
+            req = QueryRangeRequest(query=q, start_ns=int(w0 * 1e9),
+                                    end_ns=int(w1 * 1e9),
+                                    step_ns=int(rng.choice([30, 60, 300])
+                                                * 1e9))
+            ctx = f"seed={SEED} case={case} query={q!r} tier=moments"
+            f0 = dev.plane_stats.get("fused_metric_blocks", 0)
+            try:
+                a = _smap(dev.query_range("t", req))
+                b = _smap(host.query_range("t", req))
+            except Exception as e:
+                raise AssertionError(f"{ctx}: {e}") from e
+            if "quantile_over_time" in q:
+                quantile_fused += (
+                    dev.plane_stats.get("fused_metric_blocks", 0) - f0)
+            assert set(a) == set(b), f"{ctx}: series sets differ " \
+                f"(only-dev={set(a) - set(b)}, only-host={set(b) - set(a)})"
+            for k in b:
+                if "quantile_over_time" in q:
+                    # moments error gate: both sides solve the maxent
+                    # problem off independently-accumulated f32 moment
+                    # sums — reduction order differs, the answer class
+                    # (tier bound) must not
+                    np.testing.assert_allclose(
+                        a[k], b[k], rtol=5e-2, atol=1e-6,
+                        err_msg=f"{ctx} series={k}")
+                elif ("rate()" in q or "count_over_time" in q
+                      or "histogram_over_time" in q):
+                    # count kinds: integer grid cells → bit-identical
+                    assert np.array_equal(a[k], b[k]), \
+                        f"{ctx} series={k}: count-kind series not " \
+                        f"bit-identical ({a[k]} vs {b[k]})"
+                else:
+                    # float-sum kinds carry f32 reduction-order noise
+                    np.testing.assert_allclose(
+                        a[k], b[k], rtol=1e-5, atol=1e-4,
+                        err_msg=f"{ctx} series={k}")
+    assert dev.plane_stats.get("fused_metric_blocks", 0) > fused0, \
+        f"seed={SEED}: moments-tier run never rode the fused plane"
+    assert quantile_fused > 0, \
+        f"seed={SEED}: no quantile_over_time block rode the moments grid"
+
+
+def test_forced_refusal_exercises_batched_fallback(fuzz_dbs):
+    """≥1 deterministic refusal: a mixed AND/OR filter is NOT fusable
+    (superset masks would corrupt metrics), so the block must route to
+    the batched host fallback — the cause counter moves, the batched
+    evaluator answers, and parity against the host-only instance still
+    holds bit-for-bit."""
+    dev, host = fuzz_dbs
+    q = ('{ name = "op-1" && (resource.service.name = "svc-0" '
+         '|| span.region = "r1") } | rate() by (name)')
+    req = QueryRangeRequest(query=q, start_ns=int(T0 * 1e9),
+                            end_ns=int((T0 + 900) * 1e9),
+                            step_ns=int(60 * 1e9))
+    before = dict(dev.plane_stats)
+    a = _smap(dev.query_range("t", req))
+    b = _smap(host.query_range("t", req))
+    cause_delta = (dev.plane_stats.get("fallback_query_shape", 0)
+                   - before.get("fallback_query_shape", 0))
+    host_delta = (dev.plane_stats.get("host_metric_blocks", 0)
+                  - before.get("host_metric_blocks", 0))
+    assert cause_delta > 0 and host_delta > 0, \
+        f"refusal did not route to the host fallback: {dev.plane_stats}"
+    assert set(a) == set(b)
+    for k in b:
+        assert np.array_equal(a[k], b[k]), f"series={k}"
+
+
+def test_zero_steady_state_recompiles_read_paths(fuzz_dbs):
+    """Warm repeats of BOTH warm-read paths — the fused moments grid and
+    the batched host fallback — must reuse their compiled traces: zero
+    jit compiles across the steady-state phase (the ISSUE 20 acceptance
+    gate, over the product entry point)."""
+    from tempo_tpu.obs.jaxruntime import JIT_COMPILES
+    from tempo_tpu.ops import moments as M
+
+    dev, _host = fuzz_dbs
+    fused_q = ("{ } | quantile_over_time(duration, .5, .99)"
+               " by (resource.service.name)")
+    refusal_q = ('{ name = "op-1" && (resource.service.name = "svc-0" '
+                 '|| span.region = "r1") } | rate() by (name)')
+    reqs = [QueryRangeRequest(query=q, start_ns=int(T0 * 1e9),
+                              end_ns=int((T0 + 900) * 1e9),
+                              step_ns=int(60 * 1e9))
+            for q in (fused_q, refusal_q)]
+
+    def total_compiles():
+        with JIT_COMPILES._lock:
+            return sum(JIT_COMPILES._series.values())
+
+    with M.use_query_tier("moments"):
+        for _ in range(2):                      # warm every shape bucket
+            for req in reqs:
+                dev.query_range("t", req)
+        warm = total_compiles()
+        for _ in range(3):
+            for req in reqs:
+                dev.query_range("t", req)
+        assert total_compiles() == warm, \
+            "steady-state repeats recompiled a read-path kernel"
 
 
 def test_fuzz_search_parity(fuzz_dbs):
